@@ -1,0 +1,97 @@
+"""Headline benchmark: RS(8,3) encode throughput, GB/s per chip.
+
+The TPU analog of the reference harness invocation
+`ceph_erasure_code_benchmark -p isa -P k=8 -P m=3 -S 1048576 -i 1000`
+(/root/reference/src/erasure-code/isa/README:36-47; harness at
+src/test/erasure-code/ceph_erasure_code_benchmark.cc): each "object" is a
+1 MiB stripe split into eight 128 KiB data chunks; throughput counts input
+object bytes per second of encode, exactly like the harness's
+`iterations * size / elapsed`.  Stripes are batched and resident in HBM —
+the codec's deep-batching design (SURVEY.md §7 step 3) that replaces the
+reference's per-stripe CPU loop (src/osd/ECUtil.cc:139).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+vs_baseline is the ratio against the 40 GB/s/chip north-star target
+(BASELINE.json).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_GBPS = 40.0
+
+
+def main() -> None:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.gf import expand_matrix, isa_rs_vandermonde_matrix
+    from ceph_tpu.ops.pallas_gf import CodingPlan
+    from ceph_tpu.ops.xor_mm import xor_matmul
+
+    k, m = 8, 3
+    chunk = 128 * 1024  # 1 MiB object / 8 data chunks
+    platform = jax.devices()[0].platform
+    batch = 64 if platform != "cpu" else 2  # 64 MiB of object data per launch
+    iters = 40 if platform != "cpu" else 3
+
+    gfm = isa_rs_vandermonde_matrix(k, m)[k:]
+    if platform == "tpu":
+        plan = CodingPlan(gfm)
+        encode_fn = plan
+    else:
+        bit_matrix = jnp.asarray(expand_matrix(gfm), dtype=jnp.uint8)
+        encode_fn = functools.partial(xor_matmul, bit_matrix)
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8), dtype=jnp.uint8
+    )
+
+    # Serial-chain methodology: each launch's input depends on the previous
+    # launch's parity (a 128-byte patch, updated in place via donation), so
+    # runtime-level caching/elision of repeated identical launches cannot
+    # inflate the number; the measured loop is real back-to-back encodes.
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(d, p):
+        patch = (p[:1, :1, :128] ^ jnp.uint8(1)).reshape(1, 1, 128)
+        d2 = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
+        return d2, encode_fn(d2)
+
+    p = encode_fn(data)
+    data, p = step(data, p)  # compile + warm
+    jax.block_until_ready((data, p))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        data, p = step(data, p)
+    jax.block_until_ready((data, p))
+    elapsed = time.perf_counter() - t0
+
+    total_bytes = batch * k * chunk * iters  # input object bytes, harness semantics
+    gbps = total_bytes / elapsed / 1e9
+    print(
+        f"[bench] platform={platform} batch={batch} iters={iters} "
+        f"elapsed={elapsed:.4f}s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "rs_8_3_encode_GBps_per_chip",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / NORTH_STAR_GBPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""ceph_tpu — a TPU-native erasure-coding framework.
+
+From-scratch rebuild of the capability surface of Ceph's erasure-code subsystem
+(reference mounted at /root/reference), designed TPU-first:
+
+- GF(2^8) Reed-Solomon/Cauchy/LRC/SHEC/CLAY codecs whose hot loops are
+  bitsliced XOR-matmuls on the MXU (ceph_tpu.ops), not per-byte table lookups.
+- A codec interface/base/registry stack mirroring the semantics of the
+  reference's `ErasureCodeInterface` / `ErasureCode` / `ErasureCodePluginRegistry`
+  (/root/reference/src/erasure-code/) so everything above the codec boundary
+  (stripe engine, tools, benchmarks) is plugin-agnostic.
+- Stripe math + hinfo CRC (ceph_tpu.stripe) mirroring src/osd/ECUtil.{h,cc}.
+- Data-parallel stripe-batch sharding across a TPU mesh (ceph_tpu.parallel).
+"""
+
+__version__ = "0.1.0"
+
+from . import gf  # noqa: F401

@@ -1,0 +1,12 @@
+"""Codec stack: interface, base scaffolding, plugin registry, codecs."""
+
+from .base import ErasureCode
+from .interface import EcError, ErasureCodeInterface, Profile
+from .registry import ErasureCodePlugin, ErasureCodePluginRegistry, instance
+from .rs import CAUCHY, VANDERMONDE, ErasureCodeTpuRs
+
+__all__ = [
+    "ErasureCode", "EcError", "ErasureCodeInterface", "Profile",
+    "ErasureCodePlugin", "ErasureCodePluginRegistry", "instance",
+    "CAUCHY", "VANDERMONDE", "ErasureCodeTpuRs",
+]

@@ -1,0 +1,6 @@
+"""Loadable codec plugins (the `libec_<name>.so` analog set).
+
+Each module here is one plugin: it declares `__erasure_code_version__` and an
+`__erasure_code_init__(registry)` entry point, mirroring the reference's
+dlopen contract (/root/reference/src/erasure-code/ErasureCodePlugin.cc:126-163).
+"""

@@ -1,0 +1,59 @@
+"""Trivial XOR example plugin (k data + 1 parity).
+
+Mirror of the reference's example codec used by registry tests
+(/root/reference/src/test/erasure-code/ErasureCodeExample.h).
+"""
+
+import numpy as np
+
+from ceph_tpu.codec.base import ErasureCode
+from ceph_tpu.codec.interface import Profile
+from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePlugin
+from ceph_tpu.ops.xor_mm import xor_reduce
+
+__erasure_code_version__ = EC_VERSION
+
+
+class ErasureCodeXorExample(ErasureCode):
+    def __init__(self) -> None:
+        super().__init__()
+        self.k = 2
+
+    def parse(self, profile: Profile) -> None:
+        super().parse(profile)
+        self.k = self.to_int("k", profile, "2")
+        self.sanity_check_k_m(self.k, 1)
+
+    def get_chunk_count(self) -> int:
+        return self.k + 1
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        data = np.stack(
+            [np.asarray(chunks[self.chunk_index(i)], dtype=np.uint8) for i in range(self.k)]
+        )
+        np.copyto(chunks[self.chunk_index(self.k)], np.asarray(xor_reduce(data)))
+
+    def decode_chunks(self, want_to_read, chunks, decoded) -> None:
+        raw_of = self.chunk_index
+        erasures = [i for i in range(self.k + 1) if raw_of(i) not in chunks]
+        if not erasures:
+            return
+        assert len(erasures) == 1, "XOR codec tolerates exactly one erasure"
+        sources = [i for i in range(self.k + 1) if raw_of(i) in chunks][: self.k]
+        stack = np.stack(
+            [np.asarray(decoded[raw_of(i)], dtype=np.uint8) for i in sources]
+        )
+        np.copyto(decoded[raw_of(erasures[0])], np.asarray(xor_reduce(stack)))
+
+
+def _factory(profile):
+    ec = ErasureCodeXorExample()
+    ec.init(profile)
+    return ec
+
+
+def __erasure_code_init__(registry):
+    registry.add("xor", ErasureCodePlugin("xor", _factory))

@@ -1,0 +1,128 @@
+"""Plugin registry — mirror of `ErasureCodePluginRegistry`.
+
+Reference: /root/reference/src/erasure-code/ErasureCodePlugin.{h,cc}.  The
+reference dlopens `libec_<name>.so`, checks `__erasure_code_version()` against
+the build version (mismatch -> -EXDEV, :134-143), calls
+`__erasure_code_init(name, dir)` which registers a Plugin whose `factory()`
+builds codec instances, and verifies the instance's profile round-trips
+(:86-114).
+
+Here plugins are Python modules under `ceph_tpu.codec.plugins` loaded on
+demand (the import system plays dlopen's role); each must expose a module-level
+`__erasure_code_version__` string and an `__erasure_code_init__(registry)`
+entry point.  The native shell (native/) re-exports this registry behind the
+exact C ABI so a real Ceph OSD can dlopen `libec_tpu.so`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Callable
+
+from .interface import EcError, ErasureCodeInterface, Profile
+
+# The ABI version plugins must declare (reference: CEPH_GIT_NICE_VER check).
+EC_VERSION = "ceph_tpu-1"
+
+EXDEV = 18
+ENOENT = 2
+EEXIST = 17
+
+PLUGIN_PACKAGE = "ceph_tpu.codec.plugins"
+
+
+class ErasureCodePlugin:
+    """A registered factory (ErasureCodePlugin.h:39)."""
+
+    def __init__(self, name: str, factory: Callable[[Profile], ErasureCodeInterface]):
+        self.name = name
+        self._factory = factory
+
+    def factory(self, profile: Profile) -> ErasureCodeInterface:
+        ec = self._factory(profile)
+        return ec
+
+
+class ErasureCodePluginRegistry:
+    """Singleton get-or-load registry (ErasureCodePlugin.h:45)."""
+
+    _instance: "ErasureCodePluginRegistry | None" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._plugins: dict[str, ErasureCodePlugin] = {}
+        self.disable_dlclose = False  # kept for harness parity (bench sets it)
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        """ErasureCodePlugin.cc registry.add: duplicate -> -EEXIST."""
+        with self._lock:
+            if name in self._plugins:
+                raise EcError(EEXIST, f"plugin {name} already registered")
+            self._plugins[name] = plugin
+
+    def get(self, name: str) -> ErasureCodePlugin | None:
+        with self._lock:
+            return self._plugins.get(name)
+
+    def load(self, name: str) -> ErasureCodePlugin:
+        """Import-and-register, with the reference's failure-mode contract:
+        missing entry point / bad version map to the same errnos the dlopen
+        path produces (ErasureCodePlugin.cc:126-163)."""
+        with self._lock:
+            plugin = self._plugins.get(name)
+            if plugin is not None:
+                return plugin
+            try:
+                mod = importlib.import_module(f"{PLUGIN_PACKAGE}.{name}")
+            except ImportError as e:
+                raise EcError(ENOENT, f"plugin {name} not found") from e
+            version = getattr(mod, "__erasure_code_version__", None)
+            if version is None:
+                raise EcError(EXDEV, f"plugin {name} missing __erasure_code_version__")
+            if version != EC_VERSION:
+                raise EcError(
+                    EXDEV, f"plugin {name} version {version} != expected {EC_VERSION}"
+                )
+            init = getattr(mod, "__erasure_code_init__", None)
+            if init is None:
+                raise EcError(ENOENT, f"plugin {name} missing __erasure_code_init__")
+            init(self)
+            plugin = self._plugins.get(name)
+            if plugin is None:
+                raise EcError(EXDEV, f"plugin {name} init did not register itself")
+            return plugin
+
+    def factory(self, name: str, profile: Profile) -> ErasureCodeInterface:
+        """Get-or-load + instantiate + profile round-trip check
+        (ErasureCodePlugin.cc:86-114)."""
+        plugin = self.load(name)
+        ec = plugin.factory(profile)
+        got = ec.get_profile()
+        if got != profile:
+            raise EcError(
+                EXDEV,
+                f"profile {profile} != get_profile() {got} for plugin {name}",
+            )
+        return ec
+
+    def preload(self, plugins_csv: str) -> None:
+        """Load a comma-separated plugin list at startup
+        (ErasureCodePlugin.cc:180-196; used by OSD boot via
+        osd_erasure_code_plugins)."""
+        for name in plugins_csv.split(","):
+            name = name.strip()
+            if name:
+                self.load(name)
+
+
+def instance() -> ErasureCodePluginRegistry:
+    return ErasureCodePluginRegistry.instance()

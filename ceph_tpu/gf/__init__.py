@@ -1,0 +1,46 @@
+"""GF(2^8) math core: tables, coding matrices, bitsliced GF(2) expansion."""
+
+from .tables import (
+    GF_EXP,
+    GF_INV_TABLE,
+    GF_LOG,
+    GF_MUL_TABLE,
+    GF_POLY,
+    gf_inv,
+    gf_matmul,
+    gf_matvec,
+    gf_mul,
+    gf_mul_slow,
+    gf_mul_vec,
+    gf_pow,
+)
+from .matrix import (
+    gf_invert_matrix,
+    identity,
+    isa_cauchy_matrix,
+    isa_decode_matrix,
+    isa_rs_vandermonde_matrix,
+    jerasure_cauchy_good_matrix,
+    jerasure_cauchy_orig_matrix,
+    jerasure_r6_matrix,
+    jerasure_vandermonde_matrix,
+    vandermonde_mds_check,
+)
+from .bitslice import (
+    bitslice_bytes,
+    coeff_bitmatrix,
+    expand_matrix,
+    unbitslice_bytes,
+    xor_matmul_host,
+)
+
+__all__ = [
+    "GF_EXP", "GF_INV_TABLE", "GF_LOG", "GF_MUL_TABLE", "GF_POLY",
+    "gf_inv", "gf_matmul", "gf_matvec", "gf_mul", "gf_mul_slow", "gf_mul_vec",
+    "gf_pow", "gf_invert_matrix", "identity", "isa_cauchy_matrix",
+    "isa_decode_matrix", "isa_rs_vandermonde_matrix",
+    "jerasure_cauchy_good_matrix", "jerasure_cauchy_orig_matrix",
+    "jerasure_r6_matrix", "jerasure_vandermonde_matrix",
+    "vandermonde_mds_check", "bitslice_bytes", "coeff_bitmatrix",
+    "expand_matrix", "unbitslice_bytes", "xor_matmul_host",
+]

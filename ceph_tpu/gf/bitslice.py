@@ -1,0 +1,78 @@
+"""Bitsliced GF(2^8) — expand GF coding matrices into GF(2) bit-matrices.
+
+The TPU-first trick that makes Reed-Solomon ride the MXU: multiplying a byte by
+a constant c in GF(2^8) is a *linear map over GF(2)* on the byte's 8 bits.  So
+an (m, k) GF coding matrix expands into an (8m, 8k) 0/1 matrix B, and encoding
+becomes
+
+    parity_bits = (B @ data_bits) mod 2
+
+i.e. an integer matmul followed by a parity reduction — exactly the shape the
+MXU wants, with the stripe-length axis as the huge N dimension.  This replaces
+the reference's per-byte table lookups (ISA-L `ec_encode_data` /
+gf-complete SIMD regions) with one dense matmul per launch; it is the same
+linearization jerasure's "bitmatrix" techniques use on CPU
+(/root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.h:120-167), but
+laid out for a systolic array instead of word-wise XOR.
+
+Bit conventions: bit b of a byte is (x >> b) & 1 (LSB-first).  Column j of the
+8x8 block for coefficient c holds the bits of c * 2^j, because multiplying the
+basis byte 2^j by c yields that column's contribution.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .tables import GF_MUL_TABLE
+
+
+def coeff_bitmatrix(c: int) -> np.ndarray:
+    """(8, 8) 0/1 matrix M_c with M_c[i, j] = bit i of (c * 2^j in GF(2^8)).
+
+    Satisfies: bits(c * x) = M_c @ bits(x) mod 2 for every byte x.
+    """
+    cols = GF_MUL_TABLE[c, (1 << np.arange(8)).astype(np.uint8)]  # c * 2^j
+    return ((cols[None, :] >> np.arange(8)[:, None]) & 1).astype(np.uint8)
+
+
+def expand_matrix(gf_matrix: np.ndarray) -> np.ndarray:
+    """Expand an (m, k) GF(2^8) matrix into its (8m, 8k) GF(2) bit-matrix."""
+    gf_matrix = np.asarray(gf_matrix, dtype=np.uint8)
+    m, k = gf_matrix.shape
+    out = np.zeros((8 * m, 8 * k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            c = int(gf_matrix[i, j])
+            if c:
+                out[8 * i:8 * i + 8, 8 * j:8 * j + 8] = coeff_bitmatrix(c)
+    return out
+
+
+def bitslice_bytes(data: np.ndarray) -> np.ndarray:
+    """Host reference: (k, L) uint8 -> (8k, L) 0/1 bit-planes (LSB-first)."""
+    data = np.asarray(data, dtype=np.uint8)
+    k, L = data.shape
+    planes = (data[:, None, :] >> np.arange(8, dtype=np.uint8)[None, :, None]) & 1
+    return planes.reshape(8 * k, L)
+
+
+def unbitslice_bytes(planes: np.ndarray) -> np.ndarray:
+    """Host reference: (8m, L) 0/1 planes -> (m, L) uint8 bytes."""
+    planes = np.asarray(planes, dtype=np.uint8)
+    m8, L = planes.shape
+    assert m8 % 8 == 0
+    p = planes.reshape(m8 // 8, 8, L)
+    weights = (1 << np.arange(8, dtype=np.uint16))[None, :, None]
+    return (p.astype(np.uint16) * weights).sum(axis=1).astype(np.uint8)
+
+
+def xor_matmul_host(bit_matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Host reference of the device kernel: GF coding via bitsliced XOR-matmul.
+
+    bit_matrix: (8m, 8k) 0/1; data: (k, L) uint8 -> (m, L) uint8.
+    Used by tests as the oracle for the jnp/Pallas implementations.
+    """
+    planes = bitslice_bytes(data)
+    out_planes = (bit_matrix.astype(np.int32) @ planes.astype(np.int32)) & 1
+    return unbitslice_bytes(out_planes.astype(np.uint8))

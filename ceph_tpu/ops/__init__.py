@@ -1,0 +1,5 @@
+"""Device kernels: bitsliced GF(2^8) XOR-matmul (jnp + Pallas paths)."""
+
+from .xor_mm import as_device_bit_matrix, encode_full, xor_matmul, xor_reduce
+
+__all__ = ["as_device_bit_matrix", "encode_full", "xor_matmul", "xor_reduce"]

@@ -1,0 +1,180 @@
+"""Pallas TPU kernel: fused bitsliced GF(2^8) coding.
+
+The perf-critical path behind the 40 GB/s/chip north star (BASELINE.md).  The
+jnp reference (ceph_tpu.ops.xor_mm) materializes the 8x bit-plane expansion
+and the int32 parity accumulators in HBM, capping throughput at ~1/10 of HBM
+bandwidth.  This kernel keeps the whole pipeline in VMEM per tile:
+
+    HBM -> VMEM:  (k, T) uint8 chunk tile           (the only data read)
+    VPU:          8 bit-planes per chunk, f32       (shifts/masks, unrolled)
+    MXU:          (8*MP, 8k) @ (8k, T) f32 matmul
+    VPU:          mod-2 + fold bits -> (m, T)
+    VMEM -> HBM:  (m, T) uint8 parity tile          (the only data write)
+
+so HBM traffic is the information-theoretic minimum: k bytes in, m bytes out
+per stripe byte.
+
+Layout choices are driven by Mosaic's tiling:
+- planes are f32 (native (8, 128) tiles) and stacked *b-major* — piece b is
+  ((data >> b) & 1) with k rows, so for k = 8 every concat piece is exactly
+  one sublane tile: no relayouts.
+- output rows are padded to MP = 8 per bit-block: the coding matrix is
+  arranged on host as B'[r*MP + i, b*k + j] = bit r of (C[i,j] * 2^b), so the
+  fold reads tile-aligned (MP, T) slices per output bit r.
+- f32 accumulation is exact: operands are 0/1, sums bounded by 8k << 2^24.
+
+One compiled kernel per (rows, k, shape) serves every coding matrix — encode,
+any-erasure decode, LRC locality groups — because the bit-matrix is an
+operand, not a constant (the device analog of the reference's LRU
+decode-table cache, isa/ErasureCodeIsaTableCache.h:48).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ceph_tpu.gf.bitslice import coeff_bitmatrix
+
+# Rows per bit-block in the arranged matrix (f32 sublane tile height).
+MP = 8
+
+# Tile of the chunk-length (lane) axis each program processes.  VMEM per
+# program ~= T*(k + 4k + 32k + 32*MP + m) bytes; T=4096 with k=8 is ~1.3 MB.
+DEFAULT_TILE = 4096
+
+
+def arrange_bit_matrix(gf_matrix: np.ndarray) -> np.ndarray:
+    """(m, k) GF matrix -> (8*MP, 8k) f32 0/1 matrix in MXU-friendly layout.
+
+    B'[r*MP + i, b*k + j] = bit r of (gf_matrix[i, j] * 2^b); rows i >= m are
+    zero padding.  Requires m <= MP (callers split larger codes into row
+    groups of MP).
+    """
+    gf_matrix = np.asarray(gf_matrix, dtype=np.uint8)
+    m, k = gf_matrix.shape
+    assert m <= MP, f"m={m} > {MP}; split the matrix into row groups"
+    out = np.zeros((8 * MP, 8 * k), dtype=np.float32)
+    for i in range(m):
+        for j in range(k):
+            c = int(gf_matrix[i, j])
+            if c:
+                mc = coeff_bitmatrix(c)  # mc[r, b] = bit r of c*2^b
+                for r in range(8):
+                    for b in range(8):
+                        out[r * MP + i, b * k + j] = mc[r, b]
+    return out
+
+
+def _coding_kernel(bm_ref, data_ref, out_ref, *, k: int, m: int):
+    """One (stripe, lane-tile) program: parity tile from a chunk tile."""
+    d32 = data_ref[0].astype(jnp.int32)  # (k, T)
+    # Bit-plane expansion, b-major stacking: (8k, T) f32, tile-aligned pieces.
+    planes = jnp.concatenate(
+        [((d32 >> b) & 1).astype(jnp.float32) for b in range(8)], axis=0
+    )
+    acc = jax.lax.dot_general(
+        bm_ref[:],
+        planes,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)  # (8*MP, T)
+    # Fold: out byte bit r lives in tile-aligned row block [r*MP, r*MP+MP).
+    folded = acc[0:MP] & 1
+    for r in range(1, 8):
+        folded |= (acc[r * MP : (r + 1) * MP] & 1) << r
+    out_ref[0] = folded[:m].astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "tile", "interpret"))
+def _gf_code_stripes(
+    arranged_bm: jax.Array,
+    data: jax.Array,
+    *,
+    m: int,
+    tile: int,
+    interpret: bool = False,
+) -> jax.Array:
+    s, k, L = data.shape
+    assert arranged_bm.shape == (8 * MP, 8 * k), (arranged_bm.shape, k)
+    assert L % tile == 0, (L, tile)
+    grid = (s, L // tile)
+    return pl.pallas_call(
+        functools.partial(_coding_kernel, k=k, m=m),
+        grid=grid,
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec(
+                (8 * MP, 8 * k), lambda i, j: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, k, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, m, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((s, m, L), jnp.uint8),
+    )(arranged_bm, data)
+
+
+def pick_tile(L: int, cap: int = DEFAULT_TILE) -> int:
+    """Largest power-of-two tile <= cap dividing L (L is 128-aligned)."""
+    t = cap
+    while t > 128 and L % t:
+        t //= 2
+    return t
+
+
+class CodingPlan:
+    """Host-built plan: GF matrix arranged for the kernel + dispatch info.
+
+    The device-side analog of ISA-L's `ec_init_tables` product: built once
+    per (matrix, geometry), then applied to any number of stripe batches.
+    Matrices with m > MP rows are split into row groups applied back-to-back.
+    """
+
+    def __init__(self, gf_matrix: np.ndarray, *, interpret: bool = False):
+        gf_matrix = np.asarray(gf_matrix, dtype=np.uint8)
+        self.m, self.k = gf_matrix.shape
+        self.interpret = interpret
+        self.groups = [
+            jnp.asarray(arrange_bit_matrix(gf_matrix[i : i + MP]))
+            for i in range(0, self.m, MP)
+        ]
+
+    def __call__(self, data: jax.Array) -> jax.Array:
+        """(..., k, L) uint8 -> (..., m, L) uint8 coded output."""
+        *lead, k, L = data.shape
+        assert k == self.k, (k, self.k)
+        stripes = int(np.prod(lead)) if lead else 1
+        flat = data.reshape(stripes, k, L)
+        tile = pick_tile(L)
+        outs = []
+        for g, bm in enumerate(self.groups):
+            rows = min(MP, self.m - g * MP)
+            outs.append(
+                _gf_code_stripes(
+                    bm, flat, m=rows, tile=tile, interpret=self.interpret
+                )
+            )
+        out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+        return out.reshape(*lead, self.m, L)
+
+
+def gf_code(bit_matrix_or_plan, data: jax.Array) -> jax.Array:
+    """Shape-flexible coding entry.
+
+    Accepts a CodingPlan (preferred, TPU path) or a raw (8m, 8k) bit-matrix
+    (jnp fallback — also used off-TPU where Pallas TPU kernels can't run).
+    """
+    if isinstance(bit_matrix_or_plan, CodingPlan) and jax.devices()[0].platform == "tpu":
+        return bit_matrix_or_plan(data)
+    from .xor_mm import xor_matmul
+
+    if isinstance(bit_matrix_or_plan, CodingPlan):
+        raise TypeError("CodingPlan requires a TPU backend; pass a bit-matrix")
+    return xor_matmul(bit_matrix_or_plan, data)

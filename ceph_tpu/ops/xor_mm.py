@@ -1,0 +1,94 @@
+"""Device GF(2^8) coding as bitsliced XOR-matmuls (jax.numpy reference path).
+
+This is the TPU replacement for the reference's SIMD hot loop
+(`ec_encode_data`, /root/reference/src/erasure-code/isa/ErasureCodeIsa.cc:129;
+`region_xor`, isa/xor_op.cc): the (m, k) GF coding matrix is expanded once on
+host into an (8m, 8k) 0/1 bit-matrix (ceph_tpu.gf.bitslice) and applied to
+byte chunks as
+
+    planes  = bit-expand(data)          # (8k, L) 0/1, VPU shifts/masks
+    pbits   = (B @ planes) mod 2        # MXU matmul + parity reduction
+    parity  = bit-fold(pbits)           # (m, L) uint8
+
+The bit-matrix is a runtime *argument*, not a compiled constant, so one
+compiled kernel serves every erasure signature for a given (nerrs, k) shape —
+the device analog of the reference's LRU decode-table cache
+(isa/ErasureCodeIsaTableCache.h:48): recompilation happens per shape, table
+churn is just new operand bytes.
+
+Shapes: data is (k, L) or batched (B, k, L); L is the chunk length in bytes
+and maps onto the TPU lane dimension.  All dtypes uint8 in HBM; the 8x
+bit-plane expansion lives only in on-chip/intermediate form (XLA fuses the
+shift/mask producers into the matmul operand; the Pallas kernel in
+ceph_tpu.ops.pallas_gf keeps it entirely in VMEM).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIT_WEIGHTS = tuple(1 << b for b in range(8))
+
+
+def _expand_planes(data: jax.Array) -> jax.Array:
+    """(..., k, L) uint8 -> (..., 8k, L) 0/1 planes, LSB-first per byte."""
+    *lead, k, L = data.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8).reshape(8, 1)
+    planes = (data[..., :, None, :] >> shifts) & jnp.uint8(1)
+    return planes.reshape(*lead, 8 * k, L)
+
+
+def _fold_planes(planes: jax.Array) -> jax.Array:
+    """(..., 8m, L) parity bits (int) -> (..., m, L) uint8 bytes."""
+    *lead, m8, L = planes.shape
+    p = planes.reshape(*lead, m8 // 8, 8, L).astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8).reshape(8, 1)
+    return (p << shifts).sum(axis=-2, dtype=jnp.uint8)
+
+
+@jax.jit
+def xor_matmul(bit_matrix: jax.Array, data: jax.Array) -> jax.Array:
+    """Apply an (8m, 8k) GF(2) bit-matrix to (..., k, L) uint8 chunks.
+
+    Returns (..., m, L) uint8.  Accumulation runs in int32 on the MXU; the
+    mod-2 reduction keeps only the parity bit.  Exact for any k (sums are
+    bounded by 8k <= 2^31).
+    """
+    planes = _expand_planes(data).astype(jnp.int8)
+    bm = bit_matrix.astype(jnp.int8)
+    # (..., 8k, L) x (8m, 8k) -> (..., 8m, L)
+    acc = jnp.einsum(
+        "pq,...ql->...pl", bm, planes, preferred_element_type=jnp.int32
+    )
+    return _fold_planes(acc & 1)
+
+
+@jax.jit
+def xor_reduce(data: jax.Array) -> jax.Array:
+    """XOR-fold chunks: (..., k, L) uint8 -> (..., L) uint8.
+
+    Device analog of the reference's `region_xor` (isa/xor_op.cc) used for the
+    m == 1 parity and single-erasure fast paths (ErasureCodeIsa.cc:125-131,
+    :196-216).  Pure VPU work; XLA fuses the reduction tree.
+    """
+    return jax.lax.reduce(
+        data, jnp.uint8(0), jax.lax.bitwise_xor, dimensions=(data.ndim - 2,)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m"))
+def encode_full(bit_matrix: jax.Array, data: jax.Array, *, k: int, m: int) -> jax.Array:
+    """Encode: (..., k, L) data -> (..., k+m, L) all chunks (systematic)."""
+    parity = xor_matmul(bit_matrix, data)
+    return jnp.concatenate([data, parity], axis=-2)
+
+
+def as_device_bit_matrix(gf_matrix: np.ndarray) -> jax.Array:
+    """Expand an (m, k) GF matrix on host and place the bit-matrix on device."""
+    from ceph_tpu.gf.bitslice import expand_matrix
+
+    return jnp.asarray(expand_matrix(gf_matrix), dtype=jnp.uint8)

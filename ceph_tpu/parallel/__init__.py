@@ -1,0 +1,6 @@
+"""Pod-scale data distribution: mesh construction + sharded stripe pipelines."""
+
+from .mesh import make_mesh
+from .sharded import sharded_decode, sharded_encode, scrub_step
+
+__all__ = ["make_mesh", "sharded_encode", "sharded_decode", "scrub_step"]

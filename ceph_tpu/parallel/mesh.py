@@ -1,0 +1,48 @@
+"""Device mesh construction for stripe-parallel erasure coding.
+
+The TPU-native mapping of the reference's data-distribution layer (SURVEY.md
+§2.4): the stripe-batch axis plays the role PGs play (independent shards of
+work, data-parallel across the pod over ICI) and the intra-chunk byte axis is
+the "sequence" axis — GF coding is bytewise-independent, so chunk length can
+be split across devices with zero communication, the storage analog of
+sequence parallelism.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh
+
+STRIPE_AXIS = "stripe"  # data-parallel over stripe batches (PG analog)
+LANE_AXIS = "lane"  # intra-chunk byte-range parallelism (SP analog)
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    lane_parallelism: int | None = None,
+) -> Mesh:
+    """Build a (stripe, lane) 2-D mesh over the first n_devices.
+
+    lane_parallelism defaults to the largest power-of-two <= sqrt(n) that
+    divides n, keeping both axes useful without fragmenting either.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if lane_parallelism is None:
+        lane_parallelism = 1
+        while (
+            lane_parallelism * 2 <= math.isqrt(n)
+            and n % (lane_parallelism * 2) == 0
+        ):
+            lane_parallelism *= 2
+    assert n % lane_parallelism == 0
+    import numpy as np
+
+    grid = np.empty(n, dtype=object)
+    for i, d in enumerate(devices):
+        grid[i] = d
+    grid = grid.reshape(n // lane_parallelism, lane_parallelism)
+    return Mesh(grid, (STRIPE_AXIS, LANE_AXIS))

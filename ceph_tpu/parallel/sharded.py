@@ -1,0 +1,85 @@
+"""Sharded stripe-batch pipelines — pjit over a (stripe, lane) mesh.
+
+The bulk scrub/rebuild data path (SURVEY.md §7 step 6; BASELINE config
+"RS(10,4) batched encode, 64K stripes in flight"): stripe batches are sharded
+data-parallel over the mesh's `stripe` axis, chunk bytes over `lane` (GF
+coding is bytewise independent, so both axes need no communication for
+encode/decode).  Cross-device work appears only in verification/scrub
+reductions (psum over both axes) — those are the collectives that ride ICI,
+playing the role the reference's messenger fan-out plays for `ECSubWrite`
+(/root/reference/src/osd/ECBackend.cc:2071-2120).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ceph_tpu.ops.xor_mm import xor_matmul
+
+from .mesh import LANE_AXIS, STRIPE_AXIS
+
+
+def _stripe_sharding(mesh: Mesh) -> NamedSharding:
+    # (S, k, L): shard stripes over `stripe`, chunk bytes over `lane`.
+    return NamedSharding(mesh, P(STRIPE_AXIS, None, LANE_AXIS))
+
+
+def shard_batch(data: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a (S, k, L) stripe batch with stripe+lane sharding."""
+    return jax.device_put(data, _stripe_sharding(mesh))
+
+
+def sharded_encode(bit_matrix: jax.Array, data: jax.Array, mesh: Mesh) -> jax.Array:
+    """(S, k, L) uint8 -> (S, m, L) parity, fully sharded, no collectives.
+
+    XLA partitions the XOR-matmul per shard; each device encodes its own
+    stripe/lane tile — the embarrassingly-parallel layout that turns a pod
+    into one wide encoder for bulk rebuild.
+    """
+    fn = jax.jit(
+        xor_matmul,
+        in_shardings=(NamedSharding(mesh, P()), _stripe_sharding(mesh)),
+        out_shardings=_stripe_sharding(mesh),
+    )
+    return fn(bit_matrix, data)
+
+
+def sharded_decode(
+    decode_bit_matrix: jax.Array, survivors: jax.Array, mesh: Mesh
+) -> jax.Array:
+    """(S, k, L) survivors (decode_index order) -> (S, nerrs, L) rebuilt."""
+    return sharded_encode(decode_bit_matrix, survivors, mesh)
+
+
+def _scrub_impl(bit_matrix, chunks, k):
+    data = chunks[:, :k, :]
+    stored_parity = chunks[:, k:, :]
+    recomputed = xor_matmul(bit_matrix, data)
+    # Per-stripe mismatch flag, reduced over the lane axis automatically by
+    # XLA's partitioner (psum over lane shards under the hood).
+    mismatch = jnp.any(recomputed != stored_parity, axis=(1, 2))
+    return jnp.sum(mismatch.astype(jnp.int32)), mismatch
+
+
+def scrub_step(
+    bit_matrix: jax.Array, chunks: jax.Array, k: int, mesh: Mesh
+) -> tuple[jax.Array, jax.Array]:
+    """Deep-scrub analog: recompute parity for a (S, k+m, L) batch, compare.
+
+    Returns (total mismatching stripe count, per-stripe mismatch mask) — the
+    device-side equivalent of `ECBackend::be_deep_scrub` chunk verification
+    (/root/reference/src/osd/ECBackend.cc:2518), with the mismatch count
+    produced by cross-device reduction instead of primary-gathered maps.
+    """
+    sharding = NamedSharding(mesh, P(STRIPE_AXIS, None, LANE_AXIS))
+    fn = jax.jit(
+        functools.partial(_scrub_impl, k=k),
+        in_shardings=(NamedSharding(mesh, P()), sharding),
+        out_shardings=(NamedSharding(mesh, P()), NamedSharding(mesh, P(STRIPE_AXIS))),
+    )
+    return fn(bit_matrix, chunks)

@@ -1,0 +1,21 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Tests never assume real TPU hardware; sharding/collective paths are validated
+on `--xla_force_host_platform_device_count=8` exactly as the driver's
+multi-chip dry-run does.  The axon sitecustomize pre-registers the TPU
+platform before pytest starts, so overriding the platform must go through
+jax.config (env vars alone are too late / overridden).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,282 @@
+"""Codec-level tests — the tier-1 pattern from the reference test suite.
+
+Models /root/reference/src/test/erasure-code/TestErasureCodeIsa.cc: build the
+codec directly, encode a payload, verify chunk layout equals input slices
+(compare_chunks, :39-49), erase every combination, decode, compare (:51-90);
+plus registry failure-mode fixtures (TestErasureCodePlugin.cc).
+"""
+
+import itertools
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codec import (
+    CAUCHY,
+    VANDERMONDE,
+    EcError,
+    ErasureCodeTpuRs,
+)
+from ceph_tpu.codec import registry as reg_mod
+from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePluginRegistry
+from ceph_tpu.gf import gf_matmul, isa_cauchy_matrix, isa_rs_vandermonde_matrix
+
+
+def make_rs(k, m, technique=VANDERMONDE):
+    ec = ErasureCodeTpuRs(technique=technique)
+    ec.init({"k": str(k), "m": str(m)})
+    return ec
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n).astype(np.uint8).tobytes()
+
+
+class TestGeometry:
+    def test_chunk_size_alignment(self):
+        ec = make_rs(8, 3)
+        # ceil(obj/k) padded to ALIGNMENT (ErasureCodeIsa.cc:65-79).
+        assert ec.get_chunk_size(8 * 128) == 128
+        assert ec.get_chunk_size(8 * 128 + 1) == 256
+        assert ec.get_chunk_size(1) == 128
+        assert ec.get_chunk_count() == 11
+        assert ec.get_data_chunk_count() == 8
+        assert ec.get_coding_chunk_count() == 3
+        assert ec.get_sub_chunk_count() == 1
+
+    def test_defaults(self):
+        ec = ErasureCodeTpuRs()
+        ec.init({})
+        assert (ec.k, ec.m) == (7, 3)  # ErasureCodeIsa.cc:46-47
+
+    def test_vandermonde_envelope(self):
+        # ErasureCodeIsa.cc:331-361
+        with pytest.raises(EcError):
+            make_rs(33, 3)
+        with pytest.raises(EcError):
+            make_rs(8, 5)
+        with pytest.raises(EcError):
+            make_rs(22, 4)
+        make_rs(21, 4)
+        make_rs(32, 3)
+        # Cauchy has no envelope cap below k+m <= 256.
+        make_rs(33, 5, technique=CAUCHY)
+
+    def test_sanity_k_m(self):
+        with pytest.raises(EcError):
+            make_rs(1, 1)
+        with pytest.raises(EcError):
+            make_rs(4, 0)
+
+
+class TestEncodeDecode:
+    @pytest.mark.parametrize("technique", [VANDERMONDE, CAUCHY])
+    @pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 3), (6, 4)])
+    def test_roundtrip_all_erasures(self, k, m, technique):
+        if technique == VANDERMONDE and m == 4 and k > 21:
+            pytest.skip("outside envelope")
+        ec = make_rs(k, m, technique)
+        raw = payload(k * 128 + 17)  # force padding
+        want = set(range(k + m))
+        encoded = ec.encode(want, raw)
+        assert set(encoded) == want
+        chunk_size = ec.get_chunk_size(len(raw))
+        # Data chunks must equal the padded input slices (systematic layout,
+        # ErasureCodeInterface.h:39-58).
+        padded = np.zeros(k * chunk_size, dtype=np.uint8)
+        padded[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        for i in range(k):
+            assert np.array_equal(encoded[i], padded[i * chunk_size : (i + 1) * chunk_size])
+        # Every erasure combination up to m must decode byte-identically.
+        for nerr in range(1, m + 1):
+            for erasures in itertools.combinations(range(k + m), nerr):
+                avail = {i: encoded[i] for i in range(k + m) if i not in erasures}
+                decoded = ec.decode(set(erasures), avail)
+                for e in erasures:
+                    assert np.array_equal(decoded[e], encoded[e]), (erasures, e)
+
+    def test_decode_concat_roundtrip(self):
+        ec = make_rs(5, 3)
+        raw = payload(5 * 256 + 99, seed=7)
+        encoded = ec.encode(set(range(8)), raw)
+        avail = {i: encoded[i] for i in (0, 2, 3, 4, 6)}  # drop 1, 5, 7
+        out = ec.decode_concat(avail)
+        assert out[: len(raw)].tobytes() == raw
+
+    def test_parity_matches_gf_matmul(self):
+        """Encode output must equal the plain GF(2^8) matrix product — the
+        host-math oracle for byte-parity with ISA-L."""
+        for technique, gen in [
+            (VANDERMONDE, isa_rs_vandermonde_matrix),
+            (CAUCHY, isa_cauchy_matrix),
+        ]:
+            k, m = 8, 3
+            ec = make_rs(k, m, technique)
+            raw = payload(k * 128, seed=3)
+            encoded = ec.encode(set(range(k + m)), raw)
+            data = np.stack([encoded[i] for i in range(k)])
+            expect = gf_matmul(gen(k, m)[k:], data)
+            for i in range(m):
+                assert np.array_equal(encoded[k + i], expect[i])
+
+    @pytest.mark.parametrize("technique", [VANDERMONDE, CAUCHY])
+    def test_m1_xor_parity(self, technique):
+        # m==1 is a pure XOR regardless of technique (ErasureCodeIsa.cc:125-127).
+        ec = make_rs(4, 1, technique)
+        raw = payload(4 * 128, seed=5)
+        encoded = ec.encode(set(range(5)), raw)
+        expect = np.bitwise_xor.reduce(np.stack([encoded[i] for i in range(4)]), axis=0)
+        assert np.array_equal(encoded[4], expect)
+
+    @pytest.mark.parametrize("technique", [VANDERMONDE, CAUCHY])
+    def test_m1_device_decode_consistent(self, technique):
+        # Regression: decode_array must agree with the XOR-encoded parity for
+        # m==1 (the bulk/sharded device path, not just the chunk fast path).
+        ec = make_rs(4, 1, technique)
+        raw = payload(4 * 128, seed=6)
+        encoded = ec.encode(set(range(5)), raw)
+        erasures = [0]
+        idx = ec.decode_index(erasures)
+        survivors = np.stack([encoded[i] for i in idx])
+        rec = np.asarray(ec.decode_array(erasures, survivors))
+        assert np.array_equal(rec[0], encoded[0])
+
+    def test_too_many_erasures(self):
+        ec = make_rs(4, 2)
+        raw = payload(4 * 128)
+        encoded = ec.encode(set(range(6)), raw)
+        avail = {i: encoded[i] for i in (0, 1, 2)}  # 3 erasures > m=2
+        with pytest.raises(EcError):
+            ec.decode({3, 4, 5}, avail)
+
+    def test_minimum_to_decode(self):
+        ec = make_rs(4, 2)
+        # want subset of available -> want itself
+        got = ec.minimum_to_decode({0, 1}, {0, 1, 2, 3})
+        assert set(got) == {0, 1}
+        assert got[0] == [(0, 1)]
+        # missing chunk -> first k available
+        got = ec.minimum_to_decode({0}, {1, 2, 3, 4, 5})
+        assert set(got) == {1, 2, 3, 4}
+        with pytest.raises(EcError):
+            ec.minimum_to_decode({0}, {1, 2, 3})
+
+
+class TestChunkMapping:
+    def test_mapping_remaps_positions(self):
+        # mapping=_DDDD puts a coding chunk at position 0
+        # (ErasureCode.cc:260-279).
+        ec = ErasureCodeTpuRs()
+        ec.init({"k": "4", "m": "1", "mapping": "_DDDD"})
+        assert ec.get_chunk_mapping() == [1, 2, 3, 4, 0]
+        raw = payload(4 * 128, seed=11)
+        encoded = ec.encode(set(range(5)), raw)
+        # Data lives at positions 1..4; parity at 0.
+        data = np.frombuffer(raw, dtype=np.uint8).reshape(4, 128)
+        for i in range(4):
+            assert np.array_equal(encoded[i + 1], data[i])
+        expect = np.bitwise_xor.reduce(data, axis=0)
+        assert np.array_equal(encoded[0], expect)
+        out = ec.decode_concat({i: encoded[i] for i in (0, 2, 3, 4)})
+        assert out.tobytes() == raw
+
+
+class TestRegistry:
+    def fresh_registry(self):
+        return ErasureCodePluginRegistry()
+
+    def test_factory_roundtrip(self):
+        r = self.fresh_registry()
+        profile = {"k": "4", "m": "2"}
+        ec = r.factory("tpu", profile)
+        assert ec.get_chunk_count() == 6
+        assert ec.get_profile() == profile
+        assert ec.get_profile() is not profile  # codec owns its copy
+
+    def test_xor_plugin(self):
+        r = self.fresh_registry()
+        ec = r.factory("xor", {"k": "3"})
+        raw = payload(3 * 128)
+        encoded = ec.encode(set(range(4)), raw)
+        decoded = ec.decode({1}, {i: encoded[i] for i in (0, 2, 3)})
+        assert np.array_equal(decoded[1], encoded[1])
+
+    def test_xor_plugin_with_mapping(self):
+        # Regression: mapping-aware positions in the example plugin.
+        r = self.fresh_registry()
+        ec = r.factory("xor", {"k": "2", "mapping": "_DD"})
+        raw = payload(2 * 128, seed=9)
+        encoded = ec.encode(set(range(3)), raw)
+        data = np.frombuffer(raw, dtype=np.uint8).reshape(2, 128)
+        assert np.array_equal(encoded[1], data[0])
+        assert np.array_equal(encoded[2], data[1])
+        assert np.array_equal(encoded[0], data[0] ^ data[1])
+        out = ec.decode_concat({0: encoded[0], 2: encoded[2]})
+        assert out.tobytes() == raw
+
+    def test_unknown_plugin(self):
+        r = self.fresh_registry()
+        with pytest.raises(EcError) as ei:
+            r.load("doesnotexist")
+        assert ei.value.errno == -2  # ENOENT
+
+    def _fake_plugin(self, name, **attrs):
+        mod = types.ModuleType(f"{reg_mod.PLUGIN_PACKAGE}.{name}")
+        for key, val in attrs.items():
+            setattr(mod, key, val)
+        sys.modules[mod.__name__] = mod
+        return mod
+
+    def test_missing_version(self):
+        # ErasureCodePluginMissingVersion.cc analog.
+        self._fake_plugin("noversion", __erasure_code_init__=lambda r: None)
+        r = self.fresh_registry()
+        with pytest.raises(EcError) as ei:
+            r.load("noversion")
+        assert ei.value.errno == -18  # EXDEV
+
+    def test_bad_version(self):
+        self._fake_plugin(
+            "badversion",
+            __erasure_code_version__="bogus-0",
+            __erasure_code_init__=lambda r: None,
+        )
+        r = self.fresh_registry()
+        with pytest.raises(EcError) as ei:
+            r.load("badversion")
+        assert ei.value.errno == -18
+
+    def test_missing_entry_point(self):
+        # ErasureCodePluginMissingEntryPoint.cc analog.
+        self._fake_plugin("noentry", __erasure_code_version__=EC_VERSION)
+        r = self.fresh_registry()
+        with pytest.raises(EcError) as ei:
+            r.load("noentry")
+        assert ei.value.errno == -2
+
+    def test_init_without_register(self):
+        # ErasureCodePluginFailToRegister.cc analog.
+        self._fake_plugin(
+            "noregister",
+            __erasure_code_version__=EC_VERSION,
+            __erasure_code_init__=lambda r: None,
+        )
+        r = self.fresh_registry()
+        with pytest.raises(EcError) as ei:
+            r.load("noregister")
+        assert ei.value.errno == -18
+
+    def test_duplicate_add(self):
+        r = self.fresh_registry()
+        r.load("xor")
+        with pytest.raises(EcError) as ei:
+            r.load("xor2_dup") if False else r.add("xor", r.get("xor"))
+        assert ei.value.errno == -17  # EEXIST
+
+    def test_preload(self):
+        r = self.fresh_registry()
+        r.preload("tpu,xor")
+        assert r.get("tpu") is not None
+        assert r.get("xor") is not None

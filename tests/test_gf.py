@@ -1,0 +1,195 @@
+"""GF(2^8) core validation — field axioms, table integrity, matrix math.
+
+Mirrors the reference's tier-1 strategy (SURVEY.md §4): validate the math from
+first principles before any codec builds on it.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import (
+    GF_MUL_TABLE,
+    bitslice_bytes,
+    coeff_bitmatrix,
+    expand_matrix,
+    gf_inv,
+    gf_invert_matrix,
+    gf_matmul,
+    gf_mul,
+    gf_mul_slow,
+    gf_pow,
+    identity,
+    isa_cauchy_matrix,
+    isa_decode_matrix,
+    isa_rs_vandermonde_matrix,
+    jerasure_cauchy_good_matrix,
+    jerasure_cauchy_orig_matrix,
+    jerasure_r6_matrix,
+    jerasure_vandermonde_matrix,
+    unbitslice_bytes,
+    vandermonde_mds_check,
+    xor_matmul_host,
+)
+
+
+def test_mul_table_matches_first_principles():
+    # Full 256x256 check against carry-less multiply mod 0x11d.
+    for a in range(0, 256, 7):
+        for b in range(256):
+            assert GF_MUL_TABLE[a, b] == gf_mul_slow(a, b)
+    # Spot the full diagonal and first/last rows exactly.
+    for a in range(256):
+        assert GF_MUL_TABLE[a, a] == gf_mul_slow(a, a)
+        assert GF_MUL_TABLE[0, a] == 0
+        assert GF_MUL_TABLE[255, a] == gf_mul_slow(255, a)
+
+
+def test_field_axioms():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf_mul(a, b) == gf_mul(b, a)
+        assert gf_mul(a, gf_mul(b, c)) == gf_mul(gf_mul(a, b), c)
+        assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+        assert gf_mul(a, 1) == a
+    for a in range(1, 256):
+        assert gf_mul(a, gf_inv(a)) == 1
+
+
+def test_gf_pow():
+    for a in (1, 2, 3, 0x53):
+        acc = 1
+        for n in range(10):
+            assert gf_pow(a, n) == acc
+            acc = gf_mul(acc, a)
+
+
+def test_matrix_inverse_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (2, 4, 8, 16):
+        for _ in range(5):
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            inv = gf_invert_matrix(m)
+            if inv is None:
+                continue  # singular draw
+            assert np.array_equal(gf_matmul(m, inv), identity(n))
+            assert np.array_equal(gf_matmul(inv, m), identity(n))
+
+
+def test_singular_matrix_returns_none():
+    m = np.zeros((3, 3), dtype=np.uint8)
+    m[0] = [1, 2, 3]
+    m[1] = [2, 4, 6]  # 2 * row0 in GF => dependent
+    m[1] = GF_MUL_TABLE[2, m[0]]
+    m[2] = [5, 6, 7]
+    assert gf_invert_matrix(m) is None
+
+
+def test_isa_vandermonde_structure():
+    a = isa_rs_vandermonde_matrix(8, 3)
+    assert np.array_equal(a[:8], identity(8))
+    # Parity row 0 all ones; row i is powers of 2^i.
+    assert (a[8] == 1).all()
+    for i in range(3):
+        g = gf_pow(2, i)
+        expect = [gf_pow(g, j) for j in range(8)]
+        assert list(a[8 + i]) == expect
+
+
+def test_isa_cauchy_structure():
+    k, m = 8, 3
+    a = isa_cauchy_matrix(k, m)
+    assert np.array_equal(a[:k], identity(k))
+    for i in range(k, k + m):
+        for j in range(k):
+            assert gf_mul(int(a[i, j]), i ^ j) == 1
+
+
+def test_isa_cauchy_always_mds():
+    for k, m in [(4, 2), (6, 3), (8, 3), (5, 4)]:
+        assert vandermonde_mds_check(k, m, isa_cauchy_matrix(k, m))
+
+
+def test_isa_vandermonde_mds_envelope():
+    # Inside the reference's safety envelope these must be MDS
+    # (ErasureCodeIsa.cc:331-361).
+    for k, m in [(4, 2), (8, 3), (10, 3), (6, 4)]:
+        assert vandermonde_mds_check(k, m, isa_rs_vandermonde_matrix(k, m))
+
+
+def test_jerasure_vandermonde_systematic_mds():
+    for k, m in [(4, 2), (7, 3), (8, 3), (10, 4)]:
+        a = jerasure_vandermonde_matrix(k, m)
+        assert np.array_equal(a[:k], identity(k))
+        assert (a[k] == 1).all()  # first parity row all ones
+        assert vandermonde_mds_check(k, m, a)
+
+
+def test_jerasure_r6():
+    a = jerasure_r6_matrix(6)
+    assert (a[6] == 1).all()
+    assert list(a[7]) == [gf_pow(2, j) for j in range(6)]
+    assert vandermonde_mds_check(6, 2, a)
+
+
+def test_jerasure_cauchy():
+    for k, m in [(4, 2), (8, 3)]:
+        orig = jerasure_cauchy_orig_matrix(k, m)
+        good = jerasure_cauchy_good_matrix(k, m)
+        for a in (orig, good):
+            assert np.array_equal(a[:k], identity(k))
+            assert vandermonde_mds_check(k, m, a)
+        assert (good[k] == 1).all()
+        # cauchy_good must not be heavier than cauchy_orig in bit-matrix ones.
+        assert expand_matrix(good[k:]).sum() <= expand_matrix(orig[k:]).sum()
+
+
+def test_isa_decode_matrix_reconstructs():
+    k, m = 8, 3
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (k, 64)).astype(np.uint8)
+    for mat in (isa_rs_vandermonde_matrix(k, m), isa_cauchy_matrix(k, m)):
+        full = gf_matmul(mat, data)  # (k+m, L) all chunks
+        for nerr in (1, 2, 3):
+            for erasures in itertools.combinations(range(k + m), nerr):
+                res = isa_decode_matrix(mat, list(erasures), k)
+                assert res is not None
+                c, decode_index = res
+                survivors = full[decode_index, :]
+                rec = gf_matmul(c, survivors)
+                for p, e in enumerate(erasures):
+                    assert np.array_equal(rec[p], full[e]), (erasures, e)
+
+
+# ---------------------------------------------------------------------------
+# Bitslicing
+# ---------------------------------------------------------------------------
+
+def test_coeff_bitmatrix_is_multiplication():
+    rng = np.random.default_rng(3)
+    for c in [0, 1, 2, 3, 0x1D, 0x8E, 255]:
+        mc = coeff_bitmatrix(c)
+        for x in rng.integers(0, 256, 32):
+            x = int(x)
+            bits = (x >> np.arange(8)) & 1
+            out_bits = (mc.astype(int) @ bits) & 1
+            y = int((out_bits << np.arange(8)).sum())
+            assert y == gf_mul(c, x)
+
+
+def test_bitslice_roundtrip():
+    rng = np.random.default_rng(4)
+    d = rng.integers(0, 256, (5, 37)).astype(np.uint8)
+    assert np.array_equal(unbitslice_bytes(bitslice_bytes(d)), d)
+
+
+def test_xor_matmul_host_equals_gf_matmul():
+    rng = np.random.default_rng(5)
+    for k, m in [(4, 2), (8, 3), (10, 4)]:
+        mat = isa_cauchy_matrix(k, m)[k:]  # (m, k) parity rows
+        data = rng.integers(0, 256, (k, 128)).astype(np.uint8)
+        want = gf_matmul(mat, data)
+        got = xor_matmul_host(expand_matrix(mat), data)
+        assert np.array_equal(want, got)
