@@ -1,0 +1,217 @@
+"""Generic GF(2^8) matrix-codec machinery shared by every matrix technique.
+
+Any systematic code defined by a (k+m, k) distribution matrix (RS, Cauchy,
+jerasure variants, SHEC, LRC layers) gets its chunk-level and device-level
+paths from this mixin; concrete codecs supply geometry + `build_matrix()`.
+
+Caching mirrors the reference's two-level table cache
+(/root/reference/src/erasure-code/isa/ErasureCodeIsaTableCache.{h,cc}):
+encode plans per matrix, decode plans in a signature-keyed LRU (capacity 2516,
+"sufficient up to (12,4)", ErasureCodeIsaTableCache.h:48) — but a cached
+"table" here is a device bit-matrix operand for the shared XOR-matmul kernel,
+so any erasure pattern reuses one compiled kernel per shape.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.gf import expand_matrix, isa_decode_matrix
+from ceph_tpu.ops.xor_mm import xor_matmul, xor_reduce
+
+from .base import EIO
+from .interface import EcError
+
+DECODE_LRU_CAPACITY = 2516
+
+
+class _GlobalPlanCache:
+    """Process-wide encode/decode plan cache keyed by matrix content."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._encode: dict[bytes, jnp.ndarray] = {}
+        self._decode: OrderedDict[tuple[bytes, str], tuple[jnp.ndarray, list[int]]] = (
+            OrderedDict()
+        )
+
+    def encode_bit_matrix(self, coding_rows: np.ndarray) -> jnp.ndarray:
+        """Per-geometry encode matrices: one entry per codec instance's
+        matrix, unbounded like the reference's per-(k,m) encode tables."""
+        key = coding_rows.tobytes()
+        with self._lock:
+            bm = self._encode.get(key)
+        if bm is not None:
+            return bm
+        bm = jnp.asarray(expand_matrix(coding_rows), dtype=jnp.uint8)
+        with self._lock:
+            self._encode.setdefault(key, bm)
+            return self._encode[key]
+
+    def lru_bit_matrix(self, matrix: np.ndarray) -> jnp.ndarray:
+        """Bit-matrix for a decode-time matrix, bounded by the decode LRU.
+
+        For codecs whose decode matrices vary per erasure pattern but don't
+        go through decode_plan (SHEC's searched inverses) — stored alongside
+        the signature-keyed plans so total decode-table memory stays within
+        DECODE_LRU_CAPACITY, as the reference's cache guarantees.
+        """
+        key = (matrix.tobytes(), "#raw")
+        with self._lock:
+            cached = self._decode.get(key)
+            if cached is not None:
+                self._decode.move_to_end(key)
+                return cached[0]
+        bm = jnp.asarray(expand_matrix(matrix), dtype=jnp.uint8)
+        with self._lock:
+            self._decode[key] = (bm, [])
+            self._decode.move_to_end(key)
+            while len(self._decode) > DECODE_LRU_CAPACITY:
+                self._decode.popitem(last=False)
+        return bm
+
+    def decode_plan(
+        self, dist_matrix: np.ndarray, erasures: list[int], k: int
+    ) -> tuple[jnp.ndarray, list[int]]:
+        km = dist_matrix.shape[0]
+        erased = set(erasures)
+        decode_index: list[int] = []
+        r = 0
+        for _ in range(k):
+            while r in erased:
+                r += 1
+            if r >= km:
+                raise EcError(EIO, f"not enough survivors for erasures {erasures}")
+            decode_index.append(r)
+            r += 1
+        # Reference signature format, ErasureCodeIsa.cc:233-248.
+        sig = "".join(f"+{r}" for r in decode_index) + "".join(
+            f"-{e}" for e in erasures
+        )
+        key = (dist_matrix.tobytes(), sig)
+        with self._lock:
+            cached = self._decode.get(key)
+            if cached is not None:
+                self._decode.move_to_end(key)
+                return cached
+        plan = isa_decode_matrix(dist_matrix, erasures, k)
+        if plan is None:
+            raise EcError(EIO, f"singular decode matrix for erasures {erasures}")
+        c, decode_index = plan
+        bitmat = jnp.asarray(expand_matrix(c), dtype=jnp.uint8)
+        with self._lock:
+            self._decode[key] = (bitmat, decode_index)
+            self._decode.move_to_end(key)
+            while len(self._decode) > DECODE_LRU_CAPACITY:
+                self._decode.popitem(last=False)
+        return bitmat, decode_index
+
+
+PLAN_CACHE = _GlobalPlanCache()
+
+
+class MatrixCodecMixin:
+    """Chunk-level + device-level coding for matrix-defined codecs.
+
+    Host contract: the concrete class provides `self.k`, `self.m`,
+    `chunk_index()` (from ErasureCode) and `build_matrix() -> (k+m, k)`
+    systematic uint8 distribution matrix.
+    """
+
+    _dist_matrix: np.ndarray | None = None
+
+    def build_matrix(self) -> np.ndarray:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def invalidate_matrix(self) -> None:
+        """Drop the cached distribution matrix; call on (re)parse so a
+        second init() with new geometry cannot serve the stale matrix."""
+        self._dist_matrix = None
+
+    def distribution_matrix(self) -> np.ndarray:
+        if self._dist_matrix is None:
+            mat = np.asarray(self.build_matrix(), dtype=np.uint8)
+            k, m = self.k, self.m
+            assert mat.shape == (k + m, k), mat.shape
+            assert np.array_equal(mat[:k], np.eye(k, dtype=np.uint8)), (
+                "distribution matrix must be systematic"
+            )
+            self._dist_matrix = mat
+        return self._dist_matrix
+
+    def _xor_row_available(self) -> bool:
+        """True when parity row 0 is all ones (enables XOR fast paths)."""
+        mat = self.distribution_matrix()
+        return bool((mat[self.k] == 1).all())
+
+    # -- device-native bulk paths ------------------------------------------
+
+    def encode_array(self, data) -> jnp.ndarray:
+        """(..., k, L) uint8 -> (..., m, L) parity, stays on device."""
+        mat = self.distribution_matrix()
+        if self.m == 1 and self._xor_row_available():
+            return xor_reduce(jnp.asarray(data))[..., None, :]
+        bm = PLAN_CACHE.encode_bit_matrix(mat[self.k :])
+        return xor_matmul(bm, jnp.asarray(data))
+
+    def decode_array(self, erasures: list[int], survivors) -> jnp.ndarray:
+        """survivors (..., k, L) in decode_index order -> (..., nerrs, L)."""
+        bm, _ = PLAN_CACHE.decode_plan(self.distribution_matrix(), erasures, self.k)
+        return xor_matmul(bm, jnp.asarray(survivors))
+
+    def decode_index(self, erasures: list[int]) -> list[int]:
+        _, idx = PLAN_CACHE.decode_plan(self.distribution_matrix(), erasures, self.k)
+        return idx
+
+    # -- chunk-level interface ---------------------------------------------
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        k, m = self.k, self.m
+        data = np.stack(
+            [np.asarray(chunks[self.chunk_index(i)], dtype=np.uint8) for i in range(k)]
+        )
+        parity = np.asarray(self.encode_array(data))
+        for i in range(m):
+            np.copyto(chunks[self.chunk_index(k + i)], parity[i])
+
+    def _use_xor_decode(self, erasures: list[int]) -> bool:
+        """Single-erasure XOR path: first k+1 chunks + all-ones parity row 0
+        (generalizes ErasureCodeIsa.cc:196-216)."""
+        return (
+            len(erasures) == 1
+            and erasures[0] < self.k + 1
+            and self._xor_row_available()
+        )
+
+    def decode_chunks(
+        self,
+        want_to_read: set[int],
+        chunks: Mapping[int, np.ndarray],
+        decoded: dict[int, np.ndarray],
+    ) -> None:
+        k, m = self.k, self.m
+        raw_of = self.chunk_index
+        erasures = [i for i in range(k + m) if raw_of(i) not in chunks]
+        if not erasures:
+            return
+        if len(erasures) > m:
+            raise EcError(EIO, f"{len(erasures)} erasures > m={m}")
+        if self._use_xor_decode(erasures):
+            sources = [i for i in range(k + m) if raw_of(i) in chunks][:k]
+            stack = np.stack(
+                [np.asarray(decoded[raw_of(i)], dtype=np.uint8) for i in sources]
+            )
+            np.copyto(decoded[raw_of(erasures[0])], np.asarray(xor_reduce(stack)))
+            return
+        idx = self.decode_index(erasures)
+        survivors = np.stack(
+            [np.asarray(decoded[raw_of(i)], dtype=np.uint8) for i in idx]
+        )
+        rec = np.asarray(self.decode_array(erasures, survivors))
+        for p, e in enumerate(erasures):
+            np.copyto(decoded[raw_of(e)], rec[p])

@@ -1,0 +1,20 @@
+"""The `clay` plugin — coupled-layer MSR regenerating codes.
+
+Plugin shell analog of /root/reference/src/erasure-code/clay/
+ErasureCodePluginClay.cc.
+"""
+
+from ceph_tpu.codec.clay import ErasureCodeClay
+from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePlugin
+
+__erasure_code_version__ = EC_VERSION
+
+
+def _factory(profile):
+    ec = ErasureCodeClay()
+    ec.init(profile)
+    return ec
+
+
+def __erasure_code_init__(registry):
+    registry.add("clay", ErasureCodePlugin("clay", _factory))

@@ -1,0 +1,22 @@
+"""The `jerasure` plugin — jerasure-compatible techniques on TPU kernels.
+
+Plugin shell analog of /root/reference/src/erasure-code/jerasure/
+ErasureCodePluginJerasure.cc: technique selection via the `technique` profile
+key (default reed_sol_van).
+"""
+
+from ceph_tpu.codec.jerasure import ErasureCodeJerasure
+from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePlugin
+
+__erasure_code_version__ = EC_VERSION
+
+
+def _factory(profile):
+    technique = profile.get("technique") or "reed_sol_van"
+    ec = ErasureCodeJerasure(technique=technique)
+    ec.init(profile)
+    return ec
+
+
+def __erasure_code_init__(registry):
+    registry.add("jerasure", ErasureCodePlugin("jerasure", _factory))

@@ -1,0 +1,20 @@
+"""The `lrc` plugin — layered locally-repairable codes.
+
+Plugin shell analog of /root/reference/src/erasure-code/lrc/
+ErasureCodePluginLrc.cc.
+"""
+
+from ceph_tpu.codec.lrc import ErasureCodeLrc
+from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePlugin
+
+__erasure_code_version__ = EC_VERSION
+
+
+def _factory(profile):
+    ec = ErasureCodeLrc()
+    ec.init(profile)
+    return ec
+
+
+def __erasure_code_init__(registry):
+    registry.add("lrc", ErasureCodePlugin("lrc", _factory))

@@ -1,0 +1,21 @@
+"""The `shec` plugin — shingled erasure codes.
+
+Plugin shell analog of /root/reference/src/erasure-code/shec/
+ErasureCodePluginShec.cc: technique single|multiple, default multiple (:45-52).
+"""
+
+from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePlugin
+from ceph_tpu.codec.shec import MULTIPLE, ErasureCodeShec
+
+__erasure_code_version__ = EC_VERSION
+
+
+def _factory(profile):
+    technique = profile.get("technique") or MULTIPLE
+    ec = ErasureCodeShec(technique=technique)
+    ec.init(profile)
+    return ec
+
+
+def __erasure_code_init__(registry):
+    registry.add("shec", ErasureCodePlugin("shec", _factory))

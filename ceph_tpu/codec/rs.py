@@ -7,125 +7,31 @@ contract — techniques `reed_sol_van` (Vandermonde, default) and `cauchy`
 (ErasureCodeIsa.cc:331-361), XOR fast paths for m==1 and single erasures
 (:125-131, :196-216), LRU-cached decode plans keyed by the same
 "+survivor...-erasure..." signature strings (:227-303) — but the hot loop is a
-bitsliced XOR-matmul on the TPU (ceph_tpu.ops.xor_mm) instead of AVX table
-lookups, and the "decode table cache" caches device bit-matrices (operands),
-not code: one compiled kernel per shape serves every erasure pattern.
+bitsliced XOR-matmul on the TPU (ceph_tpu.ops) instead of AVX table lookups;
+the shared machinery lives in MatrixCodecMixin.
 
 Byte parity: chunks produced here are byte-identical to the reference `isa`
 plugin's because the distribution matrices reproduce ISA-L's
-gf_gen_rs_matrix/gf_gen_cauchy1_matrix over the same field (gf/matrix.py) and
-decode inverts the identical survivor submatrix.
+gf_gen_rs_matrix/gf_gen_cauchy1_matrix over the same field (gf/matrix.py),
+m==1 encodes as the same pure XOR, and decode inverts the identical survivor
+submatrix.
 """
 
 from __future__ import annotations
 
-import threading
-from collections import OrderedDict
-from typing import Mapping
-
-import jax.numpy as jnp
 import numpy as np
 
-from ceph_tpu.gf import (
-    expand_matrix,
-    isa_cauchy_matrix,
-    isa_decode_matrix,
-    isa_rs_vandermonde_matrix,
-)
-from ceph_tpu.ops.xor_mm import xor_matmul, xor_reduce
+from ceph_tpu.gf import isa_cauchy_matrix, isa_rs_vandermonde_matrix
 
-from .base import EINVAL, EIO, ErasureCode
+from .base import EINVAL, ErasureCode
 from .interface import EcError, Profile
+from .matrix_codec import MatrixCodecMixin
 
 VANDERMONDE = "reed_sol_van"
 CAUCHY = "cauchy"
 
-# Reference LRU capacity: "sufficient up to (12,4)"
-# (isa/ErasureCodeIsaTableCache.h:48).
-DECODE_LRU_CAPACITY = 2516
 
-
-class _PlanCache:
-    """Per-(technique, k, m) encode plans + LRU of decode plans.
-
-    The analog of `ErasureCodeIsaTableCache` (isa/ErasureCodeIsaTableCache.cc):
-    encode coefficients/tables computed once per geometry; decode tables LRU'd
-    by erasure signature.  Here a "table" is the GF(2) bit-matrix living on
-    device, ready to be fed to the shared xor_matmul kernel.
-    """
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._encode: dict[tuple[str, int, int], tuple[np.ndarray, jnp.ndarray]] = {}
-        self._decode: OrderedDict[tuple[str, int, int, str], tuple[jnp.ndarray, list[int]]] = OrderedDict()
-
-    def encode_plan(self, technique: str, k: int, m: int) -> tuple[np.ndarray, jnp.ndarray]:
-        """(distribution matrix (k+m, k) uint8, device parity bit-matrix)."""
-        key = (technique, k, m)
-        with self._lock:
-            plan = self._encode.get(key)
-        if plan is not None:
-            return plan
-        if technique == VANDERMONDE:
-            coeff = isa_rs_vandermonde_matrix(k, m)
-        else:
-            coeff = isa_cauchy_matrix(k, m)
-        if m == 1:
-            # The reference encodes m==1 as a pure region XOR regardless of
-            # technique (ErasureCodeIsa.cc:125-127), so the parity actually
-            # stored is the all-ones row; the distribution matrix must say so
-            # or decode-by-inversion would disagree with the stored parity.
-            coeff[k:] = 1
-        bitmat = jnp.asarray(expand_matrix(coeff[k:]), dtype=jnp.uint8)
-        with self._lock:
-            self._encode.setdefault(key, (coeff, bitmat))
-            return self._encode[key]
-
-    def decode_plan(
-        self, technique: str, k: int, m: int, erasures: list[int]
-    ) -> tuple[jnp.ndarray, list[int]]:
-        """(device decode bit-matrix (8*nerrs, 8k), decode_index survivors).
-
-        Signature format mirrors ErasureCodeIsa.cc:233-248 ("+r" per survivor
-        row then "-e" per erasure); like the reference, the cache is consulted
-        *before* the O(k^3) matrix inversion so steady-state rebuilds skip it.
-        """
-        # decode_index = first k surviving rows (ErasureCodeIsa.cc:233-242).
-        erased = set(erasures)
-        decode_index: list[int] = []
-        r = 0
-        for _ in range(k):
-            while r in erased:
-                r += 1
-            if r >= k + m:
-                raise EcError(EIO, f"not enough survivors for erasures {erasures}")
-            decode_index.append(r)
-            r += 1
-        sig = "".join(f"+{r}" for r in decode_index) + "".join(f"-{e}" for e in erasures)
-        key = (technique, k, m, sig)
-        with self._lock:
-            cached = self._decode.get(key)
-            if cached is not None:
-                self._decode.move_to_end(key)
-                return cached
-        coeff, _ = self.encode_plan(technique, k, m)
-        plan = isa_decode_matrix(coeff, erasures, k)
-        if plan is None:
-            raise EcError(EIO, f"singular decode matrix for erasures {erasures}")
-        c, decode_index = plan
-        bitmat = jnp.asarray(expand_matrix(c), dtype=jnp.uint8)
-        with self._lock:
-            self._decode[key] = (bitmat, decode_index)
-            self._decode.move_to_end(key)
-            while len(self._decode) > DECODE_LRU_CAPACITY:
-                self._decode.popitem(last=False)
-        return bitmat, decode_index
-
-
-_CACHE = _PlanCache()
-
-
-class ErasureCodeTpuRs(ErasureCode):
+class ErasureCodeTpuRs(MatrixCodecMixin, ErasureCode):
     """RS(k, m) over GF(2^8), ISA-L-compatible, bitsliced on TPU."""
 
     DEFAULT_K = "7"  # ErasureCodeIsa.cc:46
@@ -143,6 +49,7 @@ class ErasureCodeTpuRs(ErasureCode):
 
     def parse(self, profile: Profile) -> None:
         super().parse(profile)
+        self.invalidate_matrix()
         self.k = self.to_int("k", profile, self.DEFAULT_K)
         self.m = self.to_int("m", profile, self.DEFAULT_M)
         self.sanity_check_k_m(self.k, self.m)
@@ -158,91 +65,26 @@ class ErasureCodeTpuRs(ErasureCode):
     def init(self, profile: Profile) -> None:
         self.parse(profile)
         # Warm the encode plan (reference `prepare()`, ErasureCodeIsa.cc:369).
-        _CACHE.encode_plan(self.technique, self.k, self.m)
+        self.distribution_matrix()
         self._profile = dict(profile)
 
-    # -- geometry -----------------------------------------------------------
+    # -- geometry / matrix --------------------------------------------------
+
+    def build_matrix(self) -> np.ndarray:
+        if self.technique == VANDERMONDE:
+            coeff = isa_rs_vandermonde_matrix(self.k, self.m)
+        else:
+            coeff = isa_cauchy_matrix(self.k, self.m)
+        if self.m == 1:
+            # The reference encodes m==1 as a pure region XOR regardless of
+            # technique (ErasureCodeIsa.cc:125-127), so the parity actually
+            # stored is the all-ones row; the distribution matrix must say so
+            # or decode-by-inversion would disagree with the stored parity.
+            coeff[self.k :] = 1
+        return coeff
 
     def get_chunk_count(self) -> int:
         return self.k + self.m
 
     def get_data_chunk_count(self) -> int:
         return self.k
-
-    # -- device paths -------------------------------------------------------
-
-    def encode_array(self, data) -> jnp.ndarray:
-        """Device-native encode: (..., k, L) uint8 -> (..., m, L) parity.
-
-        Stays on device; the batched bulk path the benchmark and the sharded
-        scrub/rebuild pipeline use (no host round-trip per stripe — this is
-        what replaces the reference's per-stripe loop at ECUtil.cc:139).
-        """
-        _, bitmat = _CACHE.encode_plan(self.technique, self.k, self.m)
-        if self.m == 1:
-            return xor_reduce(jnp.asarray(data))[..., None, :]
-        return xor_matmul(bitmat, jnp.asarray(data))
-
-    def decode_array(self, erasures: list[int], survivors) -> jnp.ndarray:
-        """Device-native decode: survivors (..., k, L) in decode_index order
-        -> (..., nerrs, L) reconstructed chunks (erasures order)."""
-        bitmat, _ = _CACHE.decode_plan(self.technique, self.k, self.m, erasures)
-        return xor_matmul(bitmat, jnp.asarray(survivors))
-
-    def decode_index(self, erasures: list[int]) -> list[int]:
-        """First-k-survivors order used by decode_array (ErasureCodeIsa.cc:233)."""
-        _, idx = _CACHE.decode_plan(self.technique, self.k, self.m, erasures)
-        return idx
-
-    # -- chunk-level interface ---------------------------------------------
-
-    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
-        k, m = self.k, self.m
-        # Logical position i lives at raw position chunk_index(i) when a
-        # `mapping=` profile is set (ErasureCode.cc:260-279).
-        data = np.stack(
-            [np.asarray(chunks[self.chunk_index(i)], dtype=np.uint8) for i in range(k)]
-        )
-        parity = np.asarray(self.encode_array(data))
-        for i in range(m):
-            np.copyto(chunks[self.chunk_index(k + i)], parity[i])
-
-    def decode_chunks(
-        self,
-        want_to_read: set[int],
-        chunks: Mapping[int, np.ndarray],
-        decoded: dict[int, np.ndarray],
-    ) -> None:
-        k, m = self.k, self.m
-        # Work in logical chunk space; raw positions go through chunk_index.
-        raw_of = self.chunk_index
-        erasures = [i for i in range(k + m) if raw_of(i) not in chunks]
-        if not erasures:
-            return
-        if len(erasures) > m:
-            raise EcError(EIO, f"{len(erasures)} erasures > m={m}")
-
-        # XOR fast paths (ErasureCodeIsa.cc:196-216): single parity, or a
-        # Vandermonde single erasure within the first k+1 chunks — the missing
-        # chunk is the XOR of the first k survivors because parity row 0 is
-        # all-ones.
-        use_xor = (m == 1) or (
-            self.technique == VANDERMONDE
-            and len(erasures) == 1
-            and erasures[0] < k + 1
-        )
-        if use_xor:
-            sources = [i for i in range(k + m) if raw_of(i) in chunks][:k]
-            stack = np.stack(
-                [np.asarray(decoded[raw_of(i)], dtype=np.uint8) for i in sources]
-            )
-            np.copyto(decoded[raw_of(erasures[0])], np.asarray(xor_reduce(stack)))
-            return
-
-        idx = self.decode_index(erasures)
-        survivors = np.stack(
-            [np.asarray(decoded[raw_of(i)], dtype=np.uint8) for i in idx]
-        )
-        rec = np.asarray(self.decode_array(erasures, survivors))
-        for p, e in enumerate(erasures):
-            np.copyto(decoded[raw_of(e)], rec[p])

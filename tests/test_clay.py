@@ -1,0 +1,146 @@
+"""CLAY tests — layered encode/decode, sub-chunk repair bandwidth.
+
+Models /root/reference/src/test/erasure-code/TestErasureCodeClay.cc.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codec.clay import ErasureCodeClay
+from ceph_tpu.codec.interface import EcError
+from ceph_tpu.codec.registry import ErasureCodePluginRegistry
+
+
+def make(k=4, m=2, d=None, **extra):
+    ec = ErasureCodeClay()
+    prof = {"k": str(k), "m": str(m), **extra}
+    if d is not None:
+        prof["d"] = str(d)
+    ec.init(prof)
+    return ec
+
+
+def payload(ec, seed=0):
+    size = ec.get_chunk_size(1) * ec.k  # one aligned stripe
+    return np.random.default_rng(seed).integers(0, 256, size).astype(np.uint8).tobytes()
+
+
+class TestGeometry:
+    def test_params(self):
+        ec = make(4, 2)  # d defaults to k+m-1=5 -> q=2, t=3, S=8
+        assert (ec.q, ec.t, ec.nu, ec.sub_chunk_no) == (2, 3, 0, 8)
+        assert ec.get_sub_chunk_count() == 8
+        ec = make(4, 3, d=6)  # q=3, k+m=7 -> nu=2, t=3, S=27
+        assert (ec.q, ec.t, ec.nu, ec.sub_chunk_no) == (3, 3, 2, 27)
+
+    def test_d_validation(self):
+        with pytest.raises(EcError):
+            make(4, 2, d=3)  # d < k
+        with pytest.raises(EcError):
+            make(4, 2, d=6)  # d > k+m-1
+        with pytest.raises(EcError):
+            make(4, 2, scalar_mds="shec")
+
+    def test_chunk_size_alignment(self):
+        ec = make(4, 2)
+        cs = ec.get_chunk_size(1)
+        assert cs % ec.sub_chunk_no == 0
+        assert ec.get_chunk_size(4 * cs) == cs
+
+
+class TestEncodeDecode:
+    @pytest.mark.parametrize("k,m,d", [(4, 2, 5), (3, 3, 5), (4, 3, 6)])
+    def test_roundtrip_all_erasures(self, k, m, d):
+        ec = make(k, m, d=d)
+        n = k + m
+        raw = payload(ec)
+        encoded = ec.encode(set(range(n)), raw)
+        chunk_size = ec.get_chunk_size(len(raw))
+        data = np.frombuffer(raw, dtype=np.uint8)
+        for i in range(k):
+            assert np.array_equal(
+                encoded[i], data[i * chunk_size : (i + 1) * chunk_size]
+            )
+        for nerr in range(1, m + 1):
+            for erasures in itertools.combinations(range(n), nerr):
+                avail = {i: encoded[i] for i in range(n) if i not in erasures}
+                decoded = ec.decode(set(erasures), avail)
+                for e in erasures:
+                    assert np.array_equal(decoded[e], encoded[e]), (
+                        (k, m, d),
+                        erasures,
+                    )
+
+    def test_decode_concat(self):
+        ec = make(4, 2)
+        raw = payload(ec, seed=1)
+        encoded = ec.encode(set(range(6)), raw)
+        avail = {i: encoded[i] for i in (0, 2, 3, 5)}
+        out = ec.decode_concat(avail)
+        assert out[: len(raw)].tobytes() == raw
+
+
+class TestRepair:
+    @pytest.mark.parametrize("k,m,d", [(4, 2, 5), (4, 3, 6)])
+    def test_repair_reads_fraction_and_matches(self, k, m, d):
+        ec = make(k, m, d=d)
+        n = k + m
+        raw = payload(ec, seed=2)
+        encoded = ec.encode(set(range(n)), raw)
+        chunk_size = ec.get_chunk_size(len(raw))
+        sc = chunk_size // ec.sub_chunk_no
+        for lost in range(n):
+            avail = set(range(n)) - {lost}
+            assert ec.is_repair({lost}, avail)
+            minimum = ec.minimum_to_decode({lost}, avail)
+            assert len(minimum) == d
+            # every helper reads exactly sub_chunk_no/q sub-chunks
+            for _, runs in minimum.items():
+                total = sum(count for _, count in runs)
+                assert total == ec.sub_chunk_no // ec.q
+            # build helper fragments exactly as ECBackend would (fragmented
+            # sub-chunk reads, ECBackend.cc:1047-1068)
+            helper_chunks = {}
+            for node, runs in minimum.items():
+                frags = [
+                    encoded[node][off * sc : (off + count) * sc]
+                    for off, count in runs
+                ]
+                helper_chunks[node] = np.concatenate(frags)
+            repaired = ec.decode({lost}, helper_chunks, chunk_size=chunk_size)
+            assert np.array_equal(repaired[lost], encoded[lost]), lost
+
+    def test_is_repair_false_cases(self):
+        ec = make(4, 2)
+        # multiple wanted -> not a repair
+        assert not ec.is_repair({0, 1}, {2, 3, 4, 5})
+        # wanted chunk available -> not a repair
+        assert not ec.is_repair({0}, {0, 1, 2, 3, 4})
+        # missing same-column helper -> not a repair
+        # (lost 0's column group is {0, 1} for q=2: needs 1 available)
+        assert not ec.is_repair({0}, {2, 3, 4})
+
+    def test_repair_bandwidth_savings(self):
+        # The headline CLAY property: repair reads d * (1/q) chunks' worth
+        # instead of k full chunks.
+        ec = make(4, 2, d=5)
+        frac = ec.d / ec.q  # chunks' worth of data read
+        assert frac < ec.k  # 2.5 < 4
+
+
+def test_plugin_registration():
+    r = ErasureCodePluginRegistry()
+    ec = r.factory("clay", {"k": "4", "m": "2"})
+    assert ec.get_chunk_count() == 6
+    assert ec.get_sub_chunk_count() == 8
+
+
+def test_scalar_mds_isa():
+    ec = make(4, 2, scalar_mds="isa", technique="cauchy")
+    raw = payload(ec, seed=3)
+    encoded = ec.encode(set(range(6)), raw)
+    decoded = ec.decode({1, 4}, {i: encoded[i] for i in (0, 2, 3, 5)})
+    assert np.array_equal(decoded[1], encoded[1])
+    assert np.array_equal(decoded[4], encoded[4])

@@ -70,6 +70,19 @@ class TestGeometry:
         with pytest.raises(EcError):
             make_rs(4, 0)
 
+    def test_reinit_refreshes_matrix(self):
+        # Regression: a second init() with new geometry must rebuild the
+        # distribution matrix, not serve the stale cached one.
+        ec = ErasureCodeTpuRs()
+        ec.init({"k": "4", "m": "2"})
+        assert ec.distribution_matrix().shape == (6, 4)
+        ec.init({"k": "6", "m": "3"})
+        assert ec.distribution_matrix().shape == (9, 6)
+        raw = payload(6 * 128, seed=13)
+        encoded = ec.encode(set(range(9)), raw)
+        decoded = ec.decode({0}, {i: encoded[i] for i in range(1, 9)})
+        assert np.array_equal(decoded[0], encoded[0])
+
 
 class TestEncodeDecode:
     @pytest.mark.parametrize("technique", [VANDERMONDE, CAUCHY])

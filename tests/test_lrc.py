@@ -1,0 +1,145 @@
+"""LRC tests — kml expansion, layered encode/decode, locality-aware minimums.
+
+Models /root/reference/src/test/erasure-code/TestErasureCodeLrc.cc.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codec.interface import EcError
+from ceph_tpu.codec.lrc import ErasureCodeLrc
+from ceph_tpu.codec.registry import ErasureCodePluginRegistry
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n).astype(np.uint8).tobytes()
+
+
+def make_kml(k=4, m=2, l=3):
+    ec = ErasureCodeLrc()
+    ec.init({"k": str(k), "m": str(m), "l": str(l)})
+    return ec
+
+
+class TestKml:
+    def test_kml_expansion_geometry(self):
+        ec = make_kml(4, 2, 3)
+        # groups=(k+m)/l=2, chunk per group = l+1 -> 8 chunks, 4 data.
+        assert ec.get_chunk_count() == 8
+        assert ec.get_data_chunk_count() == 4
+        assert len(ec.layers) == 3  # 1 global + 2 local
+        assert ec.layers[0].chunks_map == "DDc_DDc_"
+        assert ec.layers[1].chunks_map == "DDDc____"
+        assert ec.layers[2].chunks_map == "____DDDc"
+
+    def test_kml_validation(self):
+        with pytest.raises(EcError):
+            make_kml(4, 2, 4)  # k+m not multiple of l
+        with pytest.raises(EcError):
+            ErasureCodeLrc().init({"k": "4", "m": "2"})  # l missing
+        with pytest.raises(EcError):
+            ErasureCodeLrc().init({"k": "4", "m": "2", "l": "3", "mapping": "x"})
+
+    def test_kml_hides_generated_params(self):
+        ec = make_kml()
+        assert "mapping" not in ec.get_profile()
+        assert "layers" not in ec.get_profile()
+
+
+class TestRoundtrip:
+    def test_all_single_and_double_erasures(self):
+        ec = make_kml(4, 2, 3)
+        n = ec.get_chunk_count()
+        raw = payload(4 * 128 + 5)
+        encoded = ec.encode(set(range(n)), raw)
+        # Every single erasure must be locally repairable.
+        for e in range(n):
+            avail = {i: encoded[i] for i in range(n) if i != e}
+            decoded = ec.decode({e}, avail)
+            assert np.array_equal(decoded[e], encoded[e]), e
+        # Double erasures: all pairs are recoverable for this profile.
+        for pair in itertools.combinations(range(n), 2):
+            avail = {i: encoded[i] for i in range(n) if i not in pair}
+            decoded = ec.decode(set(pair), avail)
+            for e in pair:
+                assert np.array_equal(decoded[e], encoded[e]), pair
+
+    def test_decode_concat(self):
+        ec = make_kml(4, 2, 3)
+        raw = payload(4 * 256, seed=3)
+        n = ec.get_chunk_count()
+        encoded = ec.encode(set(range(n)), raw)
+        avail = {i: encoded[i] for i in range(n) if i not in (0, 5)}
+        out = ec.decode_concat(avail)
+        assert out[: len(raw)].tobytes() == raw
+
+    def test_explicit_layers_profile(self):
+        ec = ErasureCodeLrc()
+        ec.init(
+            {
+                "mapping": "DD__DD__",
+                "layers": (
+                    '[ [ "DDc_DDc_", "" ],'
+                    '  [ "DDDc____", "" ],'
+                    '  [ "____DDDc", "" ] ]'
+                ),
+            }
+        )
+        assert ec.get_chunk_count() == 8
+        assert ec.get_data_chunk_count() == 4
+        raw = payload(4 * 128, seed=4)
+        encoded = ec.encode(set(range(8)), raw)
+        avail = {i: encoded[i] for i in range(8) if i not in (1, 6)}
+        decoded = ec.decode({1, 6}, avail)
+        assert np.array_equal(decoded[1], encoded[1])
+        assert np.array_equal(decoded[6], encoded[6])
+
+    def test_layer_profile_with_plugin_spec(self):
+        ec = ErasureCodeLrc()
+        ec.init(
+            {
+                "mapping": "DD__DD__",
+                "layers": (
+                    '[ [ "DDc_DDc_", "plugin=tpu technique=cauchy" ],'
+                    '  [ "DDDc____", "" ],'
+                    '  [ "____DDDc", "" ] ]'
+                ),
+            }
+        )
+        raw = payload(4 * 128, seed=5)
+        encoded = ec.encode(set(range(8)), raw)
+        avail = {i: encoded[i] for i in range(8) if i != 4}
+        decoded = ec.decode({4}, avail)
+        assert np.array_equal(decoded[4], encoded[4])
+
+
+class TestLocality:
+    def test_local_repair_reads_fewer_chunks(self):
+        ec = make_kml(4, 2, 3)
+        n = ec.get_chunk_count()
+        # chunk 0 lost: the local layer (DDDc____) covers it with chunks
+        # {0,1,2,3}; minimum must avoid the other group entirely.
+        available = set(range(n)) - {0}
+        minimum = ec.minimum_to_decode({0}, available)
+        assert set(minimum) <= {1, 2, 3}, minimum
+        # Compare: a global-only code would need k=4 chunks across groups.
+
+    def test_want_available_reads_want_only(self):
+        ec = make_kml(4, 2, 3)
+        minimum = ec.minimum_to_decode({1, 5}, set(range(8)))
+        assert set(minimum) == {1, 5}
+
+    def test_undecodable_raises_eio(self):
+        ec = make_kml(4, 2, 3)
+        # Lose an entire local group (4 chunks) — unrecoverable.
+        available = {4, 5, 6, 7}
+        with pytest.raises(EcError):
+            ec.minimum_to_decode({0}, available)
+
+
+def test_plugin_registration():
+    r = ErasureCodePluginRegistry()
+    ec = r.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    assert ec.get_chunk_count() == 8

@@ -1,0 +1,127 @@
+"""SHEC tests — shingle structure, c-erasure tolerance, recovery locality.
+
+Models /root/reference/src/test/erasure-code/TestErasureCodeShec*.cc.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codec.interface import EcError
+from ceph_tpu.codec.registry import ErasureCodePluginRegistry
+from ceph_tpu.codec.shec import MULTIPLE, SINGLE, ErasureCodeShec, shec_coding_matrix
+from ceph_tpu.gf import gf_matmul
+
+
+def make(k=4, m=3, c=2, technique=MULTIPLE):
+    ec = ErasureCodeShec(technique=technique)
+    ec.init({"k": str(k), "m": str(m), "c": str(c)})
+    return ec
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n).astype(np.uint8).tobytes()
+
+
+class TestMatrix:
+    def test_shingle_sparsity(self):
+        # Shingled rows must be sparser than (or equal to) full Vandermonde.
+        for technique in (SINGLE, MULTIPLE):
+            mat = shec_coding_matrix(4, 3, 2, technique)
+            assert mat.shape == (3, 4)
+            assert (mat != 0).sum() <= 12
+            # Every parity row covers at least one chunk; every data chunk is
+            # covered by at least one parity.
+            assert ((mat != 0).sum(axis=1) >= 1).all()
+            assert ((mat != 0).sum(axis=0) >= 1).all()
+
+    def test_single_band_structure(self):
+        # single: one band (m2=m, c2=c); window width ~ k*c/m.
+        mat = shec_coding_matrix(6, 3, 2, SINGLE)
+        widths = (mat != 0).sum(axis=1)
+        assert widths.sum() == 12  # sum of ((rr+c)k/m - rr*k/m) over rr = c*k
+
+
+class TestParams:
+    def test_defaults(self):
+        ec = ErasureCodeShec()
+        ec.init({})
+        assert (ec.k, ec.m, ec.c) == (4, 3, 2)
+
+    def test_envelope(self):
+        with pytest.raises(EcError):
+            make(13, 3, 2)  # k > 12
+        with pytest.raises(EcError):
+            make(12, 9, 2)  # k+m > 20
+        with pytest.raises(EcError):
+            make(4, 3, 4)  # c > m
+        with pytest.raises(EcError):
+            make(3, 4, 2)  # m > k
+        with pytest.raises(EcError):
+            ErasureCodeShec().init({"k": "4", "m": "3"})  # c missing
+
+
+class TestRoundtrip:
+    @pytest.mark.parametrize("technique", [SINGLE, MULTIPLE])
+    def test_all_c_erasures_recoverable(self, technique):
+        k, m, c = 4, 3, 2
+        ec = make(k, m, c, technique)
+        n = k + m
+        raw = payload(k * 128 + 9)
+        encoded = ec.encode(set(range(n)), raw)
+        # chunk layout: parity = shingled matrix product
+        data = np.stack([encoded[i] for i in range(k)])
+        expect = gf_matmul(ec.distribution_matrix()[k:], data)
+        for i in range(m):
+            assert np.array_equal(encoded[k + i], expect[i])
+        # any <= c erasures must decode
+        for nerr in range(1, c + 1):
+            for erasures in itertools.combinations(range(n), nerr):
+                avail = {i: encoded[i] for i in range(n) if i not in erasures}
+                decoded = ec.decode(set(erasures), avail)
+                for e in erasures:
+                    assert np.array_equal(decoded[e], encoded[e]), (
+                        technique,
+                        erasures,
+                    )
+
+    def test_decode_concat(self):
+        ec = make()
+        raw = payload(4 * 256, seed=2)
+        n = ec.get_chunk_count()
+        encoded = ec.encode(set(range(n)), raw)
+        avail = {i: encoded[i] for i in range(n) if i not in (1, 5)}
+        out = ec.decode_concat(avail)
+        assert out[: len(raw)].tobytes() == raw
+
+
+class TestLocality:
+    def test_single_erasure_reads_fewer_than_k(self):
+        # The shingle property: repairing one chunk should read fewer than k
+        # chunks for at least some erasures.
+        ec = make(8, 4, 2)
+        n = ec.get_chunk_count()
+        saw_local = False
+        for e in range(ec.k):
+            minimum = ec.minimum_to_decode({e}, set(range(n)) - {e})
+            assert e not in minimum
+            if len(minimum) < ec.k:
+                saw_local = True
+        assert saw_local, "no erasure repaired with fewer than k reads"
+
+    def test_want_available(self):
+        ec = make()
+        got = ec.minimum_to_decode({0, 1}, {0, 1, 2, 3})
+        assert set(got) == {0, 1}
+
+
+def test_plugin_registration():
+    r = ErasureCodePluginRegistry()
+    ec = r.factory("shec", {"k": "6", "m": "3", "c": "2"})
+    assert ec.get_chunk_count() == 9
+    raw = payload(6 * 128, seed=3)
+    encoded = ec.encode(set(range(9)), raw)
+    decoded = ec.decode({2, 7}, {i: encoded[i] for i in range(9) if i not in (2, 7)})
+    assert np.array_equal(decoded[2], encoded[2])
+    assert np.array_equal(decoded[7], encoded[7])
