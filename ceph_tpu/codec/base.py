@@ -156,7 +156,9 @@ class ErasureCode(ErasureCodeInterface):
         raw = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8).ravel()
         chunks = self.encode_prepare(raw)
         self.encode_chunks(chunks)
-        return {i: chunks[i] for i in want_to_encode}
+        # Out-of-range ids in want_to_encode are filtered, like the
+        # reference's erase-non-wanted loop (ErasureCode.cc:198-201).
+        return {i: chunks[i] for i in want_to_encode if i in chunks}
 
     # -- decode path (ErasureCode.cc:205-248) -------------------------------
 
@@ -166,6 +168,8 @@ class ErasureCode(ErasureCodeInterface):
         have = set(chunks)
         if want_to_read <= have:
             return {i: np.asarray(chunks[i]) for i in want_to_read}
+        if not chunks:
+            raise EcError(EIO, "no chunks available to decode from")
         k = self.get_data_chunk_count()
         m = self.get_coding_chunk_count()
         blocksize = len(next(iter(chunks.values())))

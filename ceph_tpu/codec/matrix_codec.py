@@ -43,7 +43,7 @@ class _GlobalPlanCache:
     def encode_bit_matrix(self, coding_rows: np.ndarray) -> jnp.ndarray:
         """Per-geometry encode matrices: one entry per codec instance's
         matrix, unbounded like the reference's per-(k,m) encode tables."""
-        key = coding_rows.tobytes()
+        key = (coding_rows.shape, coding_rows.tobytes())
         with self._lock:
             bm = self._encode.get(key)
         if bm is not None:
@@ -61,7 +61,7 @@ class _GlobalPlanCache:
         the signature-keyed plans so total decode-table memory stays within
         DECODE_LRU_CAPACITY, as the reference's cache guarantees.
         """
-        key = (matrix.tobytes(), "#raw")
+        key = (matrix.shape, matrix.tobytes(), "#raw")
         with self._lock:
             cached = self._decode.get(key)
             if cached is not None:
@@ -93,7 +93,7 @@ class _GlobalPlanCache:
         sig = "".join(f"+{r}" for r in decode_index) + "".join(
             f"-{e}" for e in erasures
         )
-        key = (dist_matrix.tobytes(), sig)
+        key = (dist_matrix.shape, dist_matrix.tobytes(), sig)
         with self._lock:
             cached = self._decode.get(key)
             if cached is not None:

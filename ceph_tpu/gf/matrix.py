@@ -227,15 +227,23 @@ def jerasure_cauchy_orig_matrix(k: int, m: int) -> np.ndarray:
     return a
 
 
+_BITCOUNT_TABLE: np.ndarray | None = None
+
+
 def _bitcount_gf(x: int) -> int:
     """Number of ones in the 8x8 GF(2) bit-matrix of multiply-by-x.
 
     jerasure's `cauchy_n_ones` equivalent, used by cauchy_good to pick light
-    coefficients; computed directly from the companion expansion.
+    coefficients; a 256-entry table built once from the companion expansion.
     """
-    from .bitslice import coeff_bitmatrix
+    global _BITCOUNT_TABLE
+    if _BITCOUNT_TABLE is None:
+        from .bitslice import coeff_bitmatrix
 
-    return int(coeff_bitmatrix(x).sum())
+        _BITCOUNT_TABLE = np.array(
+            [coeff_bitmatrix(c).sum() for c in range(256)], dtype=np.int32
+        )
+    return int(_BITCOUNT_TABLE[x])
 
 
 def jerasure_cauchy_good_matrix(k: int, m: int) -> np.ndarray:
@@ -268,7 +276,7 @@ def jerasure_cauchy_good_matrix(k: int, m: int) -> np.ndarray:
     return a
 
 
-def vandermonde_mds_check(k: int, m: int, matrix: np.ndarray, trials: int = 0) -> bool:
+def vandermonde_mds_check(k: int, m: int, matrix: np.ndarray) -> bool:
     """Exhaustively verify every m-erasure pattern is decodable.
 
     The reference caps ISA Vandermonde at (k<=21, m=4)/(k<=32, m<=3)

@@ -1,0 +1,6 @@
+"""Stripe engine: offset algebra, batched codec drivers, integrity digests."""
+
+from .hashinfo import HashInfo
+from .stripe import StripeInfo, decode_concat, decode_shards, encode
+
+__all__ = ["HashInfo", "StripeInfo", "decode_concat", "decode_shards", "encode"]

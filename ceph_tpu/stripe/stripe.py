@@ -1,0 +1,211 @@
+"""Stripe math + batched stripe codec driver — mirror of `ECUtil`.
+
+Reference: /root/reference/src/osd/ECUtil.{h,cc}.  `StripeInfo` reproduces
+stripe_info_t's offset algebra (stripe_width = k x chunk_size; byte B of the
+logical object lives in chunk (B / chunk_size) % k of stripe B / stripe_width,
+ErasureCodeInterface.h:39-58).  The codec drivers replace the reference's
+per-stripe hot loop (`ECUtil::encode` calling ec->encode once per stripe,
+ECUtil.cc:123-162) with ONE device launch over the whole stripe batch: the
+object reshapes to (stripes, k, chunk_size) and the bitsliced kernel treats
+stripes as the batch axis — this is the deep-batching design the 40 GB/s
+target depends on (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from ceph_tpu.codec.base import EINVAL, EIO
+from ceph_tpu.codec.interface import EcError, ErasureCodeInterface
+from ceph_tpu.codec.matrix_codec import MatrixCodecMixin
+
+
+def _matrix_fast_path(ec: ErasureCodeInterface) -> bool:
+    """Single-launch device path applies to matrix codecs whose raw chunk
+    order is the logical order (no `mapping=` remap); remapped codecs go
+    through their own chunk-level interface, which is mapping-aware."""
+    return isinstance(ec, MatrixCodecMixin) and not ec.get_chunk_mapping()
+
+
+class StripeInfo:
+    """stripe_info_t: logical <-> chunk offset algebra (ECUtil.h:27-80)."""
+
+    def __init__(self, stripe_width: int, chunk_size: int):
+        assert stripe_width % chunk_size == 0
+        self.stripe_width = stripe_width
+        self.chunk_size = chunk_size
+        self.k = stripe_width // chunk_size
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return -(-offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - offset % self.stripe_width
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        return -(-offset // self.stripe_width) * self.stripe_width
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def offset_len_to_stripe_bounds(self, offset: int, length: int) -> tuple[int, int]:
+        """Smallest stripe-aligned (offset, length) covering the range."""
+        start = self.logical_to_prev_stripe_offset(offset)
+        end = self.logical_to_next_stripe_offset(offset + length)
+        return start, end - start
+
+    def logical_to_chunk_position(self, offset: int) -> tuple[int, int, int]:
+        """(stripe index, chunk index within stripe, offset within chunk)."""
+        stripe, within = divmod(offset, self.stripe_width)
+        chunk, off = divmod(within, self.chunk_size)
+        return stripe, chunk, off
+
+
+def encode(
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    data: bytes | np.ndarray,
+    want: set[int] | None = None,
+) -> dict[int, np.ndarray]:
+    """Batched stripe encode: object -> per-shard concatenated chunks.
+
+    `data` length must be a multiple of stripe_width (the caller pads, as
+    ECTransaction does before encode_and_write).  Matrix codecs take the
+    single-launch path; layered/array codecs (lrc, clay) fall back to
+    per-stripe encode_chunks, still one python loop over stripes but device
+    work batched inside each codec.
+    """
+    raw = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8).ravel()
+    if raw.size % sinfo.stripe_width:
+        raise EcError(EINVAL, f"length {raw.size} not stripe aligned")
+    k = ec.get_data_chunk_count()
+    n = ec.get_chunk_count()
+    m = n - k
+    assert k == sinfo.k
+    stripes = raw.size // sinfo.stripe_width
+    shaped = raw.reshape(stripes, k, sinfo.chunk_size)
+    if want is None:
+        want = set(range(n))
+    out: dict[int, np.ndarray] = {}
+    if _matrix_fast_path(ec) and m > 0:
+        parity = np.asarray(ec.encode_array(shaped))  # one launch
+        for i in range(k):
+            out[i] = np.ascontiguousarray(shaped[:, i, :]).reshape(-1)
+        for i in range(m):
+            out[k + i] = np.ascontiguousarray(parity[:, i, :]).reshape(-1)
+    else:
+        shards = [np.empty((stripes, sinfo.chunk_size), dtype=np.uint8) for _ in range(n)]
+        for s in range(stripes):
+            chunks = ec.encode(set(range(n)), shaped[s].reshape(-1))
+            for i in range(n):
+                shards[i][s] = chunks[i]
+        for i in range(n):
+            out[i] = shards[i].reshape(-1)
+    return {i: out[i] for i in want}
+
+
+def decode_concat(
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    shards: Mapping[int, np.ndarray],
+) -> np.ndarray:
+    """Batched client-read decode: per-shard chunk streams -> logical bytes
+    (mirror of ECUtil::decode's concat overload, ECUtil.cc:12-48)."""
+    lengths = {len(v) for v in shards.values()}
+    if len(lengths) != 1:
+        raise EcError(EINVAL, "shards must have equal length")
+    shard_len = lengths.pop()
+    if shard_len % sinfo.chunk_size:
+        raise EcError(EINVAL, f"shard length {shard_len} not chunk aligned")
+    stripes = shard_len // sinfo.chunk_size
+    k = ec.get_data_chunk_count()
+    n = ec.get_chunk_count()
+    have = {
+        i: np.asarray(v, dtype=np.uint8).reshape(stripes, sinfo.chunk_size)
+        for i, v in shards.items()
+    }
+    # Logical data chunk i lives at raw position chunk_index(i).
+    chunk_index = getattr(ec, "chunk_index", lambda i: i)
+    data_raw = [chunk_index(i) for i in range(k)]
+    data = np.empty((stripes, k, sinfo.chunk_size), dtype=np.uint8)
+    missing_raw = [r for r in data_raw if r not in have]
+    for i, r in enumerate(data_raw):
+        if r in have:
+            data[:, i, :] = have[r]
+    if missing_raw:
+        # The decode plan needs the full erasure set (every shard we don't
+        # have), not just the wanted data shards.
+        erasures = [i for i in range(n) if i not in have]
+        if _matrix_fast_path(ec):
+            idx = ec.decode_index(erasures)
+            if any(i not in have for i in idx):
+                raise EcError(EIO, f"missing survivor shards {idx}")
+            survivors = np.stack([have[i] for i in idx], axis=1)  # (S, k, cs)
+            rec = np.asarray(ec.decode_array(erasures, survivors))
+            for p, e in enumerate(erasures):
+                if e < k:
+                    data[:, e, :] = rec[:, p, :]
+        else:
+            for s in range(stripes):
+                decoded = ec.decode(
+                    set(missing_raw), {i: buf[s] for i, buf in have.items()}
+                )
+                for i, r in enumerate(data_raw):
+                    if r in decoded:
+                        data[s, i, :] = decoded[r]
+    return data.reshape(-1)
+
+
+def decode_shards(
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    shards: Mapping[int, np.ndarray],
+    need: set[int],
+) -> dict[int, np.ndarray]:
+    """Recovery decode: rebuild whole target shards (data or parity) from
+    surviving shard streams (ECUtil::decode's per-shard overload,
+    ECUtil.cc:50-121)."""
+    lengths = {len(v) for v in shards.values()}
+    if len(lengths) != 1:
+        raise EcError(EINVAL, "shards must have equal length")
+    shard_len = lengths.pop()
+    stripes = shard_len // sinfo.chunk_size
+    have = {
+        i: np.asarray(v, dtype=np.uint8).reshape(stripes, sinfo.chunk_size)
+        for i, v in shards.items()
+    }
+    missing = sorted(i for i in need if i not in have)
+    out = {i: have[i].reshape(-1) for i in need if i in have}
+    if not missing:
+        return out
+    if _matrix_fast_path(ec):
+        erasures = [i for i in range(ec.get_chunk_count()) if i not in have]
+        idx = ec.decode_index(erasures)
+        if any(i not in have for i in idx):
+            raise EcError(EIO, f"missing survivor shards {idx}")
+        survivors = np.stack([have[i] for i in idx], axis=1)
+        rec = np.asarray(ec.decode_array(erasures, survivors))
+        for p, e in enumerate(erasures):
+            if e in need:
+                out[e] = np.ascontiguousarray(rec[:, p, :]).reshape(-1)
+    else:
+        rebuilt = {e: np.empty((stripes, sinfo.chunk_size), dtype=np.uint8) for e in missing}
+        for s in range(stripes):
+            decoded = ec.decode(
+                set(missing), {i: buf[s] for i, buf in have.items()}
+            )
+            for e in missing:
+                rebuilt[e][s] = decoded[e]
+        for e in missing:
+            out[e] = rebuilt[e].reshape(-1)
+    return out

@@ -1,0 +1,1 @@
+"""Operator tools: file codec CLI, benchmark harness, bench sweep."""

@@ -1,0 +1,97 @@
+"""bench.sh equivalent — sweep plugins x techniques x k/m grid.
+
+Mirror of /root/reference/qa/workunits/erasure-code/bench.sh:40-57: runs the
+benchmark harness over a parameter grid and emits one JSON line per run
+(instead of flot JS) so results are machine-readable.
+
+  python -m ceph_tpu.tools.bench_sweep --size 4096 --total-size 1048576
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import ec_benchmark
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_sweep", description=__doc__)
+    p.add_argument("--size", type=int, default=4096, help="object size per op")
+    p.add_argument(
+        "--total-size", type=int, default=1 << 20, help="total bytes per config"
+    )
+    p.add_argument(
+        "--plugins", default="tpu,jerasure", help="comma-separated plugin list"
+    )
+    p.add_argument("--ks", default="2,3,4,6,8,10")
+    p.add_argument("--ms", default="1,2,3")
+    p.add_argument("--workloads", default="encode,decode")
+    args = p.parse_args(argv)
+
+    techniques = {
+        "tpu": ["reed_sol_van", "cauchy"],
+        "jerasure": ["reed_sol_van", "cauchy_good"],
+    }
+    iterations = max(1, args.total_size // args.size)
+    for plugin in args.plugins.split(","):
+        for technique in techniques.get(plugin, [None]):
+            for k in (int(x) for x in args.ks.split(",")):
+                for m in (int(x) for x in args.ms.split(",")):
+                    if m > k:
+                        continue
+                    for workload in args.workloads.split(","):
+                        bench_args = [
+                            "-p", plugin,
+                            "-P", f"k={k}",
+                            "-P", f"m={m}",
+                            "-S", str(args.size),
+                            "-i", str(iterations),
+                            "-w", workload,
+                            "-e", str(min(m, 2)),
+                        ]
+                        if technique:
+                            bench_args += ["-P", f"technique={technique}"]
+                        parser = ec_benchmark.build_parser()
+                        opts = parser.parse_args(bench_args)
+                        try:
+                            ec = ec_benchmark.make_codec(opts)
+                            if workload == "encode":
+                                elapsed = ec_benchmark.run_encode(ec, opts)
+                            else:
+                                elapsed = ec_benchmark.run_decode(ec, opts)
+                        except Exception as e:  # record failures, keep sweeping
+                            print(
+                                json.dumps(
+                                    {
+                                        "plugin": plugin,
+                                        "technique": technique,
+                                        "k": k,
+                                        "m": m,
+                                        "workload": workload,
+                                        "error": str(e),
+                                    }
+                                )
+                            )
+                            continue
+                        total = iterations * args.size
+                        print(
+                            json.dumps(
+                                {
+                                    "plugin": plugin,
+                                    "technique": technique,
+                                    "k": k,
+                                    "m": m,
+                                    "workload": workload,
+                                    "seconds": round(elapsed, 6),
+                                    "KiB": total / 1024,
+                                    "MBps": round(total / max(elapsed, 1e-9) / 1e6, 1),
+                                }
+                            )
+                        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Shared utilities: native bindings, integrity digests."""
+
+from .crc32c import crc32c, hw_available
+
+__all__ = ["crc32c", "hw_available"]

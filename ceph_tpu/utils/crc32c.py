@@ -1,0 +1,51 @@
+"""crc32c (Castagnoli) — chunk integrity digests.
+
+The reference tracks per-shard cumulative crc32c in the `hinfo` xattr
+(/root/reference/src/osd/ECUtil.h:101-160) and verifies it on every sub-read
+(ECBackend.cc:1023-1156).  Hot path is the native SSE4.2 implementation
+(native/crc32c.cc via ctypes); the pure-Python table fallback keeps
+correctness on toolchain-less hosts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .native import load as _load_native
+
+_POLY = 0x82F63B78  # reflected Castagnoli
+
+
+def _build_table() -> np.ndarray:
+    table = np.zeros(256, dtype=np.uint32)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+        table[i] = c
+    return table
+
+
+_TABLE = _build_table()
+
+
+def _crc32c_py(crc: int, data: bytes) -> int:
+    c = crc ^ 0xFFFFFFFF
+    for b in data:
+        c = int(_TABLE[(c ^ b) & 0xFF]) ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+    """Cumulative crc32c; pass the previous digest to chain appends."""
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8).tobytes()
+    lib = _load_native()
+    if lib is not None:
+        return int(lib.ceph_tpu_crc32c(crc, data, len(data)))
+    return _crc32c_py(crc, data)
+
+
+def hw_available() -> bool:
+    lib = _load_native()
+    return bool(lib and lib.ceph_tpu_crc32c_hw_available())

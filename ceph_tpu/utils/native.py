@@ -1,0 +1,66 @@
+"""ctypes loader for the native runtime library (native/).
+
+Builds libceph_tpu_native.so on first use if the toolchain is available and
+the artifact is missing/stale; callers degrade gracefully to pure-Python
+fallbacks when neither a binary nor a compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libceph_tpu_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_NATIVE_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, building it on demand; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        sources_newer = False
+        if _LIB_PATH.exists():
+            lib_mtime = _LIB_PATH.stat().st_mtime
+            sources_newer = any(
+                src.stat().st_mtime > lib_mtime
+                for src in _NATIVE_DIR.glob("*.cc")
+            )
+        if (not _LIB_PATH.exists() or sources_newer) and not _build():
+            if not _LIB_PATH.exists():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            lib.ceph_tpu_crc32c.restype = ctypes.c_uint32
+            lib.ceph_tpu_crc32c.argtypes = [
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            lib.ceph_tpu_crc32c_hw_available.restype = ctypes.c_int
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib

@@ -1,0 +1,104 @@
+"""Tool tests — the byte-parity harness pattern.
+
+Models /root/reference/src/test/ceph-erasure-code-tool/
+test_ceph-erasure-code-tool.sh: encode a file to chunks, remove some, decode,
+`cmp` byte-identity with the original.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.tools import ec_benchmark, ec_tool
+
+
+@pytest.fixture
+def workfile(tmp_path):
+    path = tmp_path / "obj"
+    data = np.random.default_rng(0).integers(0, 256, 4 * 1024 + 37, dtype=np.uint8)
+    path.write_bytes(data.tobytes())
+    return str(path), data.tobytes()
+
+
+PROFILE = "plugin=tpu,technique=reed_sol_van,k=4,m=2"
+
+
+class TestEcTool:
+    def test_plugin_exists(self, capsys):
+        assert ec_tool.main(["test-plugin-exists", "tpu"]) == 0
+        assert ec_tool.main(["test-plugin-exists", "nonexistent"]) == 1
+
+    def test_validate_profile(self, capsys):
+        assert ec_tool.main(["validate-profile", PROFILE]) == 0
+        assert ec_tool.main(["validate-profile", PROFILE, "chunk_count"]) == 0
+        assert capsys.readouterr().out.strip() == "6"
+        assert ec_tool.main(["validate-profile", PROFILE, "data_chunk_count"]) == 0
+        assert capsys.readouterr().out.strip() == "4"
+        assert ec_tool.main(["validate-profile", "plugin=tpu,k=99,m=9"]) == 1
+
+    def test_calc_chunk_size(self, capsys):
+        assert ec_tool.main(["calc-chunk-size", PROFILE, "4096"]) == 0
+        assert int(capsys.readouterr().out.strip()) == 1024
+
+    def test_encode_decode_roundtrip(self, workfile):
+        """The reference harness's full round-trip + cmp byte-identity."""
+        path, original = workfile
+        assert ec_tool.main(["encode", PROFILE, "1024", "", path]) == 0
+        for i in range(6):
+            assert os.path.exists(f"{path}.{i}")
+        # erase two chunks
+        os.unlink(f"{path}.1")
+        os.unlink(f"{path}.4")
+        assert ec_tool.main(["decode", PROFILE, "1024", "", path]) == 0
+        with open(f"{path}.decoded", "rb") as f:
+            out = f.read()
+        assert out[: len(original)] == original
+
+    def test_decode_specific_chunks(self, workfile):
+        path, _ = workfile
+        assert ec_tool.main(["encode", PROFILE, "1024", "", path]) == 0
+        with open(f"{path}.2", "rb") as f:
+            chunk2 = f.read()
+        os.unlink(f"{path}.2")
+        assert ec_tool.main(["decode", PROFILE, "1024", "2", path]) == 0
+        with open(f"{path}.2.decoded", "rb") as f:
+            assert f.read() == chunk2
+
+    def test_too_many_erasures_fails(self, workfile):
+        path, _ = workfile
+        assert ec_tool.main(["encode", PROFILE, "1024", "", path]) == 0
+        for i in (0, 1, 2):
+            os.unlink(f"{path}.{i}")
+        assert ec_tool.main(["decode", PROFILE, "1024", "", path]) == 1
+
+
+class TestBenchmark:
+    def test_encode_output_format(self, capsys):
+        rc = ec_benchmark.main(
+            ["-p", "tpu", "-P", "k=4", "-P", "m=2", "-S", "4096", "-i", "3"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out.strip()
+        elapsed, kib = out.split("\t")
+        assert float(elapsed) > 0
+        assert float(kib) == 3 * 4096 / 1024
+
+    def test_decode_exhaustive_verifies(self, capsys):
+        rc = ec_benchmark.main(
+            [
+                "-p", "tpu", "-P", "k=4", "-P", "m=2", "-S", "4096",
+                "-i", "8", "-w", "decode", "-e", "2",
+                "--erasures-generation", "exhaustive",
+            ]
+        )
+        assert rc == 0
+
+    def test_decode_fixed_erasures(self, capsys):
+        rc = ec_benchmark.main(
+            [
+                "-p", "jerasure", "-P", "k=4", "-P", "m=2", "-S", "4096",
+                "-i", "2", "-w", "decode", "--erased", "0", "--erased", "5",
+            ]
+        )
+        assert rc == 0
