@@ -1,0 +1,84 @@
+"""Fault injection — mirror of src/common/fault_injector.h.
+
+Reference: /root/reference/src/common/fault_injector.h:57 (FaultInjector<T>:
+named injection points that can be armed to fail with an errno or abort)
+plus the messenger's probabilistic injections
+(`ms_inject_socket_failures`, global.yaml.in:1240) and
+`heartbeat_inject_failure` (:865).  Used by tests to drive the EIO /
+corruption / connection-loss paths the qa suites exercise
+(qa/standalone/erasure-code/test-erasure-eio.sh).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+
+class InjectedFailure(Exception):
+    def __init__(self, point: str, err: int):
+        self.point = point
+        self.errno = -abs(err)
+        super().__init__(f"injected failure at {point} (errno {self.errno})")
+
+
+class FaultInjector:
+    """Named injection points, armed per-point with an errno and an
+    optional remaining-hits budget."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._points: dict[str, tuple[int, int]] = {}  # name -> (errno, hits)
+        self._probabilistic: dict[str, float] = {}  # name -> probability
+        self._rng = random.Random(0xEC)
+
+    def inject(self, point: str, err: int, hits: int = -1) -> None:
+        """Arm: next `hits` checks at `point` raise (hits<0 = forever)."""
+        with self._lock:
+            self._points[point] = (err, hits)
+
+    def inject_probabilistic(self, point: str, one_in: int) -> None:
+        """1-in-N failure chance (ms_inject_socket_failures semantics)."""
+        with self._lock:
+            if one_in <= 0:
+                self._probabilistic.pop(point, None)
+            else:
+                self._probabilistic[point] = 1.0 / one_in
+
+    def clear(self, point: str | None = None) -> None:
+        with self._lock:
+            if point is None:
+                self._points.clear()
+                self._probabilistic.clear()
+            else:
+                self._points.pop(point, None)
+                self._probabilistic.pop(point, None)
+
+    def check(self, point: str) -> None:
+        """Call at the injection point; raises InjectedFailure if armed."""
+        with self._lock:
+            armed = self._points.get(point)
+            if armed is not None:
+                err, hits = armed
+                if hits > 0:
+                    hits -= 1
+                    if hits == 0:
+                        del self._points[point]
+                    else:
+                        self._points[point] = (err, hits)
+                raise InjectedFailure(point, err)
+            p = self._probabilistic.get(point)
+            if p is not None and self._rng.random() < p:
+                raise InjectedFailure(point, 5)  # EIO
+
+    def armed(self, point: str) -> bool:
+        with self._lock:
+            return point in self._points or point in self._probabilistic
+
+
+# Process-wide injector used by daemons when none is passed explicitly.
+_global = FaultInjector()
+
+
+def global_injector() -> FaultInjector:
+    return _global

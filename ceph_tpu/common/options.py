@@ -1,0 +1,172 @@
+"""Typed option schema — mirror of the reference's options framework.
+
+Reference: /root/reference/src/common/options/global.yaml.in (~800 typed
+options code-generated into md_config_t) and src/common/options.h (Option
+struct: name, type, level, default, description, see_also, flags).  This
+framework keeps the same shape — a declarative table of typed, leveled,
+documented options — scoped to the subsystems this framework implements.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class OptionLevel(enum.Enum):
+    """Audience levels (options.h LEVEL_BASIC/ADVANCED/DEV)."""
+
+    BASIC = "basic"
+    ADVANCED = "advanced"
+    DEV = "dev"
+
+
+@dataclass(frozen=True)
+class Option:
+    """One typed option (src/common/options.h Option)."""
+
+    name: str
+    type: type  # int | float | bool | str
+    default: object
+    level: OptionLevel = OptionLevel.ADVANCED
+    desc: str = ""
+    see_also: tuple[str, ...] = ()
+    # Runtime-mutable options notify registered observers on change
+    # (md_config_obs_t; e.g. mClockScheduler, src/osd/scheduler/
+    # mClockScheduler.h:72).
+    runtime: bool = False
+
+    def parse(self, value: object):
+        """Coerce a raw (usually string) value to the option's type."""
+        if isinstance(value, self.type):
+            return value
+        s = str(value)
+        if self.type is bool:
+            if s.lower() in ("true", "1", "yes", "on"):
+                return True
+            if s.lower() in ("false", "0", "no", "off"):
+                return False
+            raise ValueError(f"invalid bool for {self.name}: {s!r}")
+        return self.type(s)
+
+
+def _opts(*options: Option) -> dict[str, Option]:
+    table: dict[str, Option] = {}
+    for o in options:
+        if o.name in table:
+            raise ValueError(f"duplicate option {o.name}")
+        table[o.name] = o
+    return table
+
+
+B = OptionLevel.BASIC
+A = OptionLevel.ADVANCED
+D = OptionLevel.DEV
+
+# The option table.  Names and defaults follow the reference's
+# global.yaml.in / osd.yaml.in where an equivalent exists (cited inline).
+OPTIONS: dict[str, Option] = _opts(
+    # --- identity / cluster -------------------------------------------------
+    Option("name", str, "", B, "entity name, e.g. osd.0 / mon.a / client"),
+    Option("fsid", str, "", B, "cluster fsid"),
+    Option("mon_host", str, "", B, "comma-separated mon addresses"),
+    # --- erasure coding (global.yaml.in:431, :2541; osd.yaml.in) ------------
+    Option("erasure_code_dir", str, "", A, "directory for native codec plugins"),
+    Option(
+        "osd_erasure_code_plugins",
+        str,
+        "tpu jerasure lrc shec clay",
+        A,
+        "space-separated plugins preloaded at OSD boot (global.yaml.in:2541)",
+    ),
+    Option(
+        "osd_pool_erasure_code_stripe_unit",
+        int,
+        4096,
+        A,
+        "default stripe unit (bytes) for EC pools (osd.yaml.in)",
+    ),
+    Option(
+        "osd_pool_default_erasure_code_profile",
+        str,
+        "plugin=tpu technique=reed_sol_van k=2 m=1",
+        A,
+        "default EC profile (global.yaml.in)",
+    ),
+    # --- OSD ----------------------------------------------------------------
+    Option("osd_recovery_max_chunk", int, 8 << 20, A,
+           "max recovery push size; rounded to stripe (ECBackend.h:206)"),
+    Option("osd_recovery_max_active", int, 3, A,
+           "max concurrent recovery ops per OSD"),
+    Option("osd_max_backfills", int, 1, A, "max concurrent backfills"),
+    Option("osd_op_num_shards", int, 4, A,
+           "op queue shards (OSD.h sharded op queue)"),
+    Option("osd_op_num_threads_per_shard", int, 2, A, ""),
+    Option("osd_heartbeat_interval", float, 1.0, A,
+           "seconds between OSD->OSD pings (osd.yaml.in, scaled down)"),
+    Option("osd_heartbeat_grace", float, 6.0, A,
+           "seconds without reply before reporting failure "
+           "(OSDMonitor.cc:3240)", runtime=True),
+    Option("osd_scrub_interval", float, 0.0, A,
+           "periodic scrub interval; 0 disables the timer"),
+    Option("osd_pool_default_pg_num", int, 8, B, ""),
+    Option("osd_client_op_priority", int, 63, A, "", runtime=True),
+    Option("osd_recovery_op_priority", int, 3, A, "", runtime=True),
+    Option("osd_op_queue", str, "mclock_scheduler", A,
+           "op scheduler: mclock_scheduler | wpq "
+           "(osd/scheduler/OpScheduler)"),
+    Option("osd_fast_read", bool, False, A,
+           "issue k+m reads, first k win (pool fast_read default)"),
+    # --- mClock QoS (osd/scheduler/mClockScheduler.h:72) --------------------
+    Option("osd_mclock_client_res", float, 1.0, A, "", runtime=True),
+    Option("osd_mclock_client_wgt", float, 2.0, A, "", runtime=True),
+    Option("osd_mclock_client_lim", float, 0.0, A, "", runtime=True),
+    Option("osd_mclock_recovery_res", float, 0.0, A, "", runtime=True),
+    Option("osd_mclock_recovery_wgt", float, 1.0, A, "", runtime=True),
+    Option("osd_mclock_recovery_lim", float, 3.0, A, "", runtime=True),
+    # --- monitor ------------------------------------------------------------
+    Option("mon_lease", float, 5.0, A, "paxos lease seconds (Paxos.h)"),
+    Option("mon_tick_interval", float, 1.0, A, ""),
+    Option("mon_osd_min_down_reporters", int, 1, A,
+           "distinct reporters needed to mark an osd down "
+           "(OSDMonitor.cc can_mark_down quorum)"),
+    Option("mon_osd_reporter_subtree_level", str, "host", A, ""),
+    Option("mon_osd_down_out_interval", float, 30.0, A,
+           "seconds down before an osd is marked out"),
+    # --- messenger (global.yaml.in:1240-1271 fault injection) ---------------
+    Option("ms_type", str, "async+posix", A, "messenger stack"),
+    Option("ms_crc_data", bool, True, A, "crc32c-protect frame payloads"),
+    Option("ms_inject_socket_failures", int, 0, D,
+           "1-in-N chance of injected connection failure "
+           "(global.yaml.in:1240)", runtime=True),
+    Option("ms_inject_internal_delays", float, 0.0, D,
+           "injected delay seconds in delivery (global.yaml.in:1271)",
+           runtime=True),
+    Option("ms_dispatch_throttle_bytes", int, 100 << 20, A, ""),
+    # --- objectstore --------------------------------------------------------
+    Option("osd_objectstore", str, "memstore", A,
+           "objectstore backend: memstore | tpustore"),
+    Option("memstore_device_bytes", int, 1 << 30, A, ""),
+    # --- logging (src/log) --------------------------------------------------
+    Option("log_file", str, "", B, "empty = stderr"),
+    Option("log_max_recent", int, 500, A,
+           "in-memory ring entries kept for crash dump (Log.h)"),
+    Option("debug_osd", str, "1/5", A, "log/gather levels for subsystem osd"),
+    Option("debug_mon", str, "1/5", A, ""),
+    Option("debug_ms", str, "0/5", A, ""),
+    Option("debug_ec", str, "1/5", A, ""),
+    Option("debug_objecter", str, "0/5", A, ""),
+    Option("debug_crush", str, "0/5", A, ""),
+    Option("debug_paxos", str, "1/5", A, ""),
+    Option("debug_objectstore", str, "0/5", A, ""),
+    # --- admin socket (src/common/admin_socket.h:106) -----------------------
+    Option("admin_socket", str, "", A,
+           "unix socket path; empty disables the admin socket"),
+    # --- tracing (src/common/tracer.h) --------------------------------------
+    Option("jaeger_tracing_enable", bool, False, A,
+           "record spans in the in-process tracer"),
+    # --- fault injection ----------------------------------------------------
+    Option("heartbeat_inject_failure", float, 0.0, D,
+           "seconds to pretend heartbeats fail (global.yaml.in:865)",
+           runtime=True),
+)

@@ -1,0 +1,142 @@
+"""Performance counters — mirror of src/common/perf_counters.h.
+
+Reference: /root/reference/src/common/perf_counters.h:63 (PerfCounters: a
+contiguous block of typed counters built by PerfCountersBuilder between a
+lower/upper bound enum; types u64 counter, u64 gauge, time, and averages
+(sum+count pairs)), and PerfCountersCollection aggregating every logger in
+the process for `perf dump` on the admin socket.  The mgr scrapes these
+(DaemonServer.cc) — here the prometheus-style text export lives on the
+collection too.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+PERFCOUNTER_U64 = 1
+PERFCOUNTER_TIME = 2
+PERFCOUNTER_LONGRUNAVG = 4
+PERFCOUNTER_COUNTER = 8  # monotonic (vs gauge)
+
+
+@dataclass
+class _Counter:
+    name: str
+    type: int
+    desc: str = ""
+    value: float = 0.0
+    avgcount: int = 0
+
+
+class PerfCounters:
+    """One subsystem's counter block (perf_counters.h:63)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: dict[str, _Counter] = {}
+
+    # -- updates (perf_counters.h inc/dec/set/tinc) --------------------------
+
+    def inc(self, name: str, amount: float = 1) -> None:
+        with self._lock:
+            self._counters[name].value += amount
+
+    def dec(self, name: str, amount: float = 1) -> None:
+        with self._lock:
+            self._counters[name].value -= amount
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._counters[name].value = value
+
+    def tinc(self, name: str, seconds: float) -> None:
+        """Accumulate elapsed time; avg counters also count samples."""
+        with self._lock:
+            c = self._counters[name]
+            c.value += seconds
+            c.avgcount += 1
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters[name].value
+
+    def avgcount(self, name: str) -> int:
+        with self._lock:
+            return self._counters[name].avgcount
+
+    # -- dump ----------------------------------------------------------------
+
+    def dump(self) -> dict[str, object]:
+        with self._lock:
+            out: dict[str, object] = {}
+            for c in self._counters.values():
+                if c.type & PERFCOUNTER_LONGRUNAVG:
+                    out[c.name] = {"avgcount": c.avgcount, "sum": c.value}
+                else:
+                    out[c.name] = c.value
+            return out
+
+
+class PerfCountersBuilder:
+    """Declarative construction (perf_counters.h PerfCountersBuilder)."""
+
+    def __init__(self, name: str):
+        self._pc = PerfCounters(name)
+
+    def add_u64_counter(self, name: str, desc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[name] = _Counter(name, PERFCOUNTER_U64 | PERFCOUNTER_COUNTER, desc)
+        return self
+
+    def add_u64(self, name: str, desc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[name] = _Counter(name, PERFCOUNTER_U64, desc)
+        return self
+
+    def add_time_avg(self, name: str, desc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[name] = _Counter(
+            name, PERFCOUNTER_TIME | PERFCOUNTER_LONGRUNAVG, desc
+        )
+        return self
+
+    def create_perf_counters(self) -> PerfCounters:
+        return self._pc
+
+
+class PerfCountersCollection:
+    """Process-wide registry behind `perf dump` (perf_counters.h
+    PerfCountersCollection; surfaced via the admin socket)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loggers: dict[str, PerfCounters] = {}
+
+    def add(self, pc: PerfCounters) -> None:
+        with self._lock:
+            self._loggers[pc.name] = pc
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._loggers.pop(name, None)
+
+    def dump(self) -> dict[str, dict[str, object]]:
+        with self._lock:
+            return {name: pc.dump() for name, pc in self._loggers.items()}
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format — the mgr prometheus-module /
+        ceph-exporter analog (src/exporter/, src/pybind/mgr/prometheus)."""
+        def sanitize(name: str) -> str:
+            return name.replace(".", "_").replace("-", "_")
+
+        lines: list[str] = []
+        for logger, counters in sorted(self.dump().items()):
+            for cname, val in sorted(counters.items()):
+                metric = f"ceph_tpu_{sanitize(logger)}_{sanitize(cname)}"
+                if isinstance(val, dict):
+                    lines.append(f"{metric}_sum {val['sum']}")
+                    lines.append(f"{metric}_count {val['avgcount']}")
+                else:
+                    lines.append(f"{metric} {val}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,93 @@
+"""Byte/count throttles — mirror of src/common/Throttle.{h,cc}.
+
+Reference: the messenger's per-connection dispatch throttles
+(`ms_dispatch_throttle_bytes`, policy throttles at
+/root/reference/src/ceph_osd.cc:590-594) block producers once in-flight
+bytes/messages exceed a limit and wake them as credit is returned.
+Both a threading variant (for the sharded op path) and an asyncio variant
+(for the messenger) are provided.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class Throttle:
+    """Blocking counting throttle (Throttle.h)."""
+
+    def __init__(self, name: str, limit: int):
+        self.name = name
+        self._limit = limit
+        self._count = 0
+        self._cond = threading.Condition()
+
+    @property
+    def current(self) -> int:
+        with self._cond:
+            return self._count
+
+    def get(self, amount: int = 1) -> None:
+        """Take credit, blocking while over limit (Throttle::get).
+
+        An amount larger than the limit is admitted once current usage
+        drains to zero (the reference's _should_wait lets oversized
+        requests through rather than wedging the dispatch path).
+        """
+        with self._cond:
+            while (
+                self._limit > 0
+                and self._count > 0
+                and self._count + amount > self._limit
+            ):
+                self._cond.wait()
+            self._count += amount
+
+    def get_or_fail(self, amount: int = 1) -> bool:
+        with self._cond:
+            if self._limit > 0 and self._count + amount > self._limit:
+                return False
+            self._count += amount
+            return True
+
+    def put(self, amount: int = 1) -> None:
+        with self._cond:
+            self._count -= amount
+            self._cond.notify_all()
+
+
+class AsyncThrottle:
+    """asyncio counterpart used by the async messenger."""
+
+    def __init__(self, name: str, limit: int):
+        self.name = name
+        self._limit = limit
+        self._count = 0
+        self._cond: asyncio.Condition | None = None
+
+    def _condition(self) -> asyncio.Condition:
+        if self._cond is None:
+            self._cond = asyncio.Condition()
+        return self._cond
+
+    @property
+    def current(self) -> int:
+        return self._count
+
+    async def get(self, amount: int = 1) -> None:
+        cond = self._condition()
+        async with cond:
+            while (
+                self._limit > 0
+                and self._count > 0
+                and self._count + amount > self._limit
+            ):
+                await cond.wait()
+            self._count += amount
+
+    async def put(self, amount: int = 1) -> None:
+        cond = self._condition()
+        async with cond:
+            self._count -= amount
+            cond.notify_all()

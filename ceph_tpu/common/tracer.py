@@ -1,0 +1,103 @@
+"""Span tracing — mirror of src/common/tracer.h + blkin ZTracer.
+
+Reference: /root/reference/src/common/tracer.h:18 (`tracing::Tracer`
+producing `jspan` opentelemetry spans) and the Zipkin/blkin traces
+threaded through the EC data path (every ECBackend::handle_sub_* takes a
+ZTracer::Trace, src/osd/ECBackend.h:64-87, with events like
+`trace.event("start ec write")`, ECBackend.cc:2020).  Spans here are
+in-process records with parent links, timed events, and keyvals,
+exportable as JSON for offline analysis.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    tracer: "Tracer"
+    span_id: int
+    parent_id: int | None
+    name: str
+    start: float = field(default_factory=time.monotonic)
+    end: float | None = None
+    events: list[tuple[float, str]] = field(default_factory=list)
+    tags: dict[str, str] = field(default_factory=dict)
+
+    def event(self, name: str) -> None:
+        """blkin Trace::event."""
+        if self.tracer.enabled:
+            self.events.append((time.monotonic(), name))
+
+    def keyval(self, key: str, val: object) -> None:
+        if self.tracer.enabled:
+            self.tags[key] = str(val)
+
+    def child(self, name: str) -> "Span":
+        return self.tracer.start_span(name, parent=self)
+
+    def finish(self) -> None:
+        self.end = time.monotonic()
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+    def to_dict(self) -> dict:
+        return {
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "events": [{"t": t, "name": n} for t, n in self.events],
+            "tags": self.tags,
+        }
+
+
+class Tracer:
+    """Span factory + in-memory export buffer (tracer.h Tracer::init;
+    disabled tracers hand out no-op spans just like the reference's
+    null jspan)."""
+
+    def __init__(self, service: str = "", enabled: bool = True, max_spans: int = 10000):
+        self.service = service
+        self.enabled = enabled
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._max = max_spans
+
+    def start_span(self, name: str, parent: Span | None = None) -> Span:
+        span = Span(
+            tracer=self,
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent else None,
+            name=name,
+        )
+        if self.enabled:
+            with self._lock:
+                if len(self._spans) < self._max:
+                    self._spans.append(span)
+        return span
+
+    def export(self) -> list[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+NULL_TRACER = Tracer(enabled=False)
+
+
+def null_span(name: str = "") -> Span:
+    return NULL_TRACER.start_span(name)
