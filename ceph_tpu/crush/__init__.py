@@ -1,0 +1,36 @@
+"""CRUSH placement — mirror of /root/reference/src/crush.
+
+Deterministic pseudorandom placement: straw2 buckets, firstn/indep rule
+execution, weight-based rejection (SURVEY.md §1 row 4).  Kept on the CPU
+like the reference keeps it in C (§2.3): placement is latency-bound
+integer hashing, not a TPU workload.  The straw2 selection core also has
+a native C++ implementation (native/crush.cc) that must agree bit-for-bit
+with this Python one (tests/test_crush.py).
+
+All arithmetic is fixed-point integer so Python and C++ agree exactly.
+"""
+
+from .crush import (
+    CRUSH_ITEM_NONE,
+    Bucket,
+    CrushMap,
+    Rule,
+    Step,
+    do_rule,
+)
+from .hash import crush_hash32, crush_hash32_2, crush_hash32_3, str_hash
+from .wrapper import CrushWrapper
+
+__all__ = [
+    "CRUSH_ITEM_NONE",
+    "Bucket",
+    "CrushMap",
+    "CrushWrapper",
+    "Rule",
+    "Step",
+    "crush_hash32",
+    "crush_hash32_2",
+    "crush_hash32_3",
+    "do_rule",
+    "str_hash",
+]

@@ -1,0 +1,41 @@
+"""ctypes bridge to the native CRUSH core (native/crush.cc).
+
+The Python and C++ straw2 implementations must pick identical winners;
+the fixed-point log2 table is generated once in Python (crush.LN16) and
+installed into the native library on first use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from ..utils import native as _native
+from .crush import LN16
+
+
+def lib() -> ctypes.CDLL | None:
+    l = _native.load()
+    if l is None:
+        return None
+    if not l.ceph_tpu_crush_ln_table_set():
+        table = (ctypes.c_int32 * len(LN16))(*LN16)
+        l.ceph_tpu_crush_set_ln_table(table)
+    return l
+
+
+def straw2_choose_native(x: int, r: int, items: list[int], weights: list[int]) -> int | None:
+    """Native straw2 winner; None when the library is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    n = len(items)
+    c_items = (ctypes.c_int32 * n)(*items)
+    c_weights = (ctypes.c_int32 * n)(*weights)
+    return int(l.ceph_tpu_straw2_choose(x, r, c_items, c_weights, n))
+
+
+def hash32_3_native(a: int, b: int, c: int) -> int | None:
+    l = lib()
+    if l is None:
+        return None
+    return int(l.ceph_tpu_crush_hash32_3(a, b, c))

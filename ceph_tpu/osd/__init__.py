@@ -1,0 +1,11 @@
+"""OSD layer — mirror of /root/reference/src/osd.
+
+The data-path daemon and its erasure-coded backend (SURVEY.md §2.2):
+OSDMap (cluster topology + pools + EC profiles), the EC stripe/transaction
+machinery, the RMW write pipeline, recovery, scrub, heartbeats, and the
+op scheduler.
+"""
+
+from .osdmap import Incremental, OSDMap, PgPool, PG_NONE
+
+__all__ = ["Incremental", "OSDMap", "PgPool", "PG_NONE"]

@@ -60,7 +60,28 @@ def load() -> ctypes.CDLL | None:
                 ctypes.c_size_t,
             ]
             lib.ceph_tpu_crc32c_hw_available.restype = ctypes.c_int
+            lib.ceph_tpu_crush_hash32.restype = ctypes.c_uint32
+            lib.ceph_tpu_crush_hash32.argtypes = [ctypes.c_uint32]
+            lib.ceph_tpu_crush_hash32_2.restype = ctypes.c_uint32
+            lib.ceph_tpu_crush_hash32_2.argtypes = [ctypes.c_uint32] * 2
+            lib.ceph_tpu_crush_hash32_3.restype = ctypes.c_uint32
+            lib.ceph_tpu_crush_hash32_3.argtypes = [ctypes.c_uint32] * 3
+            lib.ceph_tpu_crush_set_ln_table.restype = None
+            lib.ceph_tpu_crush_set_ln_table.argtypes = [
+                ctypes.POINTER(ctypes.c_int32)
+            ]
+            lib.ceph_tpu_crush_ln_table_set.restype = ctypes.c_int
+            lib.ceph_tpu_straw2_choose.restype = ctypes.c_int32
+            lib.ceph_tpu_straw2_choose.argtypes = [
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+            ]
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so lacking newer symbols —
+            # degrade to the pure-Python fallbacks like any other failure.
             _load_failed = True
         return _lib

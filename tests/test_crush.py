@@ -1,0 +1,241 @@
+"""CRUSH + OSDMap tests.
+
+Modeled on the reference's src/test/crush/ (CrushWrapper mapping
+invariants, straw2 weight proportionality) and src/test/osd/TestOSDMap.cc
+(pg→osd mapping, erasure pools keeping stable shard holes).
+"""
+
+import collections
+
+import pytest
+
+from ceph_tpu.crush import (
+    CRUSH_ITEM_NONE,
+    CrushWrapper,
+    crush_hash32,
+    crush_hash32_2,
+    crush_hash32_3,
+    str_hash,
+)
+from ceph_tpu.crush.crush import WEIGHT_ONE, bucket_choose, Bucket
+from ceph_tpu.crush.native import hash32_3_native, straw2_choose_native
+from ceph_tpu.osd import Incremental, OSDMap, PG_NONE
+from ceph_tpu.osd.osdmap import POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED
+
+
+# --- hashing -----------------------------------------------------------------
+
+
+class TestHash:
+    def test_deterministic(self):
+        assert crush_hash32(42) == crush_hash32(42)
+        assert crush_hash32_2(1, 2) != crush_hash32_2(2, 1)
+        assert str_hash("foo") == str_hash(b"foo")
+        assert str_hash("foo") != str_hash("fop")
+
+    def test_distribution(self):
+        # Avalanche sanity: low bit of hash over sequential inputs ~ 50/50.
+        ones = sum(crush_hash32(i) & 1 for i in range(4000))
+        assert 1700 < ones < 2300
+
+
+# --- straw2 ------------------------------------------------------------------
+
+
+def make_bucket(weights):
+    return Bucket(
+        id=-1,
+        type_id=1,
+        alg="straw2",
+        items=list(range(len(weights))),
+        weights=[int(w * WEIGHT_ONE) for w in weights],
+    )
+
+
+class TestStraw2:
+    def test_weight_proportional(self):
+        b = make_bucket([1.0, 1.0, 2.0])
+        counts = collections.Counter(
+            bucket_choose(b, x, 0) for x in range(8000)
+        )
+        total = sum(counts.values())
+        assert counts[2] / total == pytest.approx(0.5, abs=0.06)
+        assert counts[0] / total == pytest.approx(0.25, abs=0.05)
+
+    def test_zero_weight_never_chosen(self):
+        b = make_bucket([1.0, 0.0, 1.0])
+        assert all(bucket_choose(b, x, 0) != 1 for x in range(500))
+
+    def test_stability_under_weight_add(self):
+        # straw2's defining property: adding an item only moves inputs
+        # *onto* the new item, never between old items.
+        b3 = make_bucket([1.0, 1.0, 1.0])
+        b4 = make_bucket([1.0, 1.0, 1.0, 1.0])
+        moved_wrong = sum(
+            1
+            for x in range(3000)
+            if bucket_choose(b3, x, 0) != bucket_choose(b4, x, 0)
+            and bucket_choose(b4, x, 0) != 3
+        )
+        assert moved_wrong == 0
+
+
+class TestNativeAgreement:
+    def test_hash_agrees(self):
+        if hash32_3_native(1, 2, 3) is None:
+            pytest.skip("native library unavailable")
+        for a, b, c in [(0, 0, 0), (1, 2, 3), (0xFFFFFFFF, 7, 1 << 31)]:
+            assert hash32_3_native(a, b, c) == crush_hash32_3(a, b, c)
+
+    def test_straw2_agrees(self):
+        b = make_bucket([1.0, 2.5, 0.5, 3.0, 1.0])
+        if straw2_choose_native(0, 0, b.items, b.weights) is None:
+            pytest.skip("native library unavailable")
+        for x in range(2000):
+            py = bucket_choose(b, x, x % 7)
+            cc = straw2_choose_native(x, x % 7, b.items, b.weights)
+            assert py == cc, f"divergence at x={x}"
+
+
+# --- rule execution ----------------------------------------------------------
+
+
+def make_cluster(n_osds=12, per_host=2):
+    cw = CrushWrapper()
+    cw.build_flat(n_osds, per_host)
+    return cw
+
+
+class TestRules:
+    def test_firstn_distinct_hosts(self):
+        cw = make_cluster(12, 2)
+        rid = cw.add_simple_rule("rep", failure_domain="host", mode="firstn")
+        for x in range(300):
+            out = cw.do_rule(rid, x, 3)
+            assert len(out) == 3
+            assert len(set(out)) == 3
+            hosts = {o // 2 for o in out}
+            assert len(hosts) == 3  # one osd per host
+
+    def test_indep_emits_holes_not_shifts(self):
+        cw = make_cluster(12, 2)
+        rid = cw.add_simple_rule("ec", failure_domain="host", mode="indep")
+        x = 17
+        full = cw.do_rule(rid, x, 5)
+        assert len(full) == 5 and PG_NONE not in full
+        # Zero out the first chosen osd's weight: its position must become
+        # a hole or be replaced in place; other positions must not shift.
+        gone = full[2]
+        rew = {gone: 0}
+        degraded = cw.do_rule(rid, x, 5, rew)
+        assert len(degraded) == 5
+        for i, (a, b) in enumerate(zip(full, degraded)):
+            if i != 2:
+                assert a == b, f"position {i} shifted on unrelated failure"
+        assert degraded[2] != gone
+
+    def test_osd_failure_domain(self):
+        cw = make_cluster(6, 6)  # one host: osd-level domains still work
+        rid = cw.add_simple_rule("ec", failure_domain="osd", mode="indep")
+        out = cw.do_rule(rid, 99, 4)
+        assert len(set(out)) == 4
+
+    def test_distribution_across_osds(self):
+        cw = make_cluster(8, 2)
+        rid = cw.add_simple_rule("rep", failure_domain="host", mode="firstn")
+        counts = collections.Counter()
+        for x in range(2000):
+            counts.update(cw.do_rule(rid, x, 2))
+        # Each of 8 equal-weight osds should get ~ 2*2000/8 = 500.
+        for osd in range(8):
+            assert 300 < counts[osd] < 700
+
+
+# --- OSDMap ------------------------------------------------------------------
+
+
+def make_osdmap(n=6, per_host=2):
+    m = OSDMap()
+    m.fsid = "test-fsid"
+    m.epoch = 1
+    m.crush.build_flat(n, per_host)
+    for o in range(n):
+        m.add_osd(o, addr=f"127.0.0.1:{6800 + o}")
+    return m
+
+
+class TestOSDMap:
+    def test_replicated_mapping(self):
+        m = make_osdmap()
+        rid = m.crush.add_simple_rule("rep", mode="firstn")
+        m.create_pool("rbd", POOL_TYPE_REPLICATED, size=3, crush_rule=rid)
+        pool = m.get_pool("rbd")
+        pg = m.object_to_pg(pool.id, "obj1")
+        up, primary, acting, _ = m.pg_to_up_acting_osds(*pg)
+        assert len(up) == 3 and primary == up[0]
+
+    def test_erasure_mapping_holes(self):
+        m = make_osdmap(8, 2)
+        rid = m.crush.add_simple_rule("ec", mode="indep", failure_domain="osd")
+        m.create_pool("ecpool", POOL_TYPE_ERASURE, size=5, crush_rule=rid)
+        pool = m.get_pool("ecpool")
+        up, primary, _, _ = m.pg_to_up_acting_osds(pool.id, 3)
+        assert len(up) == 5
+        victim = next(o for o in up if o != PG_NONE)
+        m.set_osd_state(victim, False)
+        up2, _, _, _ = m.pg_to_up_acting_osds(pool.id, 3)
+        assert up2[up.index(victim)] == PG_NONE
+        for a, b in zip(up, up2):
+            if a != victim:
+                assert a == b
+
+    def test_out_osd_remapped(self):
+        m = make_osdmap(8, 2)
+        rid = m.crush.add_simple_rule("ec", mode="indep", failure_domain="osd")
+        m.create_pool("ecpool", POOL_TYPE_ERASURE, size=4, crush_rule=rid)
+        pool = m.get_pool("ecpool")
+        up, _, _, _ = m.pg_to_up_acting_osds(pool.id, 5)
+        victim = up[1]
+        m.set_osd_weight(victim, 0)  # marked out: CRUSH refills the slot
+        up2, _, _, _ = m.pg_to_up_acting_osds(pool.id, 5)
+        assert up2[1] != victim
+        assert up2[1] != PG_NONE
+
+    def test_encode_decode_roundtrip(self):
+        m = make_osdmap()
+        rid = m.crush.add_simple_rule("ec", mode="indep")
+        m.erasure_code_profiles["default"] = {"plugin": "tpu", "k": "4", "m": "2"}
+        m.create_pool(
+            "ecpool",
+            POOL_TYPE_ERASURE,
+            size=6,
+            crush_rule=rid,
+            erasure_code_profile="default",
+            stripe_width=16384,
+        )
+        m2 = OSDMap.frombytes(m.tobytes())
+        assert m2.epoch == m.epoch
+        assert m2.erasure_code_profiles == m.erasure_code_profiles
+        assert m2.get_pool("ecpool").stripe_width == 16384
+        # Decoded map must produce identical placements.
+        pool = m.get_pool("ecpool")
+        for ps in range(pool.pg_num):
+            assert m.pg_to_up_acting_osds(pool.id, ps) == m2.pg_to_up_acting_osds(
+                pool.id, ps
+            )
+
+    def test_incremental_apply(self):
+        m = make_osdmap()
+        inc = Incremental(epoch=2, new_down=[0], new_weights={1: 0})
+        inc2 = Incremental.frombytes(inc.tobytes())
+        m = inc2.apply_to(m)
+        assert m.epoch == 2
+        assert not m.is_up(0)
+        assert not m.osds[1].in_
+
+    def test_incremental_full_map(self):
+        m = make_osdmap()
+        m.epoch = 5
+        inc = Incremental(epoch=5, full_map=m.tobytes())
+        m2 = inc.apply_to(OSDMap())
+        assert m2.epoch == 5 and len(m2.osds) == len(m.osds)
