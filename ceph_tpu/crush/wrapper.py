@@ -106,6 +106,7 @@ class CrushWrapper:
 
     def encode(self, enc) -> None:
         cmap = self.map
+        enc.u32(cmap.choose_total_tries)
         enc.map_(
             cmap.buckets,
             lambda e, k: e.i64(k),
@@ -136,6 +137,7 @@ class CrushWrapper:
     def decode(cls, dec) -> "CrushWrapper":
         cw = cls()
         cmap = CrushMap()
+        cmap.choose_total_tries = dec.u32()
         cmap.buckets = dec.map_(
             lambda d: d.i64(),
             lambda d: Bucket(

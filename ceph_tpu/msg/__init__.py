@@ -1,0 +1,27 @@
+"""Messenger — mirror of /root/reference/src/msg + src/msg/async.
+
+The distributed communication backend (SURVEY.md §2.5): an async
+event-loop messenger speaking a v2-style segmented, crc32c-protected
+frame protocol, with typed messages, dispatcher chains, per-peer
+policies/throttles, and probabilistic fault injection
+(`ms_inject_socket_failures`).
+
+TPU-native division of labor (§2.5 "TPU-native equivalent"): this
+messenger carries host-level control and chunk traffic between daemons;
+bulk intra-pod data movement rides ICI via JAX collectives
+(ceph_tpu/parallel), which this layer deliberately does NOT reimplement.
+"""
+
+from .message import Message, decode_message, encode_message, message_type
+from .messenger import Connection, Dispatcher, Messenger, Policy
+
+__all__ = [
+    "Connection",
+    "Dispatcher",
+    "Message",
+    "Messenger",
+    "Policy",
+    "decode_message",
+    "encode_message",
+    "message_type",
+]

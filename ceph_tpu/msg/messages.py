@@ -1,0 +1,357 @@
+"""The typed message catalog — mirror of src/messages/.
+
+Reference: /root/reference/src/messages/ (170 versioned classes).  This
+catalog implements the subset the framework's daemons exchange, with the
+EC sub-op messages mirroring ECMsgTypes
+(/root/reference/src/osd/ECMsgTypes.h): ECSubWrite carries a serialized
+per-shard transaction (:23-89); ECSubRead carries per-object
+(off,len,flags) plus per-shard subchunk vectors (:105-116);
+ECSubReadReply returns buffers/attrs/errors (:118-129).
+"""
+
+from __future__ import annotations
+
+from ..common.encoding import Decoder, Encodable, Encoder
+from .message import Message, message_type, PRIO_HIGH
+
+
+class Struct(Message):
+    """A nested wire struct using the same FIELDS machinery as Message
+    (WRITE_CLASS_ENCODER on plain types); never sent standalone."""
+
+
+class PgId(Struct):
+    """spg_t analog: pool + placement seed + shard (-1 = whole PG /
+    replicated)."""
+
+    FIELDS = [("pool", "u64"), ("ps", "u32"), ("shard", "i64")]
+
+    def __init__(self, pool=0, ps=0, shard=-1):
+        super().__init__(pool=pool, ps=ps, shard=shard)
+
+    def key(self) -> tuple[int, int]:
+        return (self.pool, self.ps)
+
+    def with_shard(self, shard: int) -> "PgId":
+        return PgId(self.pool, self.ps, shard)
+
+    def __repr__(self):
+        return f"{self.pool}.{self.ps}s{self.shard}"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, PgId)
+            and (self.pool, self.ps, self.shard)
+            == (other.pool, other.ps, other.shard)
+        )
+
+    def __hash__(self):
+        return hash((self.pool, self.ps, self.shard))
+
+
+class OSDOp(Struct):
+    """One client sub-operation (osd_types.h OSDOp / do_osd_ops codes)."""
+
+    # op codes (CEPH_OSD_OP_* analog)
+    READ = 1
+    WRITE = 2
+    WRITEFULL = 3
+    DELETE = 4
+    STAT = 5
+    TRUNCATE = 6
+    APPEND = 7
+    GETXATTR = 8
+    SETXATTR = 9
+
+    FIELDS = [
+        ("op", "u8"),
+        ("off", "u64"),
+        ("len", "u64"),
+        ("data", "bytes"),
+        ("name", "str"),  # xattr name for *XATTR ops
+    ]
+
+    def __init__(self, op=0, off=0, len=0, data=b"", name=""):
+        super().__init__(op=op, off=off, len=len, data=data, name=name)
+
+
+class ReqId(Struct):
+    """osd_reqid_t: originating entity + client-unique tid."""
+
+    FIELDS = [("client", "str"), ("tid", "u64")]
+
+    def __init__(self, client="", tid=0):
+        super().__init__(client=client, tid=tid)
+
+    def key(self) -> tuple[str, int]:
+        return (self.client, self.tid)
+
+
+class PushOp(Struct):
+    """Recovery push payload (osd_types.h PushOp, carried by MOSDPGPush)."""
+
+    FIELDS = [
+        ("oid", "str"),
+        ("data", "bytes"),
+        ("attrs", ("map", "str", "bytes")),
+        ("version", "u64"),
+    ]
+
+    def __init__(self, oid="", data=b"", attrs=None, version=0):
+        super().__init__(oid=oid, data=data, attrs=attrs or {}, version=version)
+
+
+# --- liveness ----------------------------------------------------------------
+
+
+@message_type(1)
+class MPing(Message):
+    FIELDS = [("stamp", "f64")]
+
+
+@message_type(10)
+class MOSDPing(Message):
+    """OSD<->OSD heartbeat (src/messages/MOSDPing.h; handled at
+    OSD.cc:5463 handle_osd_ping)."""
+
+    PING = 1
+    PING_REPLY = 2
+
+    FIELDS = [("op", "u8"), ("stamp", "f64"), ("epoch", "u32"), ("from_osd", "u32")]
+    priority = PRIO_HIGH
+
+
+# --- client I/O --------------------------------------------------------------
+
+
+@message_type(4)
+class MOSDOp(Message):
+    """Client op to the primary (src/messages/MOSDOp.h)."""
+
+    FIELDS = [
+        ("reqid", ReqId),
+        ("pgid", PgId),
+        ("oid", "str"),
+        ("ops", ("list", OSDOp)),
+        ("epoch", "u32"),
+    ]
+
+
+@message_type(5)
+class MOSDOpReply(Message):
+    """src/messages/MOSDOpReply.h."""
+
+    FIELDS = [
+        ("reqid", ReqId),
+        ("result", "i64"),
+        ("outdata", ("list", "bytes")),  # per-op output
+        ("version", "u64"),
+        ("epoch", "u32"),
+    ]
+
+
+# --- EC sub-ops (ECMsgTypes.h) ----------------------------------------------
+
+
+@message_type(6)
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard write (MOSDECSubOpWrite.h; ECSubWrite at
+    ECMsgTypes.h:23-89).  `txn` is the encoded per-shard ObjectStore
+    transaction; log_entries roll the PG log forward on the shard."""
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("from_osd", "u32"),
+        ("tid", "u64"),
+        ("reqid", ReqId),
+        ("txn", "bytes"),
+        ("at_version", "u64"),
+        ("log_entries", ("list", "bytes")),
+    ]
+    priority = PRIO_HIGH
+
+
+@message_type(7)
+class MOSDECSubOpWriteReply(Message):
+    FIELDS = [
+        ("pgid", PgId),
+        ("from_osd", "u32"),
+        ("tid", "u64"),
+        ("committed", "bool"),
+    ]
+    priority = PRIO_HIGH
+
+
+@message_type(8)
+class MOSDECSubOpRead(Message):
+    """Primary -> shard read (ECSubRead, ECMsgTypes.h:105-116):
+    per-object extent lists plus CLAY subchunk (offset,count) runs."""
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("from_osd", "u32"),
+        ("tid", "u64"),
+        # oid -> list of (off, len) extents
+        ("to_read", ("map", "str", ("list", ("list", "u64")))),
+        # oid -> subchunk (offset, count) runs within each chunk
+        ("subchunks", ("map", "str", ("list", ("list", "u64")))),
+        ("attrs_to_read", ("list", "str")),
+    ]
+    priority = PRIO_HIGH
+
+
+@message_type(9)
+class MOSDECSubOpReadReply(Message):
+    """ECSubReadReply (ECMsgTypes.h:118-129): buffers + attrs + errors."""
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("from_osd", "u32"),
+        ("tid", "u64"),
+        # oid -> list of (off, data) returned extents
+        ("buffers", ("map", "str", ("list", ("list", "bytes")))),
+        ("attrs", ("map", "str", ("map", "str", "bytes"))),
+        ("errors", ("map", "str", "i64")),
+    ]
+    priority = PRIO_HIGH
+
+
+# --- cluster membership ------------------------------------------------------
+
+
+@message_type(11)
+class MOSDBoot(Message):
+    """OSD -> mon boot announcement (src/messages/MOSDBoot.h)."""
+
+    FIELDS = [("osd", "u32"), ("addr", "str"), ("epoch", "u32")]
+
+
+@message_type(12)
+class MOSDFailure(Message):
+    """OSD -> mon failure report (src/messages/MOSDFailure.h; quorum
+    checked at OSDMonitor.cc:2791 prepare_failure)."""
+
+    FIELDS = [
+        ("target", "u32"),
+        ("target_addr", "str"),
+        ("failed_for", "f64"),
+        ("epoch", "u32"),
+    ]
+
+
+@message_type(13)
+class MOSDMap(Message):
+    """Map publication (src/messages/MOSDMap.h): full maps and/or
+    incrementals keyed by epoch."""
+
+    FIELDS = [
+        ("fsid", "str"),
+        ("maps", ("map", "u32", "bytes")),
+        ("incrementals", ("map", "u32", "bytes")),
+    ]
+
+
+# --- mon ---------------------------------------------------------------------
+
+
+@message_type(14)
+class MMonCommand(Message):
+    """CLI/admin command (src/messages/MMonCommand.h); cmd is the JSON
+    command blob like the reference's cmdmap."""
+
+    FIELDS = [("tid", "u64"), ("cmd", "str")]
+
+
+@message_type(15)
+class MMonCommandAck(Message):
+    FIELDS = [("tid", "u64"), ("retval", "i64"), ("rs", "str"), ("outbl", "bytes")]
+
+
+@message_type(16)
+class MMonSubscribe(Message):
+    """Subscriptions (src/messages/MMonSubscribe.h): what -> start epoch;
+    the mon pushes updates (osdmap) as they commit."""
+
+    FIELDS = [("what", ("map", "str", "u32"))]
+
+
+@message_type(17)
+class MMonPaxos(Message):
+    """Paxos protocol (src/messages/MMonPaxos.h)."""
+
+    OP_COLLECT = 1
+    OP_LAST = 2
+    OP_BEGIN = 3
+    OP_ACCEPT = 4
+    OP_COMMIT = 5
+    OP_LEASE = 6
+
+    FIELDS = [
+        ("op", "u8"),
+        ("pn", "u64"),
+        ("last_committed", "u64"),
+        ("values", ("map", "u64", "bytes")),
+    ]
+    priority = PRIO_HIGH
+
+
+@message_type(18)
+class MMonElection(Message):
+    """Mon elections (src/messages/MMonElection.h / ElectionLogic)."""
+
+    OP_PROPOSE = 1
+    OP_ACK = 2
+    OP_VICTORY = 3
+
+    FIELDS = [("op", "u8"), ("epoch", "u64"), ("rank", "u32")]
+    priority = PRIO_HIGH
+
+
+# --- peering / recovery ------------------------------------------------------
+
+
+@message_type(19)
+class MOSDPGQuery(Message):
+    """Primary asks a shard for its pg_info (src/messages/MOSDPGQuery.h)."""
+
+    FIELDS = [("pgid", PgId), ("epoch", "u32"), ("from_osd", "u32")]
+
+
+@message_type(20)
+class MOSDPGNotify(Message):
+    """Shard replies with pg_info (src/messages/MOSDPGNotify.h)."""
+
+    FIELDS = [("pgid", PgId), ("info", "bytes"), ("epoch", "u32"), ("from_osd", "u32")]
+
+
+@message_type(21)
+class MOSDPGLog(Message):
+    FIELDS = [
+        ("pgid", PgId),
+        ("info", "bytes"),
+        ("log", "bytes"),
+        ("epoch", "u32"),
+        ("from_osd", "u32"),
+    ]
+
+
+@message_type(22)
+class MOSDPGPush(Message):
+    """Recovery pushes (src/messages/MOSDPGPush.h → §3.2 WRITING)."""
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("pushes", ("list", PushOp)),
+        ("epoch", "u32"),
+        ("from_osd", "u32"),
+    ]
+
+
+@message_type(23)
+class MOSDPGPushReply(Message):
+    FIELDS = [
+        ("pgid", PgId),
+        ("oids", ("list", "str")),
+        ("epoch", "u32"),
+        ("from_osd", "u32"),
+    ]

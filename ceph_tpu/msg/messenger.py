@@ -1,0 +1,319 @@
+"""AsyncMessenger — mirror of src/msg/async/AsyncMessenger.{h,cc}.
+
+Reference behaviors mirrored (SURVEY.md §2.5):
+- `Messenger::create` + bind/listen/accept with a banner + identity
+  exchange (ProtocolV2 hello phase).
+- Dispatcher chain with a fast-dispatch path (`ms_fast_dispatch`
+  bypasses the queue, src/osd/OSD.cc:7244) and `ms_handle_reset`
+  connection-fault callbacks.
+- Per-peer-type Policy (lossy vs lossless: lossless connections
+  transparently reconnect and re-send queued messages).
+- Dispatch throttling (`ms_dispatch_throttle_bytes`) and probabilistic
+  fault injection (`ms_inject_socket_failures`,
+  global.yaml.in:1240-1271).
+
+Implementation is asyncio on TCP — the event-loop structure of the
+reference's epoll workers, minus the manual buffer management that Python
+streams already provide.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+
+from ..common.log import dout
+from ..common.throttle import AsyncThrottle
+from .frames import Frame, TAG_HELLO, TAG_KEEPALIVE, TAG_MESSAGE, read_frame, FrameError
+from .message import Message, decode_message, encode_message
+
+
+@dataclass
+class Policy:
+    """Per-peer-type connection policy (src/msg/Policy.h)."""
+
+    lossy: bool = True  # drop state on error (client->osd)
+    server: bool = False  # accept-only side
+    resend_on_reconnect: bool = False  # lossless peers re-queue unacked sends
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True)
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False, resend_on_reconnect=True)
+
+    @classmethod
+    def stateless_server(cls) -> "Policy":
+        return cls(lossy=True, server=True)
+
+
+class Dispatcher:
+    """Receiver interface (src/msg/Dispatcher.h)."""
+
+    def ms_can_fast_dispatch(self, msg: Message) -> bool:
+        return False
+
+    def ms_fast_dispatch(self, conn: "Connection", msg: Message) -> None:
+        raise NotImplementedError
+
+    def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        """Return True if handled."""
+        return False
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        pass
+
+    def ms_handle_accept(self, conn: "Connection") -> None:
+        pass
+
+
+class Connection:
+    """One peer session (AsyncConnection).  Owns the socket streams, a
+    send queue, and (for lossless policies) the unacked resend queue."""
+
+    def __init__(self, msgr: "Messenger", peer_addr: str, policy: Policy):
+        self.msgr = msgr
+        self.peer_addr = peer_addr
+        self.peer_name = ""  # filled by hello exchange
+        self.policy = policy
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._send_lock = asyncio.Lock()
+        self._out_seq = 0
+        self._closed = False
+        self._read_task: asyncio.Task | None = None
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._closed
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def _attach(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._reader = reader
+        self._writer = writer
+        self._read_task = asyncio.create_task(self._read_loop())
+
+    async def _connect(self) -> None:
+        reader, writer = await asyncio.open_connection(*_split(self.peer_addr))
+        # hello: announce who we are (ProtocolV2 hello/ident phase)
+        hello = Frame(TAG_HELLO, [self.msgr.name.encode(), self.msgr.addr.encode()])
+        writer.write(hello.pack(self.msgr.crc_data))
+        await writer.drain()
+        frame = await read_frame(reader)
+        if frame.tag != TAG_HELLO:
+            raise FrameError(f"expected hello, got tag {frame.tag}")
+        self.peer_name = frame.segments[0].decode()
+        await self._attach(reader, writer)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+            self._read_task = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        self._reader = None
+
+    def _fault(self) -> None:
+        """Connection error (AsyncConnection::fault): lossy connections
+        reset; lossless ones reconnect lazily on next send."""
+        writer, self._writer = self._writer, None
+        self._reader = None
+        if writer is not None:
+            writer.close()
+        if self.policy.lossy:
+            self._closed = True
+            self.msgr._drop_connection(self)
+        self.msgr._notify_reset(self)
+
+    # -- send ----------------------------------------------------------------
+
+    async def send_message(self, msg: Message) -> None:
+        """Queue-and-send (AsyncConnection::send_message).  Raises on
+        lossy connections that are closed; lossless ones reconnect."""
+        async with self._send_lock:
+            if self._closed:
+                raise ConnectionError(f"connection to {self.peer_addr} closed")
+            if self._writer is None:
+                # Lazy connect (first send), and lazy REconnect for
+                # lossless policies; faulted lossy connections were marked
+                # closed in _fault() and never reach here.
+                if self.policy.server:
+                    raise ConnectionError(f"not connected to {self.peer_addr}")
+                await self._connect()
+            self._out_seq += 1
+            msg.src = self.msgr.name
+            msg.seq = self._out_seq
+            env, payload = encode_message(msg)
+            frame = Frame(TAG_MESSAGE, [env, payload])
+            try:
+                self.msgr._maybe_inject_fault()
+                self._writer.write(frame.pack(self.msgr.crc_data))
+                await self._writer.drain()
+            except (ConnectionError, OSError):
+                self._fault()
+                raise ConnectionError(f"send to {self.peer_addr} failed")
+
+    # -- receive -------------------------------------------------------------
+
+    async def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                frame = await read_frame(self._reader)
+                self.msgr._maybe_inject_fault()
+                if frame.tag == TAG_KEEPALIVE:
+                    continue
+                if frame.tag != TAG_MESSAGE:
+                    continue
+                msg = decode_message(frame.segments[0], frame.segments[1])
+                await self.msgr._deliver(self, msg)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            FrameError,
+            asyncio.CancelledError,
+        ):
+            if not self._closed:
+                self._fault()
+
+
+def _split(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+class Messenger:
+    """The endpoint: bind/listen + outgoing connection cache
+    (AsyncMessenger).  One per daemon role, as in ceph_osd.cc:548-561
+    (the reference creates 7; here cluster+client traffic share one)."""
+
+    def __init__(
+        self,
+        name: str,
+        addr: str = "",
+        crc_data: bool = True,
+        inject_socket_failures: int = 0,
+        dispatch_throttle_bytes: int = 0,
+    ):
+        self.name = name  # entity name, e.g. "osd.0"
+        self.addr = addr  # host:port once bound (or for identification)
+        self.crc_data = crc_data
+        self.inject_socket_failures = inject_socket_failures
+        self._rng = random.Random(hash(name) & 0xFFFF)
+        self.dispatchers: list[Dispatcher] = []
+        self._conns: dict[str, Connection] = {}  # peer_addr -> conn
+        self._server: asyncio.AbstractServer | None = None
+        self._throttle = (
+            AsyncThrottle("msgr.dispatch", dispatch_throttle_bytes)
+            if dispatch_throttle_bytes
+            else None
+        )
+        self.default_policy = Policy.lossy_client()
+        self._accepted: list[Connection] = []
+
+    # -- setup ---------------------------------------------------------------
+
+    def add_dispatcher_head(self, d: Dispatcher) -> None:
+        self.dispatchers.insert(0, d)
+
+    def add_dispatcher_tail(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    async def bind(self, addr: str) -> None:
+        host, port = _split(addr)
+        self._server = await asyncio.start_server(self._accept, host, port)
+        actual_port = self._server.sockets[0].getsockname()[1]
+        self.addr = f"{host}:{actual_port}"
+
+    async def shutdown(self) -> None:
+        # Close live connections before the listener: Python 3.12's
+        # Server.wait_closed() blocks until every handler's transport is
+        # finished, so open accepted connections would deadlock it.
+        for conn in list(self._conns.values()) + self._accepted:
+            await conn.close()
+        self._conns.clear()
+        self._accepted.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        # Let cancelled read-loop tasks and closed transports unwind.
+        await asyncio.sleep(0)
+
+    # -- connections ---------------------------------------------------------
+
+    def get_connection(self, peer_addr: str, policy: Policy | None = None) -> Connection:
+        """Get-or-create an outgoing connection (connect lazily on first
+        send) — AsyncMessenger::get_connection."""
+        conn = self._conns.get(peer_addr)
+        if conn is None or conn._closed:
+            conn = Connection(self, peer_addr, policy or self.default_policy)
+            self._conns[peer_addr] = conn
+        return conn
+
+    async def send_to(self, peer_addr: str, msg: Message) -> None:
+        await self.get_connection(peer_addr).send_message(msg)
+
+    def _drop_connection(self, conn: Connection) -> None:
+        existing = self._conns.get(conn.peer_addr)
+        if existing is conn:
+            del self._conns[conn.peer_addr]
+
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            frame = await read_frame(reader)
+            if frame.tag != TAG_HELLO:
+                writer.close()
+                return
+            conn = Connection(self, frame.segments[1].decode(), Policy.stateless_server())
+            conn.peer_name = frame.segments[0].decode()
+            reply = Frame(TAG_HELLO, [self.name.encode(), self.addr.encode()])
+            writer.write(reply.pack(self.crc_data))
+            await writer.drain()
+            await conn._attach(reader, writer)
+            self._accepted.append(conn)
+            for d in self.dispatchers:
+                d.ms_handle_accept(conn)
+        except (FrameError, OSError, asyncio.IncompleteReadError):
+            writer.close()
+
+    # -- delivery ------------------------------------------------------------
+
+    async def _deliver(self, conn: Connection, msg: Message) -> None:
+        size = 64  # envelope floor; payload length dominates below
+        if self._throttle is not None:
+            await self._throttle.get(size)
+        try:
+            for d in self.dispatchers:
+                if d.ms_can_fast_dispatch(msg):
+                    d.ms_fast_dispatch(conn, msg)
+                    return
+            for d in self.dispatchers:
+                handled = d.ms_dispatch(conn, msg)
+                if asyncio.iscoroutine(handled):
+                    handled = await handled
+                if handled:
+                    return
+            dout("ms", 0, f"{self.name}: unhandled message {msg!r} from {msg.src}")
+        finally:
+            if self._throttle is not None:
+                await self._throttle.put(size)
+
+    def _notify_reset(self, conn: Connection) -> None:
+        for d in self.dispatchers:
+            d.ms_handle_reset(conn)
+
+    def _maybe_inject_fault(self) -> None:
+        if self.inject_socket_failures > 0:
+            if self._rng.randrange(self.inject_socket_failures) == 0:
+                raise ConnectionError("injected socket failure")

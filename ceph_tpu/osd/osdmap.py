@@ -169,6 +169,8 @@ class OSDMap(Encodable):
         flags: int = 0,
         fast_read: bool = False,
     ) -> PgPool:
+        if name in self.pool_name_to_id:
+            raise ValueError(f"pool {name} exists")
         pid = max(self.pools, default=0) + 1
         pool = PgPool(
             id=pid,
@@ -314,7 +316,12 @@ class Incremental(Encodable):
         """OSDMap::apply_incremental; deltas must be the successor epoch
         (the reference asserts inc.epoch == epoch + 1)."""
         if self.full_map:
-            return OSDMap.frombytes(self.full_map)
+            new_map = OSDMap.frombytes(self.full_map)
+            if new_map.epoch < osdmap.epoch:
+                raise ValueError(
+                    f"stale full map epoch {new_map.epoch} < current {osdmap.epoch}"
+                )
+            return new_map
         if self.epoch != osdmap.epoch + 1:
             raise ValueError(
                 f"incremental epoch {self.epoch} != map epoch {osdmap.epoch} + 1"

@@ -80,7 +80,8 @@ int32_t ceph_tpu_straw2_choose(uint32_t x, uint32_t r, const int32_t* items,
     if (weights[i] <= 0) continue;
     uint32_t u =
         ceph_tpu_crush_hash32_3(x, static_cast<uint32_t>(items[i]), r) & 0xffff;
-    int64_t draw = (static_cast<int64_t>(g_ln16[u]) << 16) / weights[i];
+    // multiply, not <<: left-shifting a negative int64 is UB pre-C++20
+    int64_t draw = (static_cast<int64_t>(g_ln16[u]) * 65536) / weights[i];
     if (!have_best || draw > best_draw) {
       have_best = true;
       best_draw = draw;

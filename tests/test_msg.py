@@ -1,0 +1,271 @@
+"""Messenger tests: frames, typed messages, loopback dispatch, policies,
+fault injection.
+
+Modeled on src/test/msgr/test_msgr.cc (SimpleMessenger/AsyncMessenger
+exchange tests) and the frames_v2 unit tests (src/test/msgr/test_frames_v2.cc).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import Dispatcher, Messenger, Policy
+from ceph_tpu.msg.frames import (
+    Frame,
+    FrameError,
+    TAG_MESSAGE,
+    preamble_info,
+    PREAMBLE_SIZE,
+)
+from ceph_tpu.msg.message import decode_message, encode_message
+from ceph_tpu.msg.messages import (
+    MOSDECSubOpRead,
+    MOSDECSubOpReadReply,
+    MOSDOp,
+    MOSDPing,
+    MPing,
+    OSDOp,
+    PgId,
+    ReqId,
+)
+
+
+# --- frames ------------------------------------------------------------------
+
+
+class TestFrames:
+    def test_pack_and_parse_preamble(self):
+        f = Frame(TAG_MESSAGE, [b"header", b"payload-bytes"])
+        wire = f.pack()
+        tag, flags, lens = preamble_info(wire[:PREAMBLE_SIZE])
+        assert tag == TAG_MESSAGE
+        assert lens == [6, 13]
+
+    def test_corrupt_preamble_detected(self):
+        wire = bytearray(Frame(TAG_MESSAGE, [b"x"]).pack())
+        wire[3] ^= 0xFF
+        with pytest.raises(FrameError):
+            preamble_info(bytes(wire[:PREAMBLE_SIZE]))
+
+    def test_corrupt_segment_detected(self):
+        async def run():
+            wire = bytearray(Frame(TAG_MESSAGE, [b"header", b"payload"]).pack())
+            wire[PREAMBLE_SIZE + 2] ^= 0x01  # flip a bit in segment 0
+            reader = asyncio.StreamReader()
+            reader.feed_data(bytes(wire))
+            reader.feed_eof()
+            from ceph_tpu.msg.frames import read_frame
+
+            with pytest.raises(FrameError, match="crc mismatch"):
+                await read_frame(reader)
+
+        asyncio.run(run())
+
+
+# --- message codec -----------------------------------------------------------
+
+
+class TestMessages:
+    def test_mosdop_roundtrip(self):
+        msg = MOSDOp(
+            reqid=ReqId("client.1", 42),
+            pgid=PgId(3, 7, -1),
+            oid="obj-1",
+            ops=[
+                OSDOp(OSDOp.WRITE, off=4096, len=3, data=b"abc"),
+                OSDOp(OSDOp.READ, off=0, len=100),
+            ],
+            epoch=9,
+        )
+        msg.src = "client.1"
+        msg.seq = 5
+        env, payload = encode_message(msg)
+        back = decode_message(env, payload)
+        assert isinstance(back, MOSDOp)
+        assert back.src == "client.1" and back.seq == 5
+        assert back.reqid.key() == ("client.1", 42)
+        assert back.pgid == PgId(3, 7, -1)
+        assert back.ops[0].data == b"abc"
+        assert back.ops[1].op == OSDOp.READ
+
+    def test_ec_subread_roundtrip(self):
+        msg = MOSDECSubOpRead(
+            pgid=PgId(1, 2, 4),
+            from_osd=0,
+            tid=77,
+            to_read={"o1": [[0, 4096], [8192, 4096]]},
+            subchunks={"o1": [[0, 2]]},
+            attrs_to_read=["hinfo_key"],
+        )
+        env, payload = encode_message(msg)
+        back = decode_message(env, payload)
+        assert back.to_read["o1"][1] == [8192, 4096]
+        assert back.subchunks["o1"] == [[0, 2]]
+
+    def test_reply_with_buffers(self):
+        msg = MOSDECSubOpReadReply(
+            pgid=PgId(1, 2, 0),
+            from_osd=3,
+            tid=1,
+            buffers={"o1": [[0, b"\x00" * 16]]},
+            attrs={"o1": {"hinfo_key": b"hi"}},
+            errors={"o2": -5},
+        )
+        env, payload = encode_message(msg)
+        back = decode_message(env, payload)
+        assert back.buffers["o1"][0][1] == b"\x00" * 16
+        assert back.errors["o2"] == -5
+
+
+# --- messenger loopback ------------------------------------------------------
+
+
+class Collector(Dispatcher):
+    def __init__(self, fast_types=()):
+        self.messages = []
+        self.fast = []
+        self.resets = 0
+        self.fast_types = fast_types
+        self.got = asyncio.Event()
+
+    def ms_can_fast_dispatch(self, msg):
+        return isinstance(msg, self.fast_types)
+
+    def ms_fast_dispatch(self, conn, msg):
+        self.fast.append(msg)
+        self.got.set()
+
+    def ms_dispatch(self, conn, msg):
+        self.messages.append((conn, msg))
+        self.got.set()
+        return True
+
+    def ms_handle_reset(self, conn):
+        self.resets += 1
+
+
+async def make_pair(**server_kw):
+    server = Messenger("osd.0", **server_kw)
+    coll = Collector(fast_types=(MOSDPing,))
+    server.add_dispatcher_tail(coll)
+    await server.bind("127.0.0.1:0")
+    client = Messenger("client.1")
+    return server, coll, client
+
+
+class TestMessenger:
+    def test_send_and_dispatch(self):
+        async def run():
+            server, coll, client = await make_pair()
+            await client.send_to(server.addr, MPing(stamp=1.5))
+            await asyncio.wait_for(coll.got.wait(), 5)
+            conn, msg = coll.messages[0]
+            assert isinstance(msg, MPing) and msg.stamp == 1.5
+            assert msg.src == "client.1"
+            assert conn.peer_name == "client.1"
+            await client.shutdown()
+            await server.shutdown()
+
+        asyncio.run(run())
+
+    def test_fast_dispatch_path(self):
+        async def run():
+            server, coll, client = await make_pair()
+            await client.send_to(
+                server.addr, MOSDPing(op=MOSDPing.PING, stamp=0.0, epoch=1, from_osd=4)
+            )
+            await asyncio.wait_for(coll.got.wait(), 5)
+            assert len(coll.fast) == 1 and not coll.messages
+            await client.shutdown()
+            await server.shutdown()
+
+        asyncio.run(run())
+
+    def test_bidirectional_over_accepted_conn(self):
+        # The primary "replies" over the accepted connection — the pattern
+        # every sub-op reply uses.
+        async def run():
+            server, coll, client = await make_pair()
+            client_coll = Collector()
+            client.add_dispatcher_tail(client_coll)
+            await client.send_to(server.addr, MPing(stamp=1.0))
+            await asyncio.wait_for(coll.got.wait(), 5)
+            conn, _ = coll.messages[0]
+            await conn.send_message(MPing(stamp=2.0))
+            await asyncio.wait_for(client_coll.got.wait(), 5)
+            _, reply = client_coll.messages[0]
+            assert reply.stamp == 2.0 and reply.src == "osd.0"
+            await client.shutdown()
+            await server.shutdown()
+
+        asyncio.run(run())
+
+    def test_seq_numbers_increase(self):
+        async def run():
+            server, coll, client = await make_pair()
+            for i in range(3):
+                coll.got.clear()
+                await client.send_to(server.addr, MPing(stamp=float(i)))
+                await asyncio.wait_for(coll.got.wait(), 5)
+            seqs = [m.seq for _, m in coll.messages]
+            assert seqs == [1, 2, 3]
+            await client.shutdown()
+            await server.shutdown()
+
+        asyncio.run(run())
+
+    def test_lossless_reconnects_after_server_restart(self):
+        async def run():
+            server, coll, client = await make_pair()
+            addr = server.addr
+            conn = client.get_connection(addr, Policy.lossless_peer())
+            await conn.send_message(MPing(stamp=1.0))
+            await asyncio.wait_for(coll.got.wait(), 5)
+            # kill and rebind the server on the same port
+            await server.shutdown()
+            server2 = Messenger("osd.0")
+            coll2 = Collector()
+            server2.add_dispatcher_tail(coll2)
+            await server2.bind(addr)
+            # allow the client read loop to observe the reset
+            await asyncio.sleep(0.1)
+            await conn.send_message(MPing(stamp=2.0))
+            await asyncio.wait_for(coll2.got.wait(), 5)
+            assert coll2.messages[0][1].stamp == 2.0
+            await client.shutdown()
+            await server2.shutdown()
+
+        asyncio.run(run())
+
+    def test_injected_socket_failures_surface_as_connection_errors(self):
+        async def run():
+            server, coll, client = await make_pair()
+            client.inject_socket_failures = 2  # 1-in-2 sends fail
+            failures = 0
+            for i in range(20):
+                try:
+                    conn = client.get_connection(server.addr, Policy.lossless_peer())
+                    await conn.send_message(MPing(stamp=float(i)))
+                except ConnectionError:
+                    failures += 1
+            assert failures > 2
+            await client.shutdown()
+            await server.shutdown()
+
+        asyncio.run(run())
+
+    def test_lossy_connection_stays_dead(self):
+        async def run():
+            server, coll, client = await make_pair()
+            conn = client.get_connection(server.addr, Policy.lossy_client())
+            await conn.send_message(MPing(stamp=1.0))
+            await conn.close()
+            with pytest.raises(ConnectionError):
+                await conn.send_message(MPing(stamp=2.0))
+            # but the messenger hands out a fresh connection
+            conn2 = client.get_connection(server.addr)
+            assert conn2 is not conn
+            await client.shutdown()
+            await server.shutdown()
+
+        asyncio.run(run())
