@@ -1,0 +1,29 @@
+"""Object store — mirror of /root/reference/src/os + src/kv.
+
+Transactions-as-values applied atomically to collections of objects
+(SURVEY.md §2.6): `Transaction` is an encodable op list, collections are
+PG shards (coll_t(spg_t(pgid, shard))), and stores implement the
+`ObjectStore` contract (queue_transactions / read / getattr / omap).
+
+Backends: `MemStore` (the in-RAM store the reference's unit tests run
+against, src/os/memstore/) and `FileStore` (a minimal persistent store —
+object data in flat files + a log-structured KV for metadata, standing in
+for BlueStore's block+RocksDB split).
+"""
+
+from .kv import FileKV, KeyValueDB, MemKV
+from .memstore import MemStore
+from .filestore import FileStore
+from .objectstore import ObjectStore, StoreError
+from .transaction import Transaction
+
+__all__ = [
+    "FileKV",
+    "FileStore",
+    "KeyValueDB",
+    "MemKV",
+    "MemStore",
+    "ObjectStore",
+    "StoreError",
+    "Transaction",
+]

@@ -1,0 +1,153 @@
+"""KeyValueDB — mirror of src/kv/KeyValueDB.h.
+
+Reference: the abstraction BlueStore and the mon store sit on (RocksDB via
+src/kv/RocksDBStore.h).  Two backends here: `MemKV` (sorted dict) and
+`FileKV`, a log-structured persistent store — an append-only record log
+replayed at open and compacted when garbage dominates, standing in for
+RocksDB's WAL+SST mechanics at the scale this framework needs (mon
+state, PG metadata, store metadata).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+from ..utils.crc32c import crc32c
+
+
+class KeyValueDB:
+    """get/set/rm over (prefix, key) pairs with ordered iteration
+    (KeyValueDB.h Transaction/Iterator surface, flattened)."""
+
+    def get(self, prefix: str, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def set(self, prefix: str, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def rm(self, prefix: str, key: str) -> None:
+        raise NotImplementedError
+
+    def iterate(self, prefix: str) -> Iterator[tuple[str, bytes]]:
+        """Sorted (key, value) pairs under a prefix."""
+        raise NotImplementedError
+
+    def set_batch(self, prefix: str, kv: dict[str, bytes]) -> None:
+        for k, v in kv.items():
+            self.set(prefix, k, v)
+
+    def close(self) -> None:
+        pass
+
+
+class _DictKV(KeyValueDB):
+    """Shared dict-backed read side for both backends."""
+
+    def __init__(self) -> None:
+        self._data: dict[tuple[str, str], bytes] = {}
+
+    def get(self, prefix: str, key: str) -> bytes | None:
+        return self._data.get((prefix, key))
+
+    def iterate(self, prefix: str) -> Iterator[tuple[str, bytes]]:
+        for (p, k) in sorted(self._data):
+            if p == prefix:
+                yield k, self._data[(p, k)]
+
+
+class MemKV(_DictKV):
+    def set(self, prefix: str, key: str, value: bytes) -> None:
+        self._data[(prefix, key)] = bytes(value)
+
+    def rm(self, prefix: str, key: str) -> None:
+        self._data.pop((prefix, key), None)
+
+
+# FileKV record: u8 op (1=set, 2=rm) | u32 klen | u32 vlen | key | value | crc32c
+_HEAD = struct.Struct("<BII")
+
+
+class FileKV(_DictKV):
+    """Append-only log KV with replay-on-open and threshold compaction.
+
+    Torn tails (a crash mid-append) are detected by the per-record crc
+    and truncated away on open — the WAL property BlueFS/RocksDB provide
+    the reference (SURVEY.md §5 checkpoint/resume).
+    """
+
+    COMPACT_RATIO = 4  # compact when log records > live keys * ratio
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._records = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._replay()
+        self._f = open(self.path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good_end = 0
+        with open(self.path, "rb") as f:
+            buf = f.read()
+        off = 0
+        while off + _HEAD.size <= len(buf):
+            op, klen, vlen = _HEAD.unpack_from(buf, off)
+            end = off + _HEAD.size + klen + vlen + 4
+            if op not in (1, 2) or end > len(buf):
+                break
+            rec = buf[off : end - 4]
+            (crc,) = struct.unpack_from("<I", buf, end - 4)
+            if crc32c(rec) != crc:
+                break  # torn tail
+            key = buf[off + _HEAD.size : off + _HEAD.size + klen].decode()
+            prefix, _, k = key.partition("\x00")
+            if op == 1:
+                self._data[(prefix, k)] = buf[off + _HEAD.size + klen : end - 4]
+            else:
+                self._data.pop((prefix, k), None)
+            self._records += 1
+            good_end = end
+            off = end
+        if good_end < len(buf):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+
+    def _append(self, op: int, prefix: str, key: str, value: bytes) -> None:
+        kb = f"{prefix}\x00{key}".encode()
+        rec = _HEAD.pack(op, len(kb), len(value)) + kb + value
+        self._f.write(rec + struct.pack("<I", crc32c(rec)))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._records += 1
+        if self._records > max(len(self._data), 16) * self.COMPACT_RATIO:
+            self._compact()
+
+    def _compact(self) -> None:
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            for (prefix, k), v in sorted(self._data.items()):
+                kb = f"{prefix}\x00{k}".encode()
+                rec = _HEAD.pack(1, len(kb), len(v)) + kb + v
+                f.write(rec + struct.pack("<I", crc32c(rec)))
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._records = len(self._data)
+
+    def set(self, prefix: str, key: str, value: bytes) -> None:
+        self._data[(prefix, key)] = bytes(value)
+        self._append(1, prefix, key, bytes(value))
+
+    def rm(self, prefix: str, key: str) -> None:
+        if (prefix, key) in self._data:
+            del self._data[(prefix, key)]
+            self._append(2, prefix, key, b"")
+
+    def close(self) -> None:
+        self._f.close()
