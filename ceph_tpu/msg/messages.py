@@ -355,3 +355,34 @@ class MOSDPGPushReply(Message):
         ("epoch", "u32"),
         ("from_osd", "u32"),
     ]
+
+
+@message_type(24)
+class MOSDRepOp(Message):
+    """Primary -> replica transaction for replicated pools
+    (src/messages/MOSDRepOp.h; fanned out by
+    ReplicatedBackend::submit_transaction)."""
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("from_osd", "u32"),
+        ("tid", "u64"),
+        ("reqid", ReqId),
+        ("txn", "bytes"),
+        ("log_entries", ("list", "bytes")),
+    ]
+    priority = PRIO_HIGH
+
+
+@message_type(25)
+class MOSDRepOpReply(Message):
+    FIELDS = [("pgid", PgId), ("from_osd", "u32"), ("tid", "u64")]
+    priority = PRIO_HIGH
+
+
+@message_type(26)
+class MOSDPGPull(Message):
+    """Primary asks a replica to push an object it is itself missing
+    (src/messages/MOSDPGPull.h)."""
+
+    FIELDS = [("pgid", PgId), ("oid", "str"), ("epoch", "u32"), ("from_osd", "u32")]
