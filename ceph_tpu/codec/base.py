@@ -24,9 +24,7 @@ import numpy as np
 
 from .interface import EcError, ErasureCodeInterface, Profile
 
-EINVAL = 22
-EIO = 5
-ENOENT = 2
+from ..common.errs import EINVAL, EIO, ENOENT  # noqa: F401 (historic home)
 
 
 class ErasureCode(ErasureCodeInterface):

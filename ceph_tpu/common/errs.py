@@ -1,0 +1,11 @@
+"""Shared errno constants — the reference returns negative errnos across
+every subsystem boundary (codec, objectstore, mon commands); naming them in
+one place keeps errno audits greppable."""
+
+ENOENT = 2
+EIO = 5
+EAGAIN = 11
+EINVAL = 22
+EEXIST = 17
+EXDEV = 18
+ETIMEDOUT = 110

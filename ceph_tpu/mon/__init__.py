@@ -1,0 +1,12 @@
+"""Monitor control plane — mirror of src/mon/.
+
+Paxos-replicated cluster maps, mon elections, EC-profile administration,
+and map publication to subscribers (SURVEY.md §2.7).
+"""
+
+from .elector import Elector
+from .monmap import MonMap
+from .monitor import Monitor
+from .paxos import Paxos
+
+__all__ = ["Elector", "MonMap", "Monitor", "Paxos"]

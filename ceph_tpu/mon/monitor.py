@@ -1,0 +1,267 @@
+"""Monitor daemon — mirror of src/mon/Monitor.{h,cc}.
+
+One Monitor per configured name; an elected leader drives Paxos proposals
+for every PaxosService (here: OSDMonitor).  Mirrored structure:
+
+- Elections (Elector) -> leader_init/peon_init on Paxos
+  (Monitor::win_election / lose_election).
+- Services propose encoded pending state through Paxos; every quorum member
+  applies commits in order and publishes to its own subscribers
+  (PaxosService::propose_pending / refresh).
+- Subscriptions (MMonSubscribe): "osdmap" subscribers get the current full
+  map immediately and incrementals as they commit
+  (Monitor::handle_subscribe, OSDMonitor::check_osdmap_sub).
+- Commands (MMonCommand, JSON like the reference's cmdmap): queries are
+  answered by any quorum member from committed state; mutations on a peon
+  return -EAGAIN naming the leader so clients re-target (the reference
+  forwards instead; re-targeting keeps the same consistency).
+- OSD boot/failure reports: OSDs broadcast to all mons; only the leader
+  acts (prepare_boot / prepare_failure).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ..common.log import dout
+from ..msg.messages import (
+    MMonCommand,
+    MMonCommandAck,
+    MMonElection,
+    MMonPaxos,
+    MMonSubscribe,
+    MOSDBoot,
+    MOSDFailure,
+    MOSDMap,
+)
+from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
+from .elector import Elector
+from .monmap import MonMap
+from .osd_monitor import OSDMonitor
+from .paxos import Paxos
+from ..common.errs import EAGAIN, EINVAL
+
+
+class Monitor(Dispatcher):
+    def __init__(self, name: str, monmap: MonMap, election_timeout: float = 0.5):
+        self.name = name
+        self.monmap = monmap
+        self.rank = monmap.rank_of(name)
+        self.msgr = Messenger(f"mon.{name}")
+        self.msgr.default_policy = Policy.lossless_peer()
+        self.elector = Elector(
+            self.rank,
+            monmap.size(),
+            self._send_mon_election,
+            on_win=self._win_election,
+            on_lose=self._lose_election,
+            timeout=election_timeout,
+        )
+        self.paxos = Paxos(self.rank, self._send_mon_paxos, self._apply_commit)
+        self.quorum: list[int] = []
+        self.leader_rank: int | None = None
+        self.osdmon = OSDMonitor(self)
+        # conn -> {what -> next epoch}
+        self.subs: dict[Connection, dict[str, int]] = {}
+        self._started = asyncio.Event()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.msgr.bind(self.monmap.addrs[self.name])
+        self.msgr.add_dispatcher_head(self)
+        self.elector.start()
+        self._started.set()
+
+    async def stop(self) -> None:
+        self.elector.cancel()
+        await self.msgr.shutdown()
+
+    async def wait_for_quorum(self, timeout: float = 5.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.leader_rank is None:
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("no quorum")
+            await asyncio.sleep(0.01)
+
+    def is_leader(self) -> bool:
+        return self.leader_rank == self.rank
+
+    # -- transport helpers -----------------------------------------------------
+
+    def _send_mon(self, rank: int, msg) -> None:
+        if rank == self.rank:
+            return
+        addr = self.monmap.addr_of_rank(rank)
+
+        async def _send():
+            try:
+                await self.msgr.send_to(addr, msg)
+            except ConnectionError:
+                dout("mon", 10, f"mon.{self.name}: send to rank {rank} failed")
+
+        asyncio.get_event_loop().create_task(_send())
+
+    def _send_mon_election(self, rank: int, msg: MMonElection) -> None:
+        self._send_mon(rank, msg)
+
+    def _send_mon_paxos(self, rank: int, msg: MMonPaxos) -> None:
+        self._send_mon(rank, msg)
+
+    # -- election outcomes -----------------------------------------------------
+
+    def _win_election(self, epoch: int, quorum: list[int]) -> None:
+        self.quorum = quorum
+        self.leader_rank = self.rank
+        self.paxos.leader_init(quorum)
+        self.osdmon.on_active()
+
+    def _lose_election(self, epoch: int, leader: int) -> None:
+        self.quorum = []
+        self.leader_rank = leader
+        self.paxos.peon_init(leader)
+        self.osdmon.on_election_lost()
+
+    # -- commit application ----------------------------------------------------
+
+    def _apply_commit(self, version: int, value: bytes) -> None:
+        """Every quorum member applies committed service transactions in
+        order (PaxosService::refresh)."""
+        service, _, blob = value.partition(b"\x00")
+        if service == b"osd":
+            self.osdmon.apply_commit(blob)
+
+    def propose(self, service: str, blob: bytes, on_done=None) -> None:
+        self.paxos.propose(service.encode() + b"\x00" + blob, on_done)
+
+    # -- dispatch --------------------------------------------------------------
+
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMonElection):
+            self.elector.handle(msg)
+        elif isinstance(msg, MMonPaxos):
+            self.paxos.handle(msg, self._peer_rank(conn))
+        elif isinstance(msg, MMonSubscribe):
+            self._handle_subscribe(conn, msg)
+        elif isinstance(msg, MMonCommand):
+            self._handle_command(conn, msg)
+        elif isinstance(msg, MOSDBoot):
+            if self.is_leader():
+                self.osdmon.prepare_boot(msg)
+        elif isinstance(msg, MOSDFailure):
+            if self.is_leader():
+                self.osdmon.prepare_failure(msg, reporter=msg.src)
+        else:
+            return False
+        return True
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self.subs.pop(conn, None)
+
+    def _peer_rank(self, conn: Connection) -> int:
+        name = conn.peer_name.removeprefix("mon.")
+        return self.monmap.rank_of(name)
+
+    # -- subscriptions ---------------------------------------------------------
+
+    def _handle_subscribe(self, conn: Connection, msg: MMonSubscribe) -> None:
+        subs = self.subs.setdefault(conn, {})
+        for what, start in msg.what.items():
+            subs[what] = start
+            if what == "osdmap":
+                self.osdmon.check_sub(conn, subs)
+
+    def publish_osdmap(self) -> None:
+        """Push new epochs to every osdmap subscriber (on commit)."""
+        for conn, subs in list(self.subs.items()):
+            if "osdmap" in subs:
+                self.osdmon.check_sub(conn, subs)
+
+    def send_to_conn(self, conn: Connection, msg) -> None:
+        async def _send():
+            try:
+                await conn.send_message(msg)
+            except ConnectionError:
+                self.subs.pop(conn, None)
+
+        asyncio.get_event_loop().create_task(_send())
+
+    # -- commands --------------------------------------------------------------
+
+    def _handle_command(self, conn: Connection, msg: MMonCommand) -> None:
+        try:
+            cmd = json.loads(msg.cmd)
+        except json.JSONDecodeError:
+            self.send_to_conn(
+                conn, MMonCommandAck(tid=msg.tid, retval=-EINVAL, rs="bad json", outbl=b"")
+            )
+            return
+        prefix = cmd.get("prefix", "")
+        handler = self.osdmon.command_handler(prefix) or self._mon_command_handler(
+            prefix
+        )
+        if handler is None:
+            self.send_to_conn(
+                conn,
+                MMonCommandAck(
+                    tid=msg.tid, retval=-EINVAL, rs=f"unknown command {prefix!r}", outbl=b""
+                ),
+            )
+            return
+        mutating = getattr(handler, "mutating", False)
+        if mutating and not self.is_leader():
+            leader = self.leader_rank if self.leader_rank is not None else -1
+            self.send_to_conn(
+                conn,
+                MMonCommandAck(
+                    tid=msg.tid,
+                    retval=-EAGAIN,
+                    rs=f"not leader; leader is rank {leader}",
+                    outbl=b"",
+                ),
+            )
+            return
+
+        def reply(retval: int, rs: str, outbl: bytes = b"") -> None:
+            self.send_to_conn(
+                conn, MMonCommandAck(tid=msg.tid, retval=retval, rs=rs, outbl=outbl)
+            )
+
+        try:
+            handler(cmd, reply)
+        except Exception as e:  # command bugs must not kill the mon
+            reply(-EINVAL, f"command failed: {e}")
+
+    def _mon_command_handler(self, prefix: str):
+        if prefix == "quorum_status":
+            def handler(cmd, reply):
+                reply(
+                    0,
+                    "",
+                    json.dumps(
+                        {
+                            "quorum": self.quorum,
+                            "leader": self.leader_rank,
+                            "epoch": self.elector.epoch,
+                        }
+                    ).encode(),
+                )
+            return handler
+        if prefix == "status":
+            def handler(cmd, reply):
+                m = self.osdmon.osdmap
+                reply(
+                    0,
+                    "",
+                    json.dumps(
+                        {
+                            "osdmap_epoch": m.epoch,
+                            "num_osds": len(m.osds),
+                            "num_up_osds": m.num_up_osds(),
+                            "pools": [p.name for p in m.pools.values()],
+                        }
+                    ).encode(),
+                )
+            return handler
+        return None

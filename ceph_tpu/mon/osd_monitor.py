@@ -1,0 +1,399 @@
+"""OSDMonitor — the PaxosService owning the OSDMap (src/mon/OSDMonitor.cc).
+
+Mirrored responsibilities:
+- OSD lifecycle: boot marks up (prepare_boot), failure reports are
+  quorum-checked before marking down (prepare_failure, OSDMonitor.cc:2791;
+  `mon_osd_min_down_reporters`).
+- EC profile CRUD: `osd erasure-code-profile set/get/ls/rm`
+  (OSDMonitor.cc:6859-6915) with `normalize_profile` (:7416) instantiating
+  the codec through the plugin registry to validate, and the
+  `chunk_size == stripe_unit` check at pool create (:7437-7455,
+  prepare_pool_stripe_width :7715).
+- Pool create/rm with CRUSH rule creation (`indep` for EC,
+  ErasureCode.cc:64-82).
+- Map publication: every committed epoch is pushed to `osdmap` subscribers
+  as an Incremental (full-map epochs for structural changes).
+
+Mutations queue as closures against a scratch copy of the committed map and
+ride ONE proposal at a time (the reference's pending_inc batching).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+from ..codec.interface import EcError
+from ..common.errs import EAGAIN, EINVAL
+from ..codec.registry import ErasureCodePluginRegistry
+from ..common.log import dout
+from ..msg.messages import MOSDBoot, MOSDFailure, MOSDMap
+from ..osd.osdmap import (
+    FLAG_EC_OVERWRITES,
+    Incremental,
+    OSDMap,
+    POOL_TYPE_ERASURE,
+    POOL_TYPE_REPLICATED,
+)
+
+DEFAULT_STRIPE_UNIT = 4096
+
+
+class OSDMonitor:
+    def __init__(self, mon, min_down_reporters: int = 2):
+        self.mon = mon
+        self.osdmap = OSDMap()
+        self.inc_by_epoch: dict[int, bytes] = {}
+        self.failure_reports: dict[int, set[str]] = {}  # target -> reporters
+        self.min_down_reporters = min_down_reporters
+        # queued mutations: (mutate(map) -> rs, reply or None)
+        self._pending: list[tuple[Callable, Callable | None]] = []
+        self._proposing = False
+
+    # -- paxos plumbing --------------------------------------------------------
+
+    def on_election_lost(self) -> None:
+        """Became a peon: the in-flight proposal's on_done (if any) was
+        dropped by paxos peon_init; queued mutations can't commit here, so
+        their callers retry against the new leader."""
+        self._proposing = False
+        pending, self._pending = self._pending, []
+        for _mutate, reply in pending:
+            if reply is not None:
+                reply(-EAGAIN, "lost leadership; retry")
+
+    def on_active(self) -> None:
+        """Leader became active; bootstrap the first map epoch."""
+        self._proposing = False  # a pre-election in-flight on_done is gone
+        if self.osdmap.epoch == 0:
+            def init(m: OSDMap) -> str:
+                m.fsid = "tpu-fsid"
+                m.crush.add_bucket("default", "root")
+                return "created initial map"
+
+            self._queue(init, None)
+        else:
+            self._try_propose()
+
+    def apply_commit(self, blob: bytes) -> None:
+        """Applied on EVERY quorum member in commit order."""
+        inc = Incremental.frombytes(blob)
+        self.osdmap = inc.apply_to(self.osdmap)
+        self.inc_by_epoch[self.osdmap.epoch] = blob
+        dout("mon", 10, f"osdmap e{self.osdmap.epoch} committed")
+        self.mon.publish_osdmap()
+
+    def _queue(self, mutate: Callable, reply: Callable | None) -> None:
+        self._pending.append((mutate, reply))
+        self._try_propose()
+
+    def _try_propose(self) -> None:
+        if self._proposing or not self._pending or not self.mon.is_leader():
+            return
+        batch, self._pending = self._pending, []
+        # scratch copy of the committed map (the pending_inc)
+        scratch = OSDMap.frombytes(self.osdmap.tobytes())
+        results: list[tuple[Callable | None, int, str]] = []
+        for mutate, reply in batch:
+            try:
+                rs = mutate(scratch)
+                results.append((reply, 0, rs or ""))
+            except EcError as e:
+                results.append((reply, e.errno, str(e)))
+            except (KeyError, ValueError) as e:
+                results.append((reply, -EINVAL, str(e)))
+        scratch.epoch = self.osdmap.epoch + 1
+        inc = Incremental(epoch=scratch.epoch, full_map=scratch.tobytes())
+        self._proposing = True
+
+        def on_done(_version: int) -> None:
+            self._proposing = False
+            for reply, retval, rs in results:
+                if reply is not None:
+                    reply(retval, rs)
+            self._try_propose()
+
+        self.mon.propose("osd", inc.tobytes(), on_done)
+
+    # -- subscriptions ---------------------------------------------------------
+
+    def check_sub(self, conn, subs: dict[str, int]) -> None:
+        """Send epochs the subscriber is missing (check_osdmap_sub)."""
+        start = subs.get("osdmap", 0)
+        if self.osdmap.epoch == 0 or start > self.osdmap.epoch:
+            return
+        incs: dict[int, bytes] = {}
+        maps: dict[int, bytes] = {}
+        # Delta incrementals ride as-is; full-map-backed epochs collapse to
+        # ONE latest full map (sending a full map per missed epoch would be
+        # strictly worse than the maps path).
+        pending = [
+            self.inc_by_epoch.get(e) for e in range(max(start, 1), self.osdmap.epoch + 1)
+        ]
+        if (
+            start == 0
+            or any(p is None for p in pending)
+            or any(Incremental.frombytes(p).full_map for p in pending)
+        ):
+            maps[self.osdmap.epoch] = self.osdmap.tobytes()
+        else:
+            for e in range(max(start, 1), self.osdmap.epoch + 1):
+                incs[e] = self.inc_by_epoch[e]
+        subs["osdmap"] = self.osdmap.epoch + 1
+        self.mon.send_to_conn(
+            conn, MOSDMap(fsid=self.osdmap.fsid, maps=maps, incrementals=incs)
+        )
+
+    # -- OSD lifecycle ---------------------------------------------------------
+
+    def prepare_boot(self, msg: MOSDBoot) -> None:
+        osd, addr = msg.osd, msg.addr
+        info = self.osdmap.osds.get(osd)
+        if info is not None and info.up and info.addr == addr:
+            return  # duplicate boot
+
+        def mutate(m: OSDMap) -> str:
+            if osd not in m.osds:
+                # grow the crush tree: one host per osd (the standalone
+                # many-OSDs-one-host topology, qa/standalone/ceph-helpers.sh)
+                host = m.crush.add_bucket(f"host{osd}", "host")
+                m.crush.add_item(host, osd, 1.0)
+                m.crush.add_item("default", host, 1.0)
+                m.add_osd(osd, addr=addr, up=True)
+            else:
+                m.set_osd_state(osd, True, addr)
+            self.failure_reports.pop(osd, None)
+            return f"osd.{osd} boot"
+
+        self._queue(mutate, None)
+
+    def prepare_failure(self, msg: MOSDFailure, reporter: str) -> None:
+        """Quorum-check failure reports (OSDMonitor.cc:2791, :3134)."""
+        target = msg.target
+        if not self.osdmap.is_up(target):
+            return
+        reporters = self.failure_reports.setdefault(target, set())
+        reporters.add(reporter)
+        if len(reporters) < self.min_down_reporters:
+            dout(
+                "mon", 10,
+                f"osd.{target} failure: {len(reporters)}/{self.min_down_reporters} reporters",
+            )
+            return
+        self.failure_reports.pop(target, None)
+
+        def mutate(m: OSDMap) -> str:
+            m.set_osd_state(target, False)
+            return f"osd.{target} marked down"
+
+        self._queue(mutate, None)
+
+    # -- commands --------------------------------------------------------------
+
+    def command_handler(self, prefix: str):
+        handlers = {
+            "osd erasure-code-profile set": (self._cmd_profile_set, True),
+            "osd erasure-code-profile get": (self._cmd_profile_get, False),
+            "osd erasure-code-profile ls": (self._cmd_profile_ls, False),
+            "osd erasure-code-profile rm": (self._cmd_profile_rm, True),
+            "osd pool create": (self._cmd_pool_create, True),
+            "osd pool ls": (self._cmd_pool_ls, False),
+            "osd pool rm": (self._cmd_pool_rm, True),
+            "osd dump": (self._cmd_dump, False),
+            "osd out": (self._cmd_out, True),
+            "osd in": (self._cmd_in, True),
+        }
+        entry = handlers.get(prefix)
+        if entry is None:
+            return None
+        fn, mutating = entry
+        fn.__func__.mutating = mutating
+        return fn
+
+    # normalize_profile (OSDMonitor.cc:7416): instantiate through the
+    # registry so plugin defaults land in the stored profile.
+    @staticmethod
+    def _normalize_profile(profile: dict[str, str]) -> dict[str, str]:
+        profile = dict(profile)
+        plugin = profile.setdefault("plugin", "tpu")
+        work = {k: v for k, v in profile.items() if not k.startswith("crush-") and k != "stripe_unit"}
+        ec = ErasureCodePluginRegistry.instance().factory(plugin, work)
+        out = dict(ec.get_profile())
+        for k, v in profile.items():
+            if k.startswith("crush-") or k == "stripe_unit":
+                out[k] = v
+        return out
+
+    def _cmd_profile_set(self, cmd, reply) -> None:
+        name = cmd["name"]
+        profile_kv = dict(kv.split("=", 1) for kv in cmd.get("profile", []))
+        normalized = self._normalize_profile(profile_kv)
+        force = bool(cmd.get("force"))
+
+        def mutate(m: OSDMap) -> str:
+            existing = m.erasure_code_profiles.get(name)
+            if existing is not None and existing != normalized and not force:
+                raise ValueError(
+                    f"will not override erasure code profile {name}"
+                )
+            m.erasure_code_profiles[name] = normalized
+            return f"profile {name} set"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_profile_get(self, cmd, reply) -> None:
+        name = cmd["name"]
+        prof = self.osdmap.erasure_code_profiles.get(name)
+        if prof is None:
+            reply(-2, f"no such profile {name}")
+        else:
+            reply(0, "", json.dumps(prof).encode())
+
+    def _cmd_profile_ls(self, cmd, reply) -> None:
+        reply(0, "", json.dumps(sorted(self.osdmap.erasure_code_profiles)).encode())
+
+    def _cmd_profile_rm(self, cmd, reply) -> None:
+        name = cmd["name"]
+
+        def mutate(m: OSDMap) -> str:
+            for pool in m.pools.values():
+                if pool.erasure_code_profile == name:
+                    raise ValueError(f"profile {name} in use by pool {pool.name}")
+            if name not in m.erasure_code_profiles:
+                raise KeyError(f"no such profile {name}")
+            del m.erasure_code_profiles[name]
+            return f"profile {name} removed"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_pool_create(self, cmd, reply) -> None:
+        name = cmd["pool"]
+        pool_type = cmd.get("pool_type", "replicated")
+        pg_num = int(cmd.get("pg_num", 8))
+
+        if pool_type == "erasure":
+            profile_name = cmd.get("erasure_code_profile", "default")
+
+            def mutate(m: OSDMap) -> str:
+                prof = m.erasure_code_profiles.get(profile_name)
+                if prof is None:
+                    raise KeyError(f"no such erasure-code profile {profile_name}")
+                ec = ErasureCodePluginRegistry.instance().factory(
+                    prof.get("plugin", "tpu"),
+                    {k: v for k, v in prof.items()
+                     if not k.startswith("crush-") and k != "stripe_unit"},
+                )
+                k = ec.get_data_chunk_count()
+                stripe_unit = int(prof.get("stripe_unit", DEFAULT_STRIPE_UNIT))
+                # stripe_unit must equal the codec chunk size
+                # (OSDMonitor.cc:7437-7455)
+                chunk = ec.get_chunk_size(k * stripe_unit)
+                if chunk != stripe_unit:
+                    raise ValueError(
+                        f"stripe_unit {stripe_unit} incompatible: codec chunk "
+                        f"size would be {chunk}"
+                    )
+                rule = m.crush.rule_id(f"ec_{profile_name}")
+                if rule is None:
+                    rule = m.crush.add_simple_rule(
+                        f"ec_{profile_name}",
+                        failure_domain=prof.get("crush-failure-domain", "host"),
+                        mode="indep",
+                    )
+                flags = FLAG_EC_OVERWRITES if cmd.get("allow_ec_overwrites") else 0
+                m.create_pool(
+                    name,
+                    type=POOL_TYPE_ERASURE,
+                    size=ec.get_chunk_count(),
+                    min_size=k + 1 if ec.get_coding_chunk_count() > 1 else k,
+                    pg_num=pg_num,
+                    crush_rule=rule,
+                    erasure_code_profile=profile_name,
+                    stripe_width=k * stripe_unit,
+                    flags=flags,
+                )
+                return f"pool '{name}' created"
+
+        else:
+
+            def mutate(m: OSDMap) -> str:
+                rule = m.crush.rule_id("replicated_rule")
+                if rule is None:
+                    rule = m.crush.add_simple_rule(
+                        "replicated_rule",
+                        failure_domain=cmd.get("crush_failure_domain", "host"),
+                        mode="firstn",
+                    )
+                m.create_pool(
+                    name,
+                    type=POOL_TYPE_REPLICATED,
+                    size=int(cmd.get("size", 3)),
+                    pg_num=pg_num,
+                    crush_rule=rule,
+                )
+                return f"pool '{name}' created"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_pool_ls(self, cmd, reply) -> None:
+        reply(0, "", json.dumps([p.name for p in self.osdmap.pools.values()]).encode())
+
+    def _cmd_pool_rm(self, cmd, reply) -> None:
+        name = cmd["pool"]
+
+        def mutate(m: OSDMap) -> str:
+            pool = m.get_pool(name)
+            if pool is None:
+                raise KeyError(f"no such pool {name}")
+            del m.pools[pool.id]
+            del m.pool_name_to_id[name]
+            return f"pool '{name}' removed"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_dump(self, cmd, reply) -> None:
+        m = self.osdmap
+        reply(
+            0,
+            "",
+            json.dumps(
+                {
+                    "epoch": m.epoch,
+                    "osds": {
+                        str(o): {"up": i.up, "in": i.in_, "addr": i.addr}
+                        for o, i in m.osds.items()
+                    },
+                    "pools": {
+                        str(p.id): {
+                            "name": p.name,
+                            "type": p.type,
+                            "size": p.size,
+                            "pg_num": p.pg_num,
+                            "erasure_code_profile": p.erasure_code_profile,
+                            "stripe_width": p.stripe_width,
+                        }
+                        for p in m.pools.values()
+                    },
+                }
+            ).encode(),
+        )
+
+    def _cmd_out(self, cmd, reply) -> None:
+        osd = int(cmd["id"])
+
+        def mutate(m: OSDMap) -> str:
+            m.set_osd_weight(osd, 0)
+            return f"osd.{osd} out"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_in(self, cmd, reply) -> None:
+        osd = int(cmd["id"])
+
+        def mutate(m: OSDMap) -> str:
+            from ..crush.crush import WEIGHT_ONE
+
+            m.set_osd_weight(osd, WEIGHT_ONE)
+            return f"osd.{osd} in"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))

@@ -291,8 +291,14 @@ class MMonPaxos(Message):
         ("pn", "u64"),
         ("last_committed", "u64"),
         ("values", ("map", "u64", "bytes")),
+        # pn under which an accepted-but-uncommitted value (at slot
+        # last_committed+1 in `values`) was accepted; 0 = none
+        ("uncommitted_pn", "u64"),
     ]
     priority = PRIO_HIGH
+
+    def __init__(self, uncommitted_pn: int = 0, **kwargs):
+        super().__init__(uncommitted_pn=uncommitted_pn, **kwargs)
 
 
 @message_type(18)

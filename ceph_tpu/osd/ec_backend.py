@@ -79,7 +79,6 @@ class Op:
     on_failure: Callable[[int], None] | None = None
     obj_size: int = 0
     read_results: dict[int, bytes] = field(default_factory=dict)  # off -> bytes
-    pending_reads: int = 0
     pending_commits: set[int] = field(default_factory=set)  # shard ids
     pin: object | None = None
     encoded: bool = False
@@ -283,6 +282,29 @@ class ECBackend(PGBackend):
             if proj["refs"] <= 0:
                 del self._projected[oid]
 
+    def _fail_op_chain(self, op: Op, err: int) -> None:
+        """Abort a failed un-encoded op and every LATER un-encoded op on the
+        same object: their plans were computed against this op's projected
+        state, which was never written.  Projected state resets to disk."""
+        oid = op.pgt.oid
+        doomed = [op] + [
+            o
+            for o in list(self.in_flight.values()) + self.waiting_reads
+            if o.pgt.oid == oid and o.tid > op.tid and not o.encoded
+        ]
+        for o in doomed:
+            self.in_flight.pop(o.tid, None)
+        self.waiting_reads = [o for o in self.waiting_reads if o not in doomed]
+        self._projected.pop(oid, None)
+        self.listener.clog_error(
+            f"{self.listener.pgid}: RMW read for {oid} failed ({err}); "
+            f"aborting {len(doomed)} queued write(s)"
+        )
+        self._kick_waiting_reads()
+        for o in doomed:
+            if o.on_failure is not None:
+                o.on_failure(err)
+
     def _start_rmw(self, op: Op) -> None:
         # try_state_to_reads: ops on the same object encode strictly in tid
         # order — an earlier un-encoded op may still change the bytes (and
@@ -312,22 +334,15 @@ class ECBackend(PGBackend):
         if not need:
             self._encode_and_dispatch(op)
             return
-        op.pending_reads = len(need[op.pgt.oid])
 
         def _on_read(results: dict) -> None:
             err, extents = results[op.pgt.oid]
             if err:
                 # The reference asserts here (a decodable PG cannot fail its
                 # own RMW read); we fail the op without killing the dispatch
-                # loop and let waiters re-evaluate.
-                self.in_flight.pop(op.tid, None)
-                self._unref_projected(op.pgt.oid)
-                self.listener.clog_error(
-                    f"{self.listener.pgid}: RMW read for {op.pgt.oid} failed ({err})"
-                )
-                self._kick_waiting_reads()
-                if op.on_failure is not None:
-                    op.on_failure(err)
+                # loop.  Later ops on the object planned against this op's
+                # projected size/bytes, so they abort with it.
+                self._fail_op_chain(op, err)
                 return
             for (off, _ln), data in zip(need[op.pgt.oid], extents):
                 op.read_results[off] = data
@@ -345,7 +360,7 @@ class ECBackend(PGBackend):
             hinfo = proj["hinfo"]
         else:
             hinfo = self.get_hash_info(op.pgt.oid)
-        txns, new_hinfo = generate_transactions(
+        txns, new_hinfo, merged = generate_transactions(
             op.pgt,
             op.plan,
             self.sinfo,
@@ -360,10 +375,9 @@ class ECBackend(PGBackend):
         if proj is not None:
             proj["hinfo"] = new_hinfo
             proj["hinfo_known"] = True
-        # Pin pending logical bytes so overlapping writes pipeline
-        # (ExtentCache reserve_extents_for_rmw).
+        # Pin exactly the bytes that were encoded so overlapping writes
+        # pipeline (ExtentCache reserve_extents_for_rmw).
         pin = self.extent_cache.prepare_pin()
-        merged = self._merged_bytes(op)
         for off, buf in merged.items():
             self.extent_cache.pin_extent(pin, op.pgt.oid, off, buf)
         op.pin = pin
@@ -393,25 +407,6 @@ class ECBackend(PGBackend):
             self.listener.send_shard(osd, msg)
         # Unblock readers that were waiting on our pin.
         self._kick_waiting_reads()
-
-    def _merged_bytes(self, op: Op) -> dict[int, bytes]:
-        """The new logical bytes per will_write range (for the cache pin)."""
-        out: dict[int, bytes] = {}
-        for off, ln in op.plan.will_write:
-            buf = bytearray(ln)
-            for r_off, r_data in op.read_results.items():
-                lo, hi = max(off, r_off), min(off + ln, r_off + len(r_data))
-                if lo < hi:
-                    buf[lo - off : hi - off] = r_data[lo - r_off : hi - r_off]
-            for w_off, w_data in op.pgt.writes:
-                lo, hi = max(w_off, off), min(w_off + len(w_data), off + ln)
-                if lo < hi:
-                    buf[lo - off : hi - off] = w_data[lo - w_off : hi - w_off]
-            t = op.pgt.truncate
-            if t is not None and off <= t < off + ln:
-                buf[t - off :] = b"\x00" * (off + ln - t)
-            out[off] = bytes(buf)
-        return out
 
     def _kick_waiting_reads(self) -> None:
         ready = [op for op in self.waiting_reads if not self._blocked_by_earlier(op)]

@@ -161,12 +161,14 @@ def generate_transactions(
     read_data: dict[int, bytes],
     hinfo: HashInfo | None,
     version: int,
-) -> tuple[dict[int, Transaction], HashInfo | None]:
+) -> tuple[dict[int, Transaction], HashInfo | None, dict[int, bytes]]:
     """Build one Transaction per shard (ECTransaction::generate_transactions,
     ECTransaction.cc:109).  `read_data` maps stripe-aligned offsets from
     plan.to_read to their current logical bytes (RMW input).
 
-    Returns (shard -> Transaction, updated hinfo or None when dropped)."""
+    Returns (shard -> Transaction, updated hinfo or None when dropped,
+    merged logical bytes per will_write range — what the extent cache pins
+    so overlapping writes see exactly what was encoded)."""
     n = ec.get_chunk_count()
     txns = {s: Transaction() for s in range(n)}
     sw = sinfo.stripe_width
@@ -174,7 +176,7 @@ def generate_transactions(
     if pgt.delete:
         for s, txn in txns.items():
             txn.remove(shard_colls[s], pgt.oid)
-        return txns, None
+        return txns, None, {}
 
     # Assemble the new bytes for every will_write range.
     merged: dict[int, bytearray] = {}
@@ -247,4 +249,4 @@ def generate_transactions(
                 txn.rmattr(shard_colls[s], pgt.oid, name)
             else:
                 txn.setattr(shard_colls[s], pgt.oid, name, val)
-    return txns, new_hinfo
+    return txns, new_hinfo, {off: bytes(buf) for off, buf in merged.items()}

@@ -14,6 +14,7 @@ import abc
 from typing import Callable
 
 from ..codec.base import EINVAL
+from ..common.errs import EIO
 from ..codec.interface import EcError, ErasureCodeInterface
 from ..codec.registry import ErasureCodePluginRegistry
 from ..msg.message import Message
@@ -277,7 +278,7 @@ class ReplicatedBackend(PGBackend):
             - {self.listener.whoami()}
         )
         if not sources:
-            on_complete(-5)
+            on_complete(-EIO)
             return
         self.pulling[oid] = (missing_on, on_complete)
         self.listener.send_shard(

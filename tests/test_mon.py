@@ -1,0 +1,245 @@
+"""Monitor tests: elections, Paxos replication, EC profile CRUD, pool
+create, subscriptions, failure quorum.
+
+Models the mon behaviors in SURVEY.md §2.7: OSDMonitor.cc:6859-6915 profile
+commands, :7437 stripe_unit validation, :2791 failure quorum; Paxos.cc
+collect/begin/accept/commit; ElectionLogic rank elections.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.mon import MonMap, Monitor
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.msg.messages import MOSDBoot, MOSDFailure
+from ceph_tpu.osd.osdmap import OSDMap
+
+
+def free_port_addrs(n):
+    import socket
+
+    addrs = {}
+    socks = []
+    for i in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        addrs[chr(ord("a") + i)] = f"127.0.0.1:{s.getsockname()[1]}"
+    for s in socks:
+        s.close()
+    return addrs
+
+
+async def start_mons(n, timeout=0.3):
+    monmap = MonMap(addrs=free_port_addrs(n))
+    mons = [Monitor(name, monmap, election_timeout=timeout) for name in monmap.addrs]
+    mons.sort(key=lambda m: m.rank)  # ranks follow sorted address order
+    for m in mons:
+        await m.start()
+    for m in mons:
+        await m.wait_for_quorum()
+    return monmap, mons
+
+
+async def stop_mons(mons):
+    for m in mons:
+        await m.stop()
+    await asyncio.sleep(0.05)
+
+
+class TestSingleMon:
+    def test_bootstrap_and_commands(self):
+        async def run():
+            monmap, mons = await start_mons(1)
+            mon = mons[0]
+            assert mon.is_leader()
+            client = MonClient("client.test", monmap)
+            # EC profile CRUD
+            rv, rs, _ = await client.command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "p42",
+                    "profile": ["k=4", "m=2", "plugin=tpu"],
+                }
+            )
+            assert rv == 0, rs
+            rv, _, out = await client.command(
+                {"prefix": "osd erasure-code-profile get", "name": "p42"}
+            )
+            assert rv == 0
+            prof = json.loads(out)
+            assert prof["k"] == "4" and prof["m"] == "2"
+            rv, _, out = await client.command(
+                {"prefix": "osd erasure-code-profile ls"}
+            )
+            assert "p42" in json.loads(out)
+            # pool create with stripe_unit validation
+            rv, rs, _ = await client.command(
+                {
+                    "prefix": "osd pool create",
+                    "pool": "ecpool",
+                    "pool_type": "erasure",
+                    "erasure_code_profile": "p42",
+                }
+            )
+            assert rv == 0, rs
+            rv, _, out = await client.command({"prefix": "osd dump"})
+            dump = json.loads(out)
+            pool = next(p for p in dump["pools"].values() if p["name"] == "ecpool")
+            assert pool["size"] == 6
+            assert pool["stripe_width"] == 4 * 4096
+            # profile in use cannot be removed
+            rv, rs, _ = await client.command(
+                {"prefix": "osd erasure-code-profile rm", "name": "p42"}
+            )
+            assert rv < 0 and "in use" in rs
+            await client.msgr.shutdown()
+            await stop_mons(mons)
+
+        asyncio.run(run())
+
+    def test_bad_profile_rejected(self):
+        async def run():
+            monmap, mons = await start_mons(1)
+            client = MonClient("client.test", monmap)
+            rv, rs, _ = await client.command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "bad",
+                    "profile": ["k=0", "m=2"],
+                }
+            )
+            assert rv < 0
+            await client.msgr.shutdown()
+            await stop_mons(mons)
+
+        asyncio.run(run())
+
+    def test_osd_boot_and_subscription(self):
+        async def run():
+            monmap, mons = await start_mons(1)
+            mon = mons[0]
+            client = MonClient("osd.0", monmap)
+            maps = []
+            client.on_osdmap = maps.append
+            await client.subscribe("osdmap", 0)
+            await asyncio.sleep(0.1)
+            assert maps, "initial map not delivered"
+            # boot three osds
+            for osd in range(3):
+                await client.msgr.send_to(
+                    monmap.addr_of_rank(0),
+                    MOSDBoot(osd=osd, addr=f"127.0.0.1:{7000+osd}", epoch=0),
+                )
+            await asyncio.sleep(0.3)
+            m = mon.osdmon.osdmap
+            assert m.num_up_osds() == 3
+            # subscriber saw the new epochs
+            assert len(maps) >= 2
+            # decode the latest published map
+            last = maps[-1]
+            if last.maps:
+                decoded = OSDMap.frombytes(last.maps[max(last.maps)])
+            else:
+                decoded = None
+            if decoded is not None:
+                assert decoded.epoch == m.epoch
+            await client.msgr.shutdown()
+            await stop_mons(mons)
+
+        asyncio.run(run())
+
+
+class TestMultiMon:
+    def test_election_and_replication(self):
+        async def run():
+            monmap, mons = await start_mons(3)
+            leader = [m for m in mons if m.is_leader()]
+            assert len(leader) == 1
+            assert leader[0].rank == 0  # lowest rank wins
+            client = MonClient("client.test", monmap)
+            rv, rs, _ = await client.command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "rep3",
+                    "profile": ["k=2", "m=1"],
+                }
+            )
+            assert rv == 0, rs
+            await asyncio.sleep(0.3)
+            # committed state replicated to all quorum members
+            for m in mons:
+                assert "rep3" in m.osdmon.osdmap.erasure_code_profiles, m.name
+            await client.msgr.shutdown()
+            await stop_mons(mons)
+
+        asyncio.run(run())
+
+    def test_leader_failover(self):
+        async def run():
+            monmap, mons = await start_mons(3, timeout=0.2)
+            assert mons[0].is_leader()
+            client = MonClient("client.test", monmap)
+            rv, _, _ = await client.command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "before",
+                    "profile": ["k=2", "m=1"],
+                }
+            )
+            assert rv == 0
+            await asyncio.sleep(0.2)
+            # leader dies; survivors elect rank 1
+            await mons[0].stop()
+            mons[1].elector.start()
+            await asyncio.sleep(0.8)
+            assert mons[1].is_leader()
+            # new leader serves reads and accepts writes
+            client._cur_rank = 1
+            rv, _, out = await client.command(
+                {"prefix": "osd erasure-code-profile ls"}
+            )
+            assert rv == 0 and "before" in json.loads(out)
+            rv, rs, _ = await client.command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "after",
+                    "profile": ["k=3", "m=2"],
+                }
+            )
+            assert rv == 0, rs
+            await asyncio.sleep(0.3)
+            assert "after" in mons[2].osdmon.osdmap.erasure_code_profiles
+            await client.msgr.shutdown()
+            await stop_mons(mons[1:])
+
+        asyncio.run(run())
+
+    def test_failure_report_quorum(self):
+        async def run():
+            monmap, mons = await start_mons(1)
+            mon = mons[0]
+            client = MonClient("osd.9", monmap)
+            for osd in range(3):
+                await client.msgr.send_to(
+                    monmap.addr_of_rank(0),
+                    MOSDBoot(osd=osd, addr=f"127.0.0.1:{7100+osd}", epoch=0),
+                )
+            await asyncio.sleep(0.3)
+            assert mon.osdmon.osdmap.num_up_osds() == 3
+            # one reporter is not enough (min_down_reporters=2)
+            fail = MOSDFailure(target=2, target_addr="", failed_for=25.0, epoch=0)
+            fail.src = "osd.0"
+            mon.osdmon.prepare_failure(fail, reporter="osd.0")
+            await asyncio.sleep(0.2)
+            assert mon.osdmon.osdmap.is_up(2)
+            # second reporter crosses the quorum
+            mon.osdmon.prepare_failure(fail, reporter="osd.1")
+            await asyncio.sleep(0.2)
+            assert not mon.osdmon.osdmap.is_up(2)
+            await client.msgr.shutdown()
+            await stop_mons(mons)
+
+        asyncio.run(run())
