@@ -1,0 +1,6 @@
+"""Client I/O library — librados + Objecter analogs (SURVEY.md §2.7)."""
+
+from .objecter import Objecter
+from .rados import IoCtx, Rados, RadosError
+
+__all__ = ["Objecter", "Rados", "IoCtx", "RadosError"]

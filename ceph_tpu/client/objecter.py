@@ -1,0 +1,151 @@
+"""Objecter — the client op engine, mirror of src/osdc/Objecter.{h,cc}.
+
+Reference call stack (SURVEY.md §3.1):
+
+- `op_submit` (/root/reference/src/osdc/Objecter.cc:2268) registers the
+  op, computes its target, and sends.
+- `_calc_target` (:2775): object name → PG (OSDMap::object_locator_to_pg)
+  → acting primary via CRUSH; recomputed whenever a new osdmap arrives,
+  and ops whose target changed are **resent** (handle_osd_map →
+  _scan_requests).
+- Replies arrive as MOSDOpReply (`handle_osd_op_reply`, :989) and
+  complete the registered op by tid.
+
+This client keeps that loop: an op stays registered until a final reply;
+map updates (via the MonClient osdmap subscription) wake every pending op
+to re-target and resend.  A primary that is not yet peered answers
+-EAGAIN with its epoch — the op waits for a newer map (or a short delay)
+and resends, which is the same convergence the reference gets from
+requeueing + map subscriptions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..common.errs import EAGAIN, ENOENT, ETIMEDOUT
+from ..common.log import dout
+from ..mon.client import MonClient
+from ..mon.monmap import MonMap
+from ..msg.messages import MOSDMap, MOSDOp, MOSDOpReply, OSDOp, PgId, ReqId
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..osd.osdmap import PG_NONE, OSDMap, advance_map
+
+
+class Objecter(Dispatcher):
+    def __init__(self, name: str, monmap: MonMap):
+        self.name = name
+        self.msgr = Messenger(name)
+        self.monc = MonClient(name, monmap, msgr=self.msgr)
+        self.msgr.add_dispatcher_head(self)
+        self.osdmap = OSDMap()
+        self._tid = 0
+        self._replies: dict[int, asyncio.Future] = {}
+        self._map_changed = asyncio.Event()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self, timeout: float = 5.0) -> None:
+        self.monc.on_osdmap = self._on_osdmap
+        await self.monc.subscribe("osdmap")
+        deadline = time.monotonic() + timeout
+        while self.osdmap.epoch == 0:
+            if time.monotonic() > deadline:
+                raise TimeoutError("no osdmap from mons")
+            await asyncio.sleep(0.02)
+            # subscriptions can race mon elections; renew until a map lands
+            await self.monc.resubscribe()
+
+    async def stop(self) -> None:
+        await self.msgr.shutdown()
+
+    def _on_osdmap(self, msg: MOSDMap) -> None:
+        """handle_osd_map: advance, then wake pending ops to re-target
+        (_scan_requests analog — ops re-send themselves)."""
+        self.osdmap = advance_map(self.osdmap, msg)
+        self._map_changed.set()
+        self._map_changed = asyncio.Event()
+
+    # -- dispatch --------------------------------------------------------------
+
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MOSDOpReply):
+            fut = self._replies.pop(msg.reqid.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return True
+        return False
+
+    # -- targeting -------------------------------------------------------------
+
+    def _calc_target(self, pool_id: int, oid: str) -> tuple[PgId, int]:
+        """_calc_target (Objecter.cc:2775): (pgid, acting_primary)."""
+        _pool, ps = self.osdmap.object_to_pg(pool_id, oid)
+        _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
+        return PgId(pool_id, ps, -1), primary
+
+    # -- op submission ---------------------------------------------------------
+
+    async def op_submit(
+        self,
+        pool_id: int,
+        oid: str,
+        ops: list[OSDOp],
+        timeout: float = 10.0,
+    ) -> MOSDOpReply:
+        """op_submit (Objecter.cc:2268): send + resend until a final
+        reply.  Raises TimeoutError past `timeout`."""
+        self._tid += 1
+        reqid = ReqId(client=self.name, tid=self._tid)
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"op {reqid.key()} on {oid} timed out")
+            pgid, primary = self._calc_target(pool_id, oid)
+            if primary == PG_NONE:
+                # No live primary in this interval: wait for the map to move
+                await self._wait_map_change(min(remaining, 0.5))
+                continue
+            info = self.osdmap.osds.get(primary)
+            if info is None or not info.addr:
+                await self._wait_map_change(min(remaining, 0.5))
+                continue
+            msg = MOSDOp(
+                reqid=reqid,
+                pgid=pgid,
+                oid=oid,
+                ops=ops,
+                epoch=self.osdmap.epoch,
+            )
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._replies[reqid.tid] = fut
+            try:
+                await self.msgr.send_to(info.addr, msg)
+                reply: MOSDOpReply = await asyncio.wait_for(
+                    fut, min(remaining, 2.0)
+                )
+            except (ConnectionError, asyncio.TimeoutError):
+                # Peer died or reply lost: re-target after a map change (or
+                # a short delay) and resend — Objecter's resend loop.
+                self._replies.pop(reqid.tid, None)
+                await self._wait_map_change(min(remaining, 0.3))
+                continue
+            if reply.result == -EAGAIN:
+                # Not primary / not yet active: refresh + retry.
+                await self._wait_map_change(min(remaining, 0.3))
+                continue
+            return reply
+
+    async def _wait_map_change(self, timeout: float) -> None:
+        ev = self._map_changed
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        # nudge subscriptions in case our mon connection reset
+        try:
+            await self.monc.resubscribe()
+        except ConnectionError:
+            pass

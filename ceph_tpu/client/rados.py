@@ -1,0 +1,139 @@
+"""librados-style client API — mirror of src/librados / src/include/rados.
+
+The reference's C++ `librados::Rados` / `IoCtx` surface
+(/root/reference/src/include/rados/librados.hpp), async-native: connect,
+mon commands, pool-scoped I/O contexts with object read/write/stat/
+xattr/remove, all flowing through the Objecter op engine exactly as the
+reference's IoCtxImpl does (src/librados/IoCtxImpl.cc → Objecter).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..common.errs import ENOENT
+from ..mon.monmap import MonMap
+from ..msg.messages import OSDOp
+from .objecter import Objecter
+
+
+class RadosError(Exception):
+    def __init__(self, err: int, msg: str = ""):
+        self.errno = -abs(err)
+        super().__init__(f"{msg} (errno {self.errno})")
+
+
+def _check(result: int, what: str) -> None:
+    if result < 0:
+        raise RadosError(result, what)
+
+
+class Rados:
+    """Cluster handle (librados::Rados)."""
+
+    def __init__(self, monmap: MonMap, name: str = "client.admin"):
+        self.name = name
+        self.objecter = Objecter(name, monmap)
+        self._connected = False
+
+    async def connect(self, timeout: float = 5.0) -> None:
+        await self.objecter.start(timeout)
+        self._connected = True
+
+    async def shutdown(self) -> None:
+        await self.objecter.stop()
+        self._connected = False
+
+    async def mon_command(self, cmd: dict, timeout: float = 5.0):
+        """JSON command to the mon cluster (rados_mon_command)."""
+        return await self.objecter.monc.command(cmd, timeout)
+
+    async def pool_create(
+        self, name: str, pool_type: str = "replicated", profile: str = "", **kw
+    ) -> None:
+        cmd = {"prefix": "osd pool create", "pool": name, "pool_type": pool_type}
+        if profile:
+            cmd["erasure_code_profile"] = profile
+        cmd.update(kw)
+        retval, rs, _ = await self.mon_command(cmd)
+        _check(retval, rs)
+
+    async def pool_list(self) -> list[str]:
+        retval, rs, outbl = await self.mon_command({"prefix": "osd pool ls"})
+        _check(retval, rs)
+        return json.loads(outbl.decode() or "[]")
+
+    async def open_ioctx(self, pool_name: str, timeout: float = 5.0) -> "IoCtx":
+        """Pool handle (rados_ioctx_create); waits for the pool to appear
+        in our map (pool creation is a paxos round away)."""
+        import asyncio
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            pool = self.objecter.osdmap.get_pool(pool_name)
+            if pool is not None:
+                return IoCtx(self, pool.id)
+            if time.monotonic() > deadline:
+                raise RadosError(ENOENT, f"pool {pool_name!r} not found")
+            await asyncio.sleep(0.05)
+            await self.objecter.monc.resubscribe()
+
+
+class IoCtx:
+    """Pool-scoped I/O context (librados::IoCtx)."""
+
+    def __init__(self, rados: Rados, pool_id: int):
+        self.rados = rados
+        self.pool_id = pool_id
+
+    async def _op(self, oid: str, ops: list[OSDOp], timeout: float = 10.0):
+        return await self.rados.objecter.op_submit(
+            self.pool_id, oid, ops, timeout=timeout
+        )
+
+    # -- writes ---------------------------------------------------------------
+
+    async def write(self, oid: str, data: bytes, off: int = 0) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.WRITE, off=off, data=bytes(data))])
+        _check(rep.result, f"write {oid}")
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.WRITEFULL, data=bytes(data))])
+        _check(rep.result, f"write_full {oid}")
+
+    async def append(self, oid: str, data: bytes) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.APPEND, data=bytes(data))])
+        _check(rep.result, f"append {oid}")
+
+    async def truncate(self, oid: str, size: int) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.TRUNCATE, off=size)])
+        _check(rep.result, f"truncate {oid}")
+
+    async def remove(self, oid: str) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.DELETE)])
+        _check(rep.result, f"remove {oid}")
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.SETXATTR, name=name, data=bytes(value))]
+        )
+        _check(rep.result, f"setxattr {oid}:{name}")
+
+    # -- reads ----------------------------------------------------------------
+
+    async def read(self, oid: str, length: int = 0, off: int = 0) -> bytes:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.READ, off=off, len=length)])
+        _check(rep.result, f"read {oid}")
+        return rep.outdata[0] if rep.outdata else b""
+
+    async def stat(self, oid: str) -> int:
+        """Object size (rados_stat)."""
+        rep = await self._op(oid, [OSDOp(op=OSDOp.STAT)])
+        _check(rep.result, f"stat {oid}")
+        return int.from_bytes(rep.outdata[0], "little")
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.GETXATTR, name=name)])
+        _check(rep.result, f"getxattr {oid}:{name}")
+        return rep.outdata[0]

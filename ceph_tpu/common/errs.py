@@ -9,3 +9,5 @@ EINVAL = 22
 EEXIST = 17
 EXDEV = 18
 ETIMEDOUT = 110
+ENODATA = 61
+ENXIO = 6

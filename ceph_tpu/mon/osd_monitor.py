@@ -21,6 +21,7 @@ ride ONE proposal at a time (the reference's pending_inc batching).
 from __future__ import annotations
 
 import json
+import time
 from typing import Callable
 
 from ..codec.interface import EcError
@@ -44,8 +45,10 @@ class OSDMonitor:
         self.mon = mon
         self.osdmap = OSDMap()
         self.inc_by_epoch: dict[int, bytes] = {}
-        self.failure_reports: dict[int, set[str]] = {}  # target -> reporters
+        # target -> {reporter: report time}; entries expire (prepare_failure)
+        self.failure_reports: dict[int, dict[str, float]] = {}
         self.min_down_reporters = min_down_reporters
+        self.report_expiry = 20.0  # seconds a failure report stays valid
         # queued mutations: (mutate(map) -> rs, reply or None)
         self._pending: list[tuple[Callable, Callable | None]] = []
         self._proposing = False
@@ -168,12 +171,19 @@ class OSDMonitor:
         self._queue(mutate, None)
 
     def prepare_failure(self, msg: MOSDFailure, reporter: str) -> None:
-        """Quorum-check failure reports (OSDMonitor.cc:2791, :3134)."""
+        """Quorum-check failure reports (OSDMonitor.cc:2791, :3134).
+        Reports expire after `report_expiry` seconds — a stale reporter
+        from a long-past blip must not combine with a fresh one to mark
+        a healthy OSD down (failure_info_t's report window)."""
         target = msg.target
         if not self.osdmap.is_up(target):
             return
-        reporters = self.failure_reports.setdefault(target, set())
-        reporters.add(reporter)
+        now = time.monotonic()
+        reporters = self.failure_reports.setdefault(target, {})
+        reporters[reporter] = now
+        for r, ts in list(reporters.items()):
+            if now - ts > self.report_expiry:
+                del reporters[r]
         if len(reporters) < self.min_down_reporters:
             dout(
                 "mon", 10,

@@ -318,9 +318,22 @@ class MMonElection(Message):
 
 @message_type(19)
 class MOSDPGQuery(Message):
-    """Primary asks a shard for its pg_info (src/messages/MOSDPGQuery.h)."""
+    """Primary asks a shard for its pg_info or log tail
+    (src/messages/MOSDPGQuery.h; pg_query_t INFO/LOG types in
+    osd_types.h)."""
 
-    FIELDS = [("pgid", PgId), ("epoch", "u32"), ("from_osd", "u32")]
+    INFO = 1
+    LOG = 2
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("op", "u8"),
+        ("epoch", "u32"),
+        ("from_osd", "u32"),
+        # LOG queries: send entries after (since_epoch, since_ver)
+        ("since_epoch", "u32"),
+        ("since_ver", "u64"),
+    ]
 
 
 @message_type(20)

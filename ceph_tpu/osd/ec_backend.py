@@ -390,20 +390,31 @@ class ECBackend(PGBackend):
         )
         acting = self.listener.acting()
         log_bytes = [entry.tobytes()]
+        # Register EVERY pending shard before dispatching ANY sub-write:
+        # the self-send applies synchronously, and its reply must not see a
+        # half-filled pending set (it would commit after the local apply
+        # alone, racing the remote shards).
+        sends: list[tuple[int, MOSDECSubOpWrite]] = []
         for s in range(self.n):
             osd = acting[s] if s < len(acting) else PG_NONE
             if osd == PG_NONE:
                 continue
             op.pending_commits.add(s)
-            msg = MOSDECSubOpWrite(
-                pgid=self.listener.pgid.with_shard(s),
-                from_osd=self.listener.whoami(),
-                tid=op.tid,
-                reqid=op.reqid,
-                txn=txns[s].tobytes(),
-                at_version=op.version.version,
-                log_entries=log_bytes,
+            sends.append(
+                (
+                    osd,
+                    MOSDECSubOpWrite(
+                        pgid=self.listener.pgid.with_shard(s),
+                        from_osd=self.listener.whoami(),
+                        tid=op.tid,
+                        reqid=op.reqid,
+                        txn=txns[s].tobytes(),
+                        at_version=op.version.version,
+                        log_entries=log_bytes,
+                    ),
+                )
             )
+        for osd, msg in sends:
             self.listener.send_shard(osd, msg)
         # Unblock readers that were waiting on our pin.
         self._kick_waiting_reads()
@@ -503,6 +514,10 @@ class ECBackend(PGBackend):
     def _send_reads(self, rop: ReadOp, shards: set[int]) -> None:
         acting = self.listener.acting()
         sub_count = self.ec.get_sub_chunk_count()
+        # Register every source before sending: the self-send replies
+        # synchronously and must see the complete source set, or the
+        # completion check runs against a partial plan.
+        sends: list[tuple[int, MOSDECSubOpRead]] = []
         for s in shards:
             osd = acting[s]
             rop.sources[s] = osd
@@ -515,18 +530,26 @@ class ECBackend(PGBackend):
                     exts.append([c_off, c_len])
                 to_read[oid] = exts
             runs = rop.subchunks.get(s, [(0, sub_count)])
-            msg = MOSDECSubOpRead(
-                pgid=self.listener.pgid.with_shard(s),
-                from_osd=self.listener.whoami(),
-                tid=rop.tid,
-                to_read=to_read,
-                subchunks={
-                    oid: [[o, c] for o, c in runs] for oid in rop.requests
-                },
-                attrs_to_read=(
-                    list(rop.requests) if any(r.want_attrs for r in rop.requests.values()) else []
-                ),
+            sends.append(
+                (
+                    osd,
+                    MOSDECSubOpRead(
+                        pgid=self.listener.pgid.with_shard(s),
+                        from_osd=self.listener.whoami(),
+                        tid=rop.tid,
+                        to_read=to_read,
+                        subchunks={
+                            oid: [[o, c] for o, c in runs] for oid in rop.requests
+                        },
+                        attrs_to_read=(
+                            list(rop.requests)
+                            if any(r.want_attrs for r in rop.requests.values())
+                            else []
+                        ),
+                    ),
+                )
             )
+        for osd, msg in sends:
             self.listener.send_shard(osd, msg)
 
     def handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
@@ -806,6 +829,9 @@ class ECBackend(PGBackend):
         version = 0
         if OI_ATTR in rec.attrs:
             version = ObjectInfo.decode(rec.attrs[OI_ATTR]).version
+        # Register all pending pushes before sending any: a push to our own
+        # shard replies synchronously and must not observe a partial set.
+        sends: list[tuple[int, MOSDPGPush]] = []
         for s in sorted(want):
             osd = acting[s] if s < len(acting) else PG_NONE
             if osd == PG_NONE:
@@ -817,15 +843,22 @@ class ECBackend(PGBackend):
                 attrs=dict(rec.attrs),
                 version=version,
             )
-            msg = MOSDPGPush(
-                pgid=self.listener.pgid.with_shard(s),
-                pushes=[push],
-                epoch=self.listener.epoch(),
-                from_osd=self.listener.whoami(),
+            sends.append(
+                (
+                    osd,
+                    MOSDPGPush(
+                        pgid=self.listener.pgid.with_shard(s),
+                        pushes=[push],
+                        epoch=self.listener.epoch(),
+                        from_osd=self.listener.whoami(),
+                    ),
+                )
             )
-            self.listener.send_shard(osd, msg)
-        if not rec.pending_pushes:
+        if not sends:
             self._finish_recovery(rec)
+            return
+        for osd, msg in sends:
+            self.listener.send_shard(osd, msg)
 
     def _full_shard_len(self, rec: RecoveryOp) -> int:
         """True (unfragmented) shard length for CLAY repair decode."""

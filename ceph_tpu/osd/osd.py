@@ -1,0 +1,434 @@
+"""OSD daemon — mirror of src/osd/OSD.{h,cc} + src/ceph_osd.cc.
+
+Structure mirrored from the reference (§3.3 of SURVEY.md):
+
+- **Boot** (src/ceph_osd.cc:120): mount the object store, bind the
+  messenger, announce to the monitors with MOSDBoot, subscribe to osdmap
+  updates (the reference's `osd->init()` → `_send_boot`).
+- **Map handling** (OSD::handle_osd_map → consume_map): full maps and
+  incrementals advance the in-memory OSDMap; every PG whose acting set we
+  appear in is created/advanced through a new peering interval.
+- **Dispatch** (OSD::ms_fast_dispatch, OSD.cc:7244): backend sub-ops are
+  fast-dispatched straight into the owning PG's backend (the reference
+  bypasses the dispatch queue for exactly these); client MOSDOps are
+  queued through the mClock/WPQ OpScheduler (enqueue_op/dequeue_op,
+  OSD.cc:9431,9491) and run by the op worker.
+- **Heartbeats** (handle_osd_ping OSD.cc:5463, heartbeat_check :5834):
+  periodic MOSDPing to every up peer; peers that miss
+  `osd_heartbeat_grace` seconds of replies are reported to the monitors
+  with MOSDFailure, where the failure-quorum logic decides
+  (OSDMonitor.cc:2791 prepare_failure).
+- Cluster sends are ordered per peer through a single drain task — the
+  per-connection ordering the reference gets from its one writer thread
+  per AsyncConnection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..common.config import Config
+from ..common.log import dout
+from ..common.perf_counters import PerfCountersBuilder
+from ..mon.client import MonClient
+from ..mon.monmap import MonMap
+from ..msg.message import Message
+from ..msg.messages import (
+    MOSDBoot,
+    MOSDECSubOpRead,
+    MOSDECSubOpReadReply,
+    MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply,
+    MOSDFailure,
+    MOSDMap,
+    MOSDOp,
+    MOSDOpReply,
+    MOSDPGLog,
+    MOSDPGNotify,
+    MOSDPGPull,
+    MOSDPGPush,
+    MOSDPGPushReply,
+    MOSDPGQuery,
+    MOSDPing,
+    MOSDRepOp,
+    MOSDRepOpReply,
+)
+from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
+from ..os.memstore import MemStore
+from .osdmap import PG_NONE, OSDMap, advance_map
+from .pg import PG
+from .scheduler import SchedClass, WorkItem, make_scheduler
+
+# Messages owned by a PG's backend (fast-dispatched, OSD.cc:7244).
+BACKEND_MSGS = (
+    MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply,
+    MOSDECSubOpRead,
+    MOSDECSubOpReadReply,
+    MOSDRepOp,
+    MOSDRepOpReply,
+    MOSDPGPush,
+    MOSDPGPushReply,
+    MOSDPGPull,
+)
+PEERING_MSGS = (MOSDPGQuery, MOSDPGNotify, MOSDPGLog)
+
+
+class OSD(Dispatcher):
+    def __init__(
+        self,
+        whoami: int,
+        monmap: MonMap,
+        conf: Config | None = None,
+        store=None,
+        addr: str = "127.0.0.1:0",
+    ):
+        self.whoami = whoami
+        self.monmap = monmap
+        self.conf = conf or Config({"name": f"osd.{whoami}"})
+        self.store = store if store is not None else MemStore()
+        self._bind_addr = addr
+        self.msgr = Messenger(
+            f"osd.{whoami}",
+            crc_data=self.conf.get("ms_crc_data"),
+            inject_socket_failures=self.conf.get("ms_inject_socket_failures"),
+        )
+        self.msgr.default_policy = Policy.lossless_peer()
+        self.monc = MonClient(f"osd.{whoami}", monmap)
+        self.osdmap = OSDMap()
+        self.pgs: dict[tuple[int, int], PG] = {}
+        self.sched = make_scheduler(self.conf.get("osd_op_queue"))
+        self._sched_kick = asyncio.Event()
+        b = PerfCountersBuilder(f"osd.{whoami}")
+        for c in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
+                  "recovery_ops", "heartbeat_failures"):
+            b.add_u64_counter(c)
+        self.perf = b.create_perf_counters()
+        self.clog: list[str] = []
+        # heartbeat state: peer -> last reply rx time
+        self._hb_last_rx: dict[int, float] = {}
+        self._hb_first_tx: dict[int, float] = {}
+        self._reported_failed: set[int] = set()
+        # ordered cluster sends: addr -> queue + drain task
+        self._out_q: dict[str, asyncio.Queue] = {}
+        self._out_tasks: dict[str, asyncio.Task] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._running = False
+        self.up = False
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> None:
+        """Boot sequence (ceph_osd.cc main → OSD::init)."""
+        self.store.mount()
+        await self.msgr.bind(self._bind_addr)
+        self.msgr.add_dispatcher_head(self)
+        self.monc.on_osdmap = self._on_osdmap_msg
+        self._running = True
+        await self.monc.subscribe("osdmap")
+        await self._send_boot()
+        self._tasks.append(asyncio.create_task(self._op_worker()))
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks + list(self._out_tasks.values()):
+            t.cancel()
+        self._tasks.clear()
+        self._out_tasks.clear()
+        await self.msgr.shutdown()
+        await self.monc.msgr.shutdown()
+        self.store.umount()
+
+    async def _send_boot(self) -> None:
+        """MOSDBoot broadcast to every mon (OSD::_send_boot; only the
+        Paxos leader acts on it)."""
+        boot = MOSDBoot(osd=self.whoami, addr=self.msgr.addr, epoch=self.osdmap.epoch)
+        for name in self.monmap.ranks:
+            try:
+                await self.monc.msgr.send_to(self.monmap.addrs[name], boot)
+            except ConnectionError:
+                continue
+
+    async def wait_for_up(self, timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self.up:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"osd.{self.whoami} never marked up")
+            await asyncio.sleep(0.01)
+
+    # -- osdmap handling -------------------------------------------------------
+
+    def _on_osdmap_msg(self, msg: MOSDMap) -> None:
+        """OSD::handle_osd_map: apply full maps / incrementals in epoch
+        order, then advance the PGs."""
+        self.osdmap = advance_map(self.osdmap, msg)
+        info = self.osdmap.osds.get(self.whoami)
+        self.up = bool(info and info.up and info.addr == self.msgr.addr)
+        self._advance_pgs()
+
+    def _advance_pgs(self) -> None:
+        """consume_map: create/advance every PG we participate in."""
+        epoch = self.osdmap.epoch
+        for pool in self.osdmap.pools.values():
+            for ps in range(pool.pg_num):
+                try:
+                    _up, _upp, acting, _actp = self.osdmap.pg_to_up_acting_osds(
+                        pool.id, ps
+                    )
+                except Exception:
+                    continue
+                key = (pool.id, ps)
+                if self.whoami in acting:
+                    pg = self.pgs.get(key)
+                    if pg is None:
+                        pg = self.pgs[key] = PG(
+                            self, pool, ps, self.osdmap.erasure_code_profiles
+                        )
+                    pg.on_new_interval(epoch, acting)
+                elif key in self.pgs:
+                    # no longer in the acting set: drop the in-memory PG
+                    # (data stays on disk, as the reference keeps strays)
+                    del self.pgs[key]
+
+    def _get_pg(self, pgid) -> PG | None:
+        pg = self.pgs.get((pgid.pool, pgid.ps))
+        if pg is not None:
+            return pg
+        # A peering message can arrive before our copy of the map does
+        # (OSD::handle_pg_create path): create the PG shell on demand.
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None:
+            return None
+        try:
+            _up, _upp, acting, _actp = self.osdmap.pg_to_up_acting_osds(
+                pool.id, pgid.ps
+            )
+        except Exception:
+            return None
+        if self.whoami not in acting:
+            return None
+        pg = self.pgs[(pgid.pool, pgid.ps)] = PG(
+            self, pool, pgid.ps, self.osdmap.erasure_code_profiles
+        )
+        pg.on_new_interval(self.osdmap.epoch, acting)
+        return pg
+
+    # -- dispatch --------------------------------------------------------------
+
+    def ms_can_fast_dispatch(self, msg: Message) -> bool:
+        return isinstance(msg, BACKEND_MSGS + PEERING_MSGS + (MOSDPing, MOSDOp))
+
+    def ms_fast_dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, MOSDPing):
+            self._handle_ping(conn, msg)
+            return
+        if isinstance(msg, MOSDOp):
+            self._enqueue_op(conn, msg)
+            return
+        pg = self._get_pg(msg.pgid)
+        if pg is None:
+            dout("osd", 5, f"osd.{self.whoami}: no pg for {msg.pgid}, dropping {msg!r}")
+            return
+        if isinstance(msg, PEERING_MSGS):
+            pg.handle_peering_message(msg)
+        else:
+            pg.backend.handle_message(msg)
+
+    # -- client ops through the scheduler --------------------------------------
+
+    def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
+        """enqueue_op (OSD.cc:9431): into the QoS scheduler."""
+        cost = sum(len(op.data) for op in msg.ops) or 4096
+        self.perf.inc("op")
+
+        def run() -> None:
+            self._do_dispatch_op(conn, msg)
+
+        self.sched.enqueue(
+            WorkItem(run=run, klass=SchedClass.CLIENT, cost=cost)
+        )
+        self._sched_kick.set()
+
+    def _do_dispatch_op(self, conn: Connection, msg: MOSDOp) -> None:
+        """dequeue_op (OSD.cc:9491) → PG::do_op."""
+        pg = self._get_pg(msg.pgid)
+
+        def reply(rep: MOSDOpReply) -> None:
+            async def _send():
+                try:
+                    await conn.send_message(rep)
+                except ConnectionError:
+                    pass
+
+            asyncio.get_event_loop().create_task(_send())
+
+        if pg is None:
+            from ..common.errs import EAGAIN
+
+            reply(
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=-EAGAIN,
+                    outdata=[],
+                    version=0,
+                    epoch=self.osdmap.epoch,
+                )
+            )
+            return
+        for op in msg.ops:
+            if op.data:
+                self.perf.inc("op_in_bytes", len(op.data))
+        pg.do_op(msg, reply)
+
+    async def _op_worker(self) -> None:
+        """The op worker (the reference's ShardedThreadPool shards,
+        OSD.h:1584, collapsed onto the event loop)."""
+        while self._running:
+            item = self.sched.dequeue()
+            if item is None:
+                self._sched_kick.clear()
+                await self._sched_kick.wait()
+                continue
+            try:
+                item.run()
+            except Exception as e:  # an op must not kill the worker
+                dout("osd", 0, f"osd.{self.whoami}: op raised {e!r}")
+            await asyncio.sleep(0)
+
+    # -- ordered cluster sends -------------------------------------------------
+
+    def send_cluster(self, osd: int, msg: Message) -> None:
+        """Ordered send to a peer OSD by id (cluster messenger)."""
+        info = self.osdmap.osds.get(osd)
+        if info is None or not info.addr:
+            dout("osd", 5, f"osd.{self.whoami}: no addr for osd.{osd}, dropping")
+            return
+        self._send_addr(info.addr, msg)
+
+    def _send_addr(self, addr: str, msg: Message) -> None:
+        q = self._out_q.get(addr)
+        if q is None:
+            q = self._out_q[addr] = asyncio.Queue()
+            self._out_tasks[addr] = asyncio.create_task(self._drain(addr, q))
+        q.put_nowait(msg)
+
+    async def _drain(self, addr: str, q: asyncio.Queue) -> None:
+        while True:
+            msg = await q.get()
+            try:
+                await self.msgr.send_to(addr, msg)
+            except ConnectionError:
+                dout("osd", 5, f"osd.{self.whoami}: send to {addr} failed")
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # A malformed message must not wedge the whole peer queue.
+                dout(
+                    "osd", 0,
+                    f"osd.{self.whoami}: dropping unsendable {type(msg).__name__}"
+                    f" to {addr}: {e!r}",
+                )
+
+    # -- heartbeats ------------------------------------------------------------
+
+    def _hb_peers(self) -> list[int]:
+        return [
+            o
+            for o, info in self.osdmap.osds.items()
+            if o != self.whoami and info.up
+        ]
+
+    async def _heartbeat_loop(self) -> None:
+        interval = self.conf.get("osd_heartbeat_interval")
+        while self._running:
+            await asyncio.sleep(interval)
+            if not self.up:
+                # Mon may have missed our boot (election in progress) or the
+                # subscription connection reset: renew both (OSD::tick).
+                await self._send_boot()
+                try:
+                    await self.monc.resubscribe()
+                except ConnectionError:
+                    pass
+                continue
+            for pg in list(self.pgs.values()):
+                pg.tick()
+            if self.conf.get("heartbeat_inject_failure") > 0:
+                continue  # pretend our pings are lost (global.yaml.in:865)
+            now = time.monotonic()
+            for peer in self._hb_peers():
+                self._hb_first_tx.setdefault(peer, now)
+                self.send_cluster(
+                    peer,
+                    MOSDPing(
+                        op=MOSDPing.PING,
+                        stamp=now,
+                        epoch=self.osdmap.epoch,
+                        from_osd=self.whoami,
+                    ),
+                )
+            self._heartbeat_check(now)
+
+    def _heartbeat_check(self, now: float) -> None:
+        """heartbeat_check (OSD.cc:5834): report peers past the grace."""
+        grace = self.conf.get("osd_heartbeat_grace")
+        for peer in self._hb_peers():
+            first = self._hb_first_tx.get(peer)
+            if first is None:
+                continue
+            last = self._hb_last_rx.get(peer, first)
+            failed_for = now - last
+            if failed_for > grace and now - first > grace:
+                if peer not in self._reported_failed:
+                    self._reported_failed.add(peer)
+                    self.perf.inc("heartbeat_failures")
+                    self._report_failure(peer, failed_for)
+            else:
+                self._reported_failed.discard(peer)
+
+    def _report_failure(self, peer: int, failed_for: float) -> None:
+        info = self.osdmap.osds.get(peer)
+        fail = MOSDFailure(
+            target=peer,
+            target_addr=info.addr if info else "",
+            failed_for=failed_for,
+            epoch=self.osdmap.epoch,
+        )
+        for name in self.monmap.ranks:
+            async def _send(addr=self.monmap.addrs[name]):
+                try:
+                    await self.monc.msgr.send_to(addr, fail)
+                except ConnectionError:
+                    pass
+
+            asyncio.get_event_loop().create_task(_send())
+
+    def _handle_ping(self, conn: Connection, msg: MOSDPing) -> None:
+        """handle_osd_ping (OSD.cc:5463)."""
+        if msg.op == MOSDPing.PING:
+            self.send_cluster(
+                msg.from_osd,
+                MOSDPing(
+                    op=MOSDPing.PING_REPLY,
+                    stamp=msg.stamp,
+                    epoch=self.osdmap.epoch,
+                    from_osd=self.whoami,
+                ),
+            )
+        elif msg.op == MOSDPing.PING_REPLY:
+            self._hb_last_rx[msg.from_osd] = time.monotonic()
+
+    # -- misc ------------------------------------------------------------------
+
+    def clog_error(self, msg: str) -> None:
+        """Cluster-log error (clog → mon LogMonitor in the reference)."""
+        self.clog.append(msg)
+        dout("osd", 0, f"osd.{self.whoami} clog: {msg}")
+
+    def num_pgs(self) -> int:
+        return len(self.pgs)
+
+    def all_clean(self) -> bool:
+        return all(pg.is_clean for pg in self.pgs.values() if pg.peering.is_primary())

@@ -28,6 +28,24 @@ POOL_TYPE_ERASURE = 3
 FLAG_EC_OVERWRITES = 1 << 0  # pool flag (osd_types.h:1222)
 
 
+def advance_map(current: "OSDMap", msg) -> "OSDMap":
+    """Apply an MOSDMap's full maps / incrementals in epoch order
+    (the shared OSD::handle_osd_map / Objecter::handle_osd_map advance
+    loop).  Epochs at or below `current.epoch` are skipped; an
+    incremental with a gap waits for a full map."""
+    out = current
+    fulls = {int(e): blob for e, blob in msg.maps.items()}
+    incs = {int(e): blob for e, blob in msg.incrementals.items()}
+    for epoch in sorted(set(fulls) | set(incs)):
+        if epoch <= out.epoch:
+            continue
+        if epoch in incs and out.epoch == epoch - 1:
+            out = Incremental.frombytes(incs[epoch]).apply_to(out)
+        elif epoch in fulls:
+            out = OSDMap.frombytes(fulls[epoch])
+    return out
+
+
 @dataclass
 class OsdInfo:
     """Per-OSD state (OSDMap osd_state/osd_weight/osd_addrs)."""

@@ -1,0 +1,316 @@
+"""Peering state machine — mirror of src/osd/PeeringState.{h,cc}.
+
+The reference drives peering with a boost::statechart machine
+(/root/reference/src/osd/PeeringState.h:460 lists the event set); the
+states that matter for correctness are the primary's
+GetInfo → GetLog → GetMissing → Activating → Active chain and the
+replica's Stray → ReplicaActive.  This module keeps those states and the
+same information flow, as plain explicit-state code:
+
+- **GetInfo**: the primary queries every acting shard for its `pg_info_t`
+  (MOSDPGQuery(INFO) → MOSDPGNotify), the reference's
+  PeeringState::proc_replica_info.
+- **GetLog**: if some shard's `last_update` beats ours, fetch its log
+  delta (MOSDPGQuery(LOG) → MOSDPGLog) and merge it, computing our own
+  missing set from the entries we had never applied
+  (PGLog::merge_log / proc_master_log).
+- **GetMissing** is folded into activation: the primary holds the
+  authoritative log, so each lagging peer's missing set is computed
+  locally from the log delta past that peer's `last_update`
+  (PGLog::proc_replica_log), and the delta is pushed to the peer in
+  MOSDPGLog so it reaches the same conclusion (activate_map path).
+- Shards whose logs fell behind the tail cannot log-recover and are
+  **backfilled**: every object the primary has is marked missing on them
+  (PeeringState's backfill_targets).
+- **Active**: `missing` + `peer_missing` feed the recovery machinery
+  (PGBackend::recover_object, §3.2) and degraded-object write blocking.
+
+Epochs guard everything: a new osdmap interval restarts peering
+(PeeringState::start_peering_interval), and stale messages from an older
+epoch are dropped on receipt.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Callable
+
+from ..common.log import dout
+from ..msg.messages import MOSDPGLog, MOSDPGNotify, MOSDPGQuery, PgId
+from .osdmap import PG_NONE
+from .pg_log import Eversion, LogEntry, Missing, PGLog, PgInfo
+
+
+class PeerState(enum.Enum):
+    """The state names the reference's statechart uses
+    (PeeringState.h Initial/Reset/Started/GetInfo/GetLog/Active/...)."""
+
+    RESET = "Reset"
+    GETINFO = "GetInfo"
+    GETLOG = "GetLog"
+    ACTIVE = "Active"
+    REPLICA_ACTIVE = "ReplicaActive"
+    STRAY = "Stray"
+
+
+class PeeringState:
+    """Per-PG peering driver.  Owned by the PG; sends through callbacks so
+    it stays transport-agnostic (unit tests pump a queue)."""
+
+    def __init__(
+        self,
+        pgid: PgId,
+        whoami: int,
+        log: PGLog,
+        info: PgInfo,
+        send: Callable[[int, object], None],
+        on_active: Callable[[], None],
+        list_local_objects: Callable[[], list[str]],
+    ):
+        self.pgid = pgid
+        self.whoami = whoami
+        self.log = log
+        self.info = info
+        self.send = send
+        self.on_active = on_active
+        self.list_local_objects = list_local_objects
+
+        self.state = PeerState.RESET
+        self.epoch = 0
+        self.acting: list[int] = []
+        self.primary: int = PG_NONE
+        self.peer_info: dict[int, PgInfo] = {}
+        self.missing = Missing()  # our own missing objects
+        self.peer_missing: dict[int, Missing] = {}  # primary-only
+        self.backfill_targets: set[int] = set()
+
+    # -- interval handling ----------------------------------------------------
+
+    def start_peering_interval(self, epoch: int, acting: list[int]) -> None:
+        """New map interval (PeeringState::start_peering_interval):
+        drop in-flight peering state and restart from GetInfo/Stray."""
+        self.epoch = epoch
+        self.acting = list(acting)
+        self.primary = next((o for o in acting if o != PG_NONE), PG_NONE)
+        self.peer_info = {}
+        self.peer_missing = {}
+        self.backfill_targets = set()
+        if self.primary != self.whoami:
+            self.state = PeerState.STRAY
+            return
+        self.state = PeerState.GETINFO
+        peers = self._up_peers()
+        if not peers:
+            self._activate()
+            return
+        for osd in peers:
+            self.send(
+                osd,
+                MOSDPGQuery(
+                    pgid=self.pgid,
+                    op=MOSDPGQuery.INFO,
+                    epoch=self.epoch,
+                    from_osd=self.whoami,
+                    since_epoch=0,
+                    since_ver=0,
+                ),
+            )
+
+    def _up_peers(self) -> list[int]:
+        return [o for o in self.acting if o not in (self.whoami, PG_NONE)]
+
+    def tick(self) -> None:
+        """Liveness re-kick (the reference gets this from statechart
+        timeouts + map-advance requeues): a primary stuck in GetInfo or
+        GetLog re-sends its one-shot queries — a dropped message (peer's
+        map behind, connection reset) must not wedge the PG forever."""
+        if self.state in (PeerState.GETINFO, PeerState.GETLOG):
+            self.start_peering_interval(self.epoch, self.acting)
+
+    def is_primary(self) -> bool:
+        return self.primary == self.whoami
+
+    def is_active(self) -> bool:
+        return self.state in (PeerState.ACTIVE, PeerState.REPLICA_ACTIVE)
+
+    # -- message handling ------------------------------------------------------
+
+    def handle_query(self, msg: MOSDPGQuery) -> None:
+        """A primary asks for our info or log (replica side)."""
+        if msg.epoch < self.epoch:
+            return  # stale interval
+        if msg.op == MOSDPGQuery.INFO:
+            self.send(
+                msg.from_osd,
+                MOSDPGNotify(
+                    pgid=self.pgid,
+                    info=self.info.tobytes(),
+                    epoch=msg.epoch,
+                    from_osd=self.whoami,
+                ),
+            )
+        elif msg.op == MOSDPGQuery.LOG:
+            since = Eversion(msg.since_epoch, msg.since_ver)
+            if self.log.can_catch_up(since):
+                entries = self.log.entries_after(since)
+            else:
+                entries = list(self.log.entries)  # best effort full log
+            blob = _pack_entries(entries)
+            self.send(
+                msg.from_osd,
+                MOSDPGLog(
+                    pgid=self.pgid,
+                    info=self.info.tobytes(),
+                    log=blob,
+                    epoch=msg.epoch,
+                    from_osd=self.whoami,
+                ),
+            )
+
+    def handle_notify(self, msg: MOSDPGNotify) -> None:
+        """proc_replica_info: gather infos during GetInfo."""
+        if msg.epoch != self.epoch or self.state != PeerState.GETINFO:
+            return
+        self.peer_info[msg.from_osd] = PgInfo.frombytes(msg.info)
+        if set(self.peer_info) >= set(self._up_peers()):
+            self._choose_auth_log()
+
+    def _choose_auth_log(self) -> None:
+        """find_best_info (PeeringState.cc): highest last_update wins;
+        ties break toward ourselves to avoid a needless log fetch."""
+        best_osd, best = self.whoami, self.info
+        for osd, info in self.peer_info.items():
+            if info.last_update > best.last_update:
+                best_osd, best = osd, info
+        if best_osd == self.whoami:
+            self._activate()
+            return
+        self.state = PeerState.GETLOG
+        self.auth_osd = best_osd
+        self.send(
+            best_osd,
+            MOSDPGQuery(
+                pgid=self.pgid,
+                op=MOSDPGQuery.LOG,
+                epoch=self.epoch,
+                from_osd=self.whoami,
+                since_epoch=self.log.head.epoch,
+                since_ver=self.log.head.version,
+            ),
+        )
+
+    def handle_log(self, msg: MOSDPGLog) -> None:
+        """Either the auth shard's reply to our GetLog (primary) or the
+        primary's activation delta (replica)."""
+        if msg.epoch != self.epoch:
+            return
+        entries = _unpack_entries(msg.log)
+        if self.state == PeerState.GETLOG and msg.from_osd == getattr(
+            self, "auth_osd", None
+        ):
+            self._merge_log(entries)
+            auth_info = PgInfo.frombytes(msg.info)
+            self.info.last_update = auth_info.last_update
+            self._activate()
+        elif self.state in (PeerState.STRAY, PeerState.REPLICA_ACTIVE):
+            self._merge_log(entries)
+            self.info.last_update = self.log.head
+            self.info.last_epoch_started = msg.epoch
+            self.state = PeerState.REPLICA_ACTIVE
+            dout("osd", 10, f"pg {self.pgid} replica active @ {self.log.head}")
+
+    def _merge_log(self, entries: list[LogEntry]) -> None:
+        """PGLog::merge_log: append unseen entries; each one names an
+        object version we do not have on disk yet → missing."""
+        for entry in entries:
+            if entry.version > self.log.head:
+                self.log.append(entry)
+                self.missing.add_next_event(entry)
+
+    # -- activation ------------------------------------------------------------
+
+    def _activate(self) -> None:
+        """PeeringState::activate: compute peer missing sets, ship log
+        deltas, open for business."""
+        self.state = PeerState.ACTIVE
+        self.info.last_epoch_started = self.epoch
+        head = self.log.head
+        for osd in self._up_peers():
+            pinfo = self.peer_info.get(osd, PgInfo())
+            if pinfo.last_update >= head:
+                self.peer_missing[osd] = Missing()
+                continue
+            if self.log.can_catch_up(pinfo.last_update):
+                # proc_replica_log: delta past the peer's head = its missing
+                self.peer_missing[osd] = self.log.missing_from(pinfo.last_update)
+                delta = self.log.entries_after(pinfo.last_update)
+            else:
+                # Log trimmed past the peer: backfill (everything we have)
+                self.backfill_targets.add(osd)
+                m = Missing()
+                for oid in self.list_local_objects():
+                    m.add(oid, head)
+                self.peer_missing[osd] = m
+                delta = list(self.log.entries)
+            blob = _pack_entries(delta)
+            self.send(
+                osd,
+                MOSDPGLog(
+                    pgid=self.pgid,
+                    info=self.info.tobytes(),
+                    log=blob,
+                    epoch=self.epoch,
+                    from_osd=self.whoami,
+                ),
+            )
+        dout(
+            "osd",
+            10,
+            f"pg {self.pgid} active @ e{self.epoch}: "
+            f"{len(self.missing)} missing here, "
+            f"{sum(len(m) for m in self.peer_missing.values())} on peers",
+        )
+        self.on_active()
+
+    # -- recovery bookkeeping --------------------------------------------------
+
+    def object_missing_anywhere(self, oid: str) -> bool:
+        return oid in self.missing or any(
+            oid in m for m in self.peer_missing.values()
+        )
+
+    def osds_missing(self, oid: str) -> set[int]:
+        """OSDs (not shards) that lack oid."""
+        out = {o for o, m in self.peer_missing.items() if oid in m}
+        if oid in self.missing:
+            out.add(self.whoami)
+        return out
+
+    def mark_recovered(self, oid: str, osd: int) -> None:
+        if osd == self.whoami:
+            self.missing.rm(oid)
+        elif osd in self.peer_missing:
+            self.peer_missing[osd].rm(oid)
+
+    def all_missing_oids(self) -> list[str]:
+        oids: set[str] = set(self.missing.items)
+        for m in self.peer_missing.values():
+            oids.update(m.items)
+        return sorted(oids)
+
+
+def _pack_entries(entries: list[LogEntry]) -> bytes:
+    return b"".join(
+        len(e := entry.tobytes()).to_bytes(4, "little") + e for entry in entries
+    )
+
+
+def _unpack_entries(blob: bytes) -> list[LogEntry]:
+    entries: list[LogEntry] = []
+    off = 0
+    while off < len(blob):
+        ln = int.from_bytes(blob[off : off + 4], "little")
+        off += 4
+        entries.append(LogEntry.frombytes(blob[off : off + ln]))
+        off += ln
+    return entries

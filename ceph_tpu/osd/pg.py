@@ -1,0 +1,448 @@
+"""PG — the placement-group execution context.
+
+Mirrors the slice of src/osd/PG.{h,cc} + PrimaryLogPG.cc that executes
+client ops and drives recovery:
+
+- `do_op` is PrimaryLogPG::do_op → execute_ctx → do_osd_ops
+  (/root/reference/src/osd/PrimaryLogPG.cc:1978,4134,5960): the op-code
+  switch over an MOSDOp's OSDOp vector, reads completing asynchronously
+  through the backend's reconstructing read path, writes becoming one
+  PGTransaction submitted to the PGBackend (issue_repop,
+  PrimaryLogPG.cc:11387).
+- Degraded-object gating is PrimaryLogPG::wait_for_degraded_object: ops
+  touching an object that is missing anywhere queue until recovery
+  completes, and that object's recovery is prioritized.
+- The recovery driver is the OSD's recovery work-queue scaled down:
+  up to `osd_recovery_max_active` objects in flight, each via
+  PGBackend::recover_object (§3.2 of SURVEY.md).
+- The PG implements PGListener — the boundary the backends (EC and
+  replicated) call back through, src/osd/PGBackend.h Listener.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+from ..common.errs import EAGAIN, EINVAL, ENODATA, ENOENT
+from ..common.log import dout
+from ..msg.messages import (
+    MOSDOp,
+    MOSDOpReply,
+    MOSDPGLog,
+    MOSDPGNotify,
+    MOSDPGQuery,
+    OSDOp,
+    PgId,
+)
+from ..os.transaction import Transaction
+from .ec_transaction import PGTransaction
+from .osdmap import PG_NONE, POOL_TYPE_ERASURE, PgPool
+from .peering import PeeringState
+from .pg_backend import PGListener, build_pg_backend, shard_coll
+from .pg_log import Eversion, LogEntry, PGLog, PgInfo
+
+WRITE_OPS = {
+    OSDOp.WRITE,
+    OSDOp.WRITEFULL,
+    OSDOp.DELETE,
+    OSDOp.TRUNCATE,
+    OSDOp.APPEND,
+    OSDOp.SETXATTR,
+}
+
+
+class PG(PGListener):
+    """One placement group hosted by an OSD (possibly one shard of it)."""
+
+    def __init__(self, osd, pool: PgPool, ps: int, profiles: dict):
+        self.osd = osd
+        self.pool = pool
+        self.ps = ps
+        self.pgid = PgId(pool.id, ps, -1)
+        self.pg_log = PGLog()
+        self.info = PgInfo()
+        self._acting: list[int] = []
+        self._epoch = 0
+        self._version = 0
+        self.peering = PeeringState(
+            self.pgid,
+            osd.whoami,
+            self.pg_log,
+            self.info,
+            send=self._send_peering,
+            on_active=self._on_active,
+            list_local_objects=self._list_local,
+        )
+        self.backend = build_pg_backend(pool, profiles, self, osd.store)
+        self.recovering: set[str] = set()
+        self.waiting_for_degraded: dict[str, list[Callable[[], None]]] = {}
+        self._colls_made: set[str] = set()
+        # Completed write results by reqid (PrimaryLogPG's dup-op check
+        # against the pg log's reqid index): a client resend after a lost
+        # reply must get the original result, not a second execution.
+        self._reqid_results: dict[tuple[str, int], MOSDOpReply] = {}
+        self._inflight_reqids: dict[tuple[str, int], list] = {}
+
+    # -- interval / peering ----------------------------------------------------
+
+    def on_new_interval(self, epoch: int, acting: list[int]) -> None:
+        """OSDMap advance (PG::handle_advance_map).  Re-peering only
+        happens when the *interval* changed — i.e. the acting set moved
+        (PastIntervals::is_new_interval); unrelated epoch bumps (another
+        pool created, another OSD booting) must not bounce an active PG
+        back through GetInfo."""
+        interval_changed = acting != self._acting or self._epoch == 0
+        self._epoch = epoch
+        if not interval_changed:
+            return
+        self._acting = list(acting)
+        self._ensure_local_coll()
+        self.peering.start_peering_interval(epoch, acting)
+
+    def tick(self) -> None:
+        """Periodic liveness: retry stuck peering, keep recovery moving."""
+        self.peering.tick()
+        if self.peering.is_active():
+            self._kick_recovery()
+
+    def _ensure_local_coll(self) -> None:
+        coll = shard_coll(self.pgid, self.whoami_shard())
+        if coll in self._colls_made:
+            return
+        if not self.osd.store.collection_exists(coll):
+            self.osd.store.queue_transaction(Transaction().create_collection(coll))
+        self._colls_made.add(coll)
+
+    def _send_peering(self, osd: int, msg) -> None:
+        self.osd.send_cluster(osd, msg)
+
+    def _list_local(self) -> list[str]:
+        coll = shard_coll(self.pgid, self.whoami_shard())
+        try:
+            return self.osd.store.list_objects(coll)
+        except Exception:
+            return []
+
+    def _on_active(self) -> None:
+        self._version = max(self._version, self.pg_log.head.version)
+        self._kick_recovery()
+
+    def handle_peering_message(self, msg) -> bool:
+        if isinstance(msg, MOSDPGQuery):
+            self._ensure_local_coll()
+            self.peering.handle_query(msg)
+        elif isinstance(msg, MOSDPGNotify):
+            self.peering.handle_notify(msg)
+        elif isinstance(msg, MOSDPGLog):
+            was_active = self.peering.is_active()
+            self.peering.handle_log(msg)
+            if not was_active and self.peering.is_active():
+                self._version = max(self._version, self.pg_log.head.version)
+        else:
+            return False
+        return True
+
+    # -- PGListener ------------------------------------------------------------
+
+    def whoami(self) -> int:
+        return self.osd.whoami
+
+    def whoami_shard(self) -> int:
+        if self.pool.type != POOL_TYPE_ERASURE:
+            return -1
+        if self.osd.whoami in self._acting:
+            return self._acting.index(self.osd.whoami)
+        return -1
+
+    def acting(self) -> list[int]:
+        return self._acting
+
+    def epoch(self) -> int:
+        return self._epoch
+
+    def next_version(self) -> Eversion:
+        self._version += 1
+        return Eversion(self._epoch, self._version)
+
+    def send_shard(self, osd: int, msg) -> None:
+        if osd == self.osd.whoami:
+            # the primary "sends to itself" (ECBackend.h:336-338)
+            self.backend.handle_message(msg)
+        else:
+            self.osd.send_cluster(osd, msg)
+
+    def append_log(self, entry: LogEntry) -> None:
+        if entry.version > self.pg_log.head:
+            self.pg_log.append(entry)
+        self.info.last_update = self.pg_log.head
+        self._version = max(self._version, entry.version.version)
+        # A sub-write for an object voids any stale missing record: the
+        # write pipeline only runs on recovered objects.
+        self.peering.missing.rm(entry.oid)
+
+    def get_shard_missing(self, oid: str) -> set[int]:
+        osds = self.peering.osds_missing(oid)
+        if self.pool.type != POOL_TYPE_ERASURE:
+            return osds
+        return {
+            self._acting.index(o)
+            for o in osds
+            if o in self._acting
+        }
+
+    def on_local_recover(self, oid: str) -> None:
+        self.peering.mark_recovered(oid, self.osd.whoami)
+
+    def on_global_recover(self, oid: str) -> None:
+        for osd in list(self.peering.peer_missing):
+            self.peering.mark_recovered(oid, osd)
+        self.peering.mark_recovered(oid, self.osd.whoami)
+        self.recovering.discard(oid)
+        for cb in self.waiting_for_degraded.pop(oid, []):
+            cb()
+        self._kick_recovery()
+
+    def clog_error(self, msg: str) -> None:
+        self.osd.clog_error(msg)
+
+    # -- client op execution ---------------------------------------------------
+
+    def do_op(self, msg: MOSDOp, reply: Callable[[MOSDOpReply], None]) -> None:
+        """PrimaryLogPG::do_op.  `reply` delivers the MOSDOpReply."""
+        if not self.peering.is_primary() or not self.peering.is_active():
+            # Misdirected or not-yet-peered: tell the client to refresh its
+            # map and resend (the reference drops + relies on the map sub;
+            # an explicit EAGAIN keeps the same retry loop without a race).
+            reply(
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=-EAGAIN,
+                    outdata=[],
+                    version=0,
+                    epoch=self._epoch,
+                )
+            )
+            return
+        oid = msg.oid
+        if self.peering.object_missing_anywhere(oid):
+            # wait_for_degraded_object: queue + prioritize its recovery
+            self.waiting_for_degraded.setdefault(oid, []).append(
+                lambda: self.do_op(msg, reply)
+            )
+            self._recover_one(oid)
+            return
+        if any(op.op in WRITE_OPS for op in msg.ops):
+            key = msg.reqid.key()
+            done = self._reqid_results.get(key)
+            if done is not None:
+                reply(done)  # duplicate of a completed write
+                return
+            waiters = self._inflight_reqids.get(key)
+            if waiters is not None:
+                waiters.append(reply)  # duplicate of an in-flight write
+                return
+            self._inflight_reqids[key] = []
+            self._do_write(msg, reply)
+        else:
+            self._do_read(msg, reply)
+
+    def _do_write(self, msg: MOSDOp, reply) -> None:
+        pgt = PGTransaction(oid=msg.oid)
+        outdata: list[bytes] = [b""] * len(msg.ops)
+        size = self._object_size(msg.oid)
+        for op in msg.ops:
+            if op.op == OSDOp.WRITE:
+                pgt.write(op.off, op.data)
+                size = max(size, op.off + len(op.data))
+            elif op.op == OSDOp.WRITEFULL:
+                pgt.write(0, op.data)
+                pgt.truncate = len(op.data)
+                size = len(op.data)
+            elif op.op == OSDOp.APPEND:
+                pgt.write(size, op.data)
+                size += len(op.data)
+            elif op.op == OSDOp.TRUNCATE:
+                pgt.truncate = op.off
+                size = op.off
+            elif op.op == OSDOp.DELETE:
+                pgt.delete = True
+                size = 0
+            elif op.op == OSDOp.SETXATTR:
+                pgt.attrs[f"_{op.name}"] = op.data
+            else:
+                self._inflight_reqids.pop(msg.reqid.key(), None)
+                reply(self._errored(msg, -EINVAL))
+                return
+        key = msg.reqid.key()
+
+        def finish(rep: MOSDOpReply, remember: bool) -> None:
+            if remember:
+                self._reqid_results[key] = rep
+                if len(self._reqid_results) > 1000:  # bounded dup window
+                    self._reqid_results.pop(next(iter(self._reqid_results)))
+            reply(rep)
+            for dup_reply in self._inflight_reqids.pop(key, []):
+                dup_reply(rep)
+
+        def on_commit() -> None:
+            finish(
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=0,
+                    outdata=outdata,
+                    version=self._version,
+                    epoch=self._epoch,
+                ),
+                remember=True,
+            )
+
+        def on_failure(err: int) -> None:
+            finish(self._errored(msg, -abs(err)), remember=False)
+
+        kwargs = {}
+        if self.pool.type == POOL_TYPE_ERASURE:
+            kwargs["on_failure"] = on_failure
+        try:
+            self.backend.submit_transaction(pgt, msg.reqid, on_commit, **kwargs)
+        except Exception as e:  # EcError on an invalid write plan
+            err = getattr(e, "errno", EINVAL)
+            finish(self._errored(msg, -abs(err)), remember=False)
+
+    def _do_read(self, msg: MOSDOp, reply) -> None:
+        outdata: list[bytes] = [b""] * len(msg.ops)
+        read_extents: list[tuple[int, tuple[int, int]]] = []  # (op idx, extent)
+        size = self._object_size(msg.oid)
+        exists = self._object_exists(msg.oid)
+        result = 0
+        for i, op in enumerate(msg.ops):
+            if op.op == OSDOp.READ:
+                if not exists:
+                    result = -ENOENT
+                    break
+                ln = op.len or max(size - op.off, 0)
+                ln = min(ln, max(size - op.off, 0))
+                if ln > 0:
+                    read_extents.append((i, (op.off, ln)))
+            elif op.op == OSDOp.STAT:
+                if not exists:
+                    result = -ENOENT
+                    break
+                outdata[i] = size.to_bytes(8, "little")
+            elif op.op == OSDOp.GETXATTR:
+                val = self._getxattr(msg.oid, f"_{op.name}")
+                if val is None:
+                    result = -ENODATA
+                    break
+                outdata[i] = val
+            else:
+                result = -EINVAL
+                break
+        if result != 0 or not read_extents:
+            reply(
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=result,
+                    outdata=outdata,
+                    version=self._version,
+                    epoch=self._epoch,
+                )
+            )
+            return
+
+        def on_read(results: dict) -> None:
+            err, bufs = results[msg.oid]
+            if err:
+                reply(self._errored(msg, err))
+                return
+            for (i, _ext), buf in zip(read_extents, bufs):
+                outdata[i] = buf
+            reply(
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=0,
+                    outdata=outdata,
+                    version=self._version,
+                    epoch=self._epoch,
+                )
+            )
+
+        self.backend.objects_read_and_reconstruct(
+            {msg.oid: [ext for _i, ext in read_extents]}, on_read
+        )
+
+    def _errored(self, msg: MOSDOp, err: int) -> MOSDOpReply:
+        return MOSDOpReply(
+            reqid=msg.reqid,
+            result=err,
+            outdata=[],
+            version=0,
+            epoch=self._epoch,
+        )
+
+    # -- object metadata helpers ----------------------------------------------
+
+    def _object_size(self, oid: str) -> int:
+        if self.pool.type == POOL_TYPE_ERASURE:
+            return self.backend.object_size(oid)
+        coll = shard_coll(self.pgid, -1)
+        try:
+            return self.osd.store.stat(coll, oid)
+        except Exception:
+            return 0
+
+    def _object_exists(self, oid: str) -> bool:
+        if self.pool.type == POOL_TYPE_ERASURE:
+            return self.backend.get_object_info(oid) is not None
+        coll = shard_coll(self.pgid, -1)
+        return self.osd.store.exists(coll, oid)
+
+    def _getxattr(self, oid: str, name: str) -> bytes | None:
+        coll = shard_coll(self.pgid, self.whoami_shard())
+        try:
+            return self.osd.store.getattr(coll, oid, name)
+        except Exception:
+            return None
+
+    # -- recovery driver -------------------------------------------------------
+
+    def _kick_recovery(self) -> None:
+        """Start recoveries up to osd_recovery_max_active
+        (the OSD recovery wq, scaled to this PG)."""
+        if not self.peering.is_primary() or not self.peering.is_active():
+            return
+        max_active = self.osd.conf.get("osd_recovery_max_active")
+        for oid in self.peering.all_missing_oids():
+            if len(self.recovering) >= max_active:
+                break
+            self._recover_one(oid)
+
+    def _recover_one(self, oid: str) -> None:
+        if oid in self.recovering or not self.peering.is_active():
+            return
+        osds = self.peering.osds_missing(oid)
+        if not osds:
+            return
+        self.recovering.add(oid)
+        if self.pool.type == POOL_TYPE_ERASURE:
+            missing_on = {
+                self._acting.index(o) for o in osds if o in self._acting
+            }
+        else:
+            missing_on = osds
+
+        def on_complete(err: int) -> None:
+            if err:
+                self.recovering.discard(oid)
+                self.clog_error(f"pg {self.pgid} recovery of {oid} failed: {err}")
+                return
+            self.on_global_recover(oid)
+
+        self.backend.recover_object(oid, missing_on, on_complete)
+
+    @property
+    def is_clean(self) -> bool:
+        return (
+            self.peering.is_active()
+            and not self.peering.missing.items
+            and all(not m.items for m in self.peering.peer_missing.values())
+        )

@@ -1,0 +1,222 @@
+"""Full-cluster integration tests — the tier-3 standalone analog.
+
+Models qa/standalone/erasure-code/test-erasure-code.sh (SURVEY.md §4.3):
+boot real mon+OSD daemons on localhost loopback sockets, create pools
+through mon commands, and exercise put/get round trips, failure
+detection, degraded reads, and recovery — the whole §3.1/§3.2 call stack
+over real (TCP) messengers instead of a pumped queue.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import Rados, RadosError
+from ceph_tpu.common.config import Config
+from ceph_tpu.mon import MonMap, Monitor
+from ceph_tpu.osd.osd import OSD
+
+from test_mon import free_port_addrs
+
+
+def fast_conf(whoami: int) -> Config:
+    return Config(
+        {
+            "name": f"osd.{whoami}",
+            "osd_heartbeat_interval": 0.1,
+            "osd_heartbeat_grace": 0.6,
+        },
+        env=False,
+    )
+
+
+async def start_cluster(n_mons: int, n_osds: int):
+    monmap = MonMap(addrs=free_port_addrs(n_mons))
+    mons = [Monitor(name, monmap, election_timeout=0.3) for name in monmap.addrs]
+    for m in mons:
+        await m.start()
+    for m in mons:
+        await m.wait_for_quorum()
+    osds = [OSD(i, monmap, conf=fast_conf(i)) for i in range(n_osds)]
+    for o in osds:
+        await o.start()
+    for o in osds:
+        await o.wait_for_up()
+    return monmap, mons, osds
+
+
+async def stop_cluster(mons, osds):
+    for o in osds:
+        if o._running:
+            await o.stop()
+    for m in mons:
+        await m.stop()
+    await asyncio.sleep(0.05)
+
+
+async def wait_until(pred, timeout: float, what: str = "") -> None:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not pred():
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.02)
+
+
+class TestReplicatedCluster:
+    def test_put_get_roundtrip(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("rbdpool", "replicated", size=3, pg_num=4)
+            ioctx = await client.open_ioctx("rbdpool")
+
+            payload = bytes(range(256)) * 16
+            await ioctx.write_full("obj1", payload)
+            assert await ioctx.read("obj1") == payload
+            assert await ioctx.stat("obj1") == len(payload)
+
+            await ioctx.append("obj1", b"tail")
+            assert await ioctx.read("obj1") == payload + b"tail"
+
+            await ioctx.setxattr("obj1", "user.k", b"v1")
+            assert await ioctx.getxattr("obj1", "user.k") == b"v1"
+
+            await ioctx.remove("obj1")
+            with pytest.raises(RadosError):
+                await ioctx.stat("obj1")
+
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_replica_consistency(self):
+        """Every replica OSD holds the object bytes (fan-out committed)."""
+
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("rp", "replicated", size=3, pg_num=2)
+            ioctx = await client.open_ioctx("rp")
+            await ioctx.write_full("rep-obj", b"replicated-bytes")
+
+            def replicas_have_it():
+                holders = 0
+                for o in osds:
+                    for coll in o.store.list_collections():
+                        try:
+                            if b"replicated-bytes" in o.store.read(
+                                coll, "rep-obj", 0, 0
+                            ):
+                                holders += 1
+                                break
+                        except Exception:
+                            continue
+                return holders == 3
+
+            await wait_until(replicas_have_it, 3.0, "3 replicas")
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestErasureCodedCluster:
+    def test_ec_pool_put_get(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 4)
+            client = Rados(monmap)
+            await client.connect()
+            rv, rs, _ = await client.mon_command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "k2m1",
+                    "profile": ["k=2", "m=1", "plugin=tpu"],
+                }
+            )
+            assert rv == 0, rs
+            await client.pool_create("ecpool", "erasure", profile="k2m1", pg_num=4)
+            ioctx = await client.open_ioctx("ecpool")
+
+            # Multi-stripe object: 3 stripes of 2x4K + a partial tail.
+            payload = bytes((i * 7 + 3) % 256 for i in range(3 * 8192 + 1000))
+            await ioctx.write_full("big", payload)
+            assert await ioctx.read("big") == payload
+            assert await ioctx.stat("big") == len(payload)
+            # ranged read crossing a stripe boundary
+            assert await ioctx.read("big", 5000, 7000) == payload[7000:12000]
+
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_osd_failure_degraded_read_and_recovery(self):
+        """Kill an OSD: heartbeat quorum marks it down, EC reads
+        reconstruct, and the restarted OSD recovers via peering+push —
+        the §3.2 decode path end to end over the wire."""
+
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 4)
+            client = Rados(monmap)
+            await client.connect()
+            rv, rs, _ = await client.mon_command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "k2m1f",
+                    "profile": ["k=2", "m=1", "plugin=tpu"],
+                }
+            )
+            assert rv == 0, rs
+            await client.pool_create("ecf", "erasure", profile="k2m1f", pg_num=2)
+            ioctx = await client.open_ioctx("ecf")
+
+            objs = {f"o{i}": bytes([i]) * (8192 + 100 * i) for i in range(4)}
+            for oid, data in objs.items():
+                await ioctx.write_full(oid, data)
+
+            # Kill osd.3; survivors report it, mon needs 2 reporters.
+            victim = osds[3]
+            victim_store = victim.store
+            await victim.stop()
+            await wait_until(
+                lambda: not mons[0].osdmon.osdmap.is_up(3),
+                8.0,
+                "mon marking osd.3 down",
+            )
+
+            # Degraded reads: every object still fully readable (k=2 of 3).
+            for oid, data in objs.items():
+                assert await ioctx.read(oid) == data, f"degraded read {oid}"
+
+            # Write while degraded (a new object lands on remaining shards).
+            await ioctx.write_full("during", b"D" * 8192)
+            assert await ioctx.read("during") == b"D" * 8192
+
+            # Restart osd.3 on its old store; peering computes the missing
+            # set from the log delta and recovery pushes rebuilt shards.
+            revived = OSD(3, monmap, conf=fast_conf(3), store=victim_store)
+            await revived.start()
+            await revived.wait_for_up()
+            osds[3] = revived
+
+            def all_recovered():
+                return all(
+                    pg.is_clean
+                    for o in osds
+                    if o._running
+                    for pg in o.pgs.values()
+                    if pg.peering.is_primary()
+                )
+
+            await wait_until(all_recovered, 10.0, "recovery to clean")
+            for oid, data in objs.items():
+                assert await ioctx.read(oid) == data
+            assert await ioctx.read("during") == b"D" * 8192
+
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
