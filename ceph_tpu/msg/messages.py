@@ -405,3 +405,39 @@ class MOSDPGPull(Message):
     (src/messages/MOSDPGPull.h)."""
 
     FIELDS = [("pgid", PgId), ("oid", "str"), ("epoch", "u32"), ("from_osd", "u32")]
+
+
+# --- scrub -------------------------------------------------------------------
+
+
+@message_type(27)
+class MOSDRepScrub(Message):
+    """Primary asks a shard for its scrub map over an object chunk
+    (src/messages/MOSDRepScrub.h; chunky scrub in
+    src/osd/scrubber/pg_scrubber.cc)."""
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("epoch", "u32"),
+        ("from_osd", "u32"),
+        ("deep", "bool"),
+        ("scrub_tid", "u64"),
+        # chunk boundaries: scrub objects with start <= name < end
+        # ("" end = unbounded)
+        ("chunk_start", "str"),
+        ("chunk_end", "str"),
+    ]
+
+
+@message_type(28)
+class MOSDRepScrubMap(Message):
+    """Shard's scrub map reply (src/messages/MOSDRepScrubMap.h);
+    `scrub_map` is a JSON blob of oid -> {size, digest, ...}."""
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("epoch", "u32"),
+        ("from_osd", "u32"),
+        ("scrub_tid", "u64"),
+        ("scrub_map", "bytes"),
+    ]

@@ -79,6 +79,7 @@ class Connection:
         self.peer_addr = peer_addr
         self.peer_name = ""  # filled by hello exchange
         self.policy = policy
+        self.auth_entity = ""  # authenticated peer (cephx server side)
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._send_lock = asyncio.Lock()
@@ -105,10 +106,30 @@ class Connection:
         hello = Frame(TAG_HELLO, [self.msgr.name.encode(), self.msgr.addr.encode()])
         writer.write(hello.pack(self.msgr.crc_data))
         await writer.drain()
-        frame = await read_frame(reader)
-        if frame.tag != TAG_HELLO:
-            raise FrameError(f"expected hello, got tag {frame.tag}")
-        self.peer_name = frame.segments[0].decode()
+        try:
+            frame = await read_frame(reader)
+            if frame.tag != TAG_HELLO:
+                raise FrameError(f"expected hello, got tag {frame.tag}")
+            self.peer_name = frame.segments[0].decode()
+            if self.msgr.auth is not None:
+                # cephx handshake rides auth frames before the session
+                # opens (ProtocolV2 auth phase).  Bounded: an auth-less
+                # peer silently ignores auth frames, and an unbounded wait
+                # here would wedge the connection's send lock forever.
+                await asyncio.wait_for(
+                    self.msgr.auth.client_auth(
+                        *_frame_io(reader, writer, self.msgr.crc_data),
+                        peer=self.peer_addr,
+                    ),
+                    timeout=5.0,
+                )
+        except Exception as e:
+            # close the half-open socket and keep send_message's contract:
+            # connection failures surface as ConnectionError
+            writer.close()
+            raise ConnectionError(
+                f"handshake with {self.peer_addr} failed: {e}"
+            ) from e
         await self._attach(reader, writer)
 
     async def close(self) -> None:
@@ -190,6 +211,21 @@ def _split(addr: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def _frame_io(reader, writer, crc_data: bool):
+    """(send_frame, recv_frame) pair for the auth handshake — raw tagged
+    frames on the not-yet-attached stream."""
+
+    async def send_frame(tag: int, segments: list[bytes]) -> None:
+        writer.write(Frame(tag, segments).pack(crc_data))
+        await writer.drain()
+
+    async def recv_frame() -> tuple[int, list[bytes]]:
+        frame = await read_frame(reader)
+        return frame.tag, frame.segments
+
+    return send_frame, recv_frame
+
+
 class Messenger:
     """The endpoint: bind/listen + outgoing connection cache
     (AsyncMessenger).  One per daemon role, as in ceph_osd.cc:548-561
@@ -202,6 +238,7 @@ class Messenger:
         crc_data: bool = True,
         inject_socket_failures: int = 0,
         dispatch_throttle_bytes: int = 0,
+        auth=None,  # CephxAuth (src/auth/cephx); None = auth_none
     ):
         self.name = name  # entity name, e.g. "osd.0"
         self.addr = addr  # host:port once bound (or for identification)
@@ -218,6 +255,7 @@ class Messenger:
         )
         self.default_policy = Policy.lossy_client()
         self._accepted: list[Connection] = []
+        self.auth = auth
 
     # -- setup ---------------------------------------------------------------
 
@@ -280,6 +318,14 @@ class Messenger:
             reply = Frame(TAG_HELLO, [self.name.encode(), self.addr.encode()])
             writer.write(reply.pack(self.crc_data))
             await writer.drain()
+            if self.auth is not None:
+                try:
+                    conn.auth_entity = await self.auth.server_auth(
+                        *_frame_io(reader, writer, self.crc_data)
+                    )
+                except Exception:  # AuthError and protocol noise alike
+                    writer.close()
+                    return
             await conn._attach(reader, writer)
             self._accepted.append(conn)
             for d in self.dispatchers:

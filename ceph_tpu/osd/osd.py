@@ -53,6 +53,8 @@ from ..msg.messages import (
     MOSDPing,
     MOSDRepOp,
     MOSDRepOpReply,
+    MOSDRepScrub,
+    MOSDRepScrubMap,
 )
 from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ..os.memstore import MemStore
@@ -73,6 +75,7 @@ BACKEND_MSGS = (
     MOSDPGPull,
 )
 PEERING_MSGS = (MOSDPGQuery, MOSDPGNotify, MOSDPGLog)
+SCRUB_MSGS = (MOSDRepScrub, MOSDRepScrubMap)
 
 
 class OSD(Dispatcher):
@@ -218,7 +221,9 @@ class OSD(Dispatcher):
     # -- dispatch --------------------------------------------------------------
 
     def ms_can_fast_dispatch(self, msg: Message) -> bool:
-        return isinstance(msg, BACKEND_MSGS + PEERING_MSGS + (MOSDPing, MOSDOp))
+        return isinstance(
+            msg, BACKEND_MSGS + PEERING_MSGS + SCRUB_MSGS + (MOSDPing, MOSDOp)
+        )
 
     def ms_fast_dispatch(self, conn: Connection, msg: Message) -> None:
         if isinstance(msg, MOSDPing):
@@ -233,6 +238,8 @@ class OSD(Dispatcher):
             return
         if isinstance(msg, PEERING_MSGS):
             pg.handle_peering_message(msg)
+        elif isinstance(msg, SCRUB_MSGS):
+            pg.handle_scrub_message(msg)
         else:
             pg.backend.handle_message(msg)
 

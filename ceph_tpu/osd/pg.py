@@ -21,6 +21,7 @@ client ops and drives recovery:
 
 from __future__ import annotations
 
+import time
 from typing import TYPE_CHECKING, Callable
 
 from ..common.errs import EAGAIN, EINVAL, ENODATA, ENOENT
@@ -39,7 +40,7 @@ from .ec_transaction import PGTransaction
 from .osdmap import PG_NONE, POOL_TYPE_ERASURE, PgPool
 from .peering import PeeringState
 from .pg_backend import PGListener, build_pg_backend, shard_coll
-from .pg_log import Eversion, LogEntry, PGLog, PgInfo
+from .pg_log import Eversion, LogEntry, Missing, PGLog, PgInfo
 
 WRITE_OPS = {
     OSDOp.WRITE,
@@ -74,6 +75,9 @@ class PG(PGListener):
             list_local_objects=self._list_local,
         )
         self.backend = build_pg_backend(pool, profiles, self, osd.store)
+        from .scrubber import PgScrubber
+
+        self.scrubber = PgScrubber(self)
         self.recovering: set[str] = set()
         self.waiting_for_degraded: dict[str, list[Callable[[], None]]] = {}
         self._colls_made: set[str] = set()
@@ -97,11 +101,14 @@ class PG(PGListener):
             return
         self._acting = list(acting)
         self._ensure_local_coll()
+        self.scrubber.reset()  # an interval change aborts in-flight scrubs
         self.peering.start_peering_interval(epoch, acting)
 
     def tick(self) -> None:
-        """Periodic liveness: retry stuck peering, keep recovery moving."""
+        """Periodic liveness: retry stuck peering, keep recovery moving,
+        abort scrubs whose shard died."""
         self.peering.tick()
+        self.scrubber.tick(time.monotonic())
         if self.peering.is_active():
             self._kick_recovery()
 
@@ -232,6 +239,10 @@ class PG(PGListener):
             self._recover_one(oid)
             return
         if any(op.op in WRITE_OPS for op in msg.ops):
+            if self.scrubber.write_blocked(oid):
+                # write_blocked_by_scrub: hold until the chunk completes
+                self.scrubber.waiting_writes.append(lambda: self.do_op(msg, reply))
+                return
             key = msg.reqid.key()
             done = self._reqid_results.get(key)
             if done is not None:
@@ -438,6 +449,56 @@ class PG(PGListener):
             self.on_global_recover(oid)
 
         self.backend.recover_object(oid, missing_on, on_complete)
+
+    # -- scrub -----------------------------------------------------------------
+
+    def scrub(self, deep: bool = False, repair: bool = False, on_done=None) -> bool:
+        """Primary-only scrub kick (PgScrubber)."""
+        if not self.peering.is_primary() or not self.peering.is_active():
+            return False
+        return self.scrubber.start(deep=deep, repair=repair, on_done=on_done)
+
+    def handle_scrub_message(self, msg) -> bool:
+        from ..msg.messages import MOSDRepScrub, MOSDRepScrubMap
+
+        if isinstance(msg, MOSDRepScrub):
+            self.scrubber.handle_rep_scrub(msg)
+        elif isinstance(msg, MOSDRepScrubMap):
+            self.scrubber.handle_scrub_map(msg)
+        else:
+            return False
+        return True
+
+    def send_scrub(self, osd: int, msg) -> None:
+        if osd == self.osd.whoami:
+            self.scrubber.handle_rep_scrub(msg)
+        else:
+            self.osd.send_cluster(osd, msg)
+
+    def send_scrub_reply(self, osd: int, msg) -> None:
+        if osd == self.osd.whoami:
+            self.scrubber.handle_scrub_map(msg)
+        else:
+            self.osd.send_cluster(osd, msg)
+
+    def mark_shard_missing(self, oid: str, osd: int) -> None:
+        """Repair path: treat a corrupt shard as missing so recovery
+        rebuilds it (the reference's repair → recovery handoff)."""
+        v = self.pg_log.head
+        if osd == self.osd.whoami:
+            self.peering.missing.add(oid, v)
+            if self.pool.type != POOL_TYPE_ERASURE:
+                # Replicated recovery pulls from a replica only when the
+                # primary's copy is ABSENT (recover_object's exists()
+                # check) — a corrupt-but-present copy would be pushed back
+                # out as "repair".  Drop it so the pull path engages.
+                coll = shard_coll(self.pgid, -1)
+                self.osd.store.queue_transaction(Transaction().remove(coll, oid))
+        else:
+            self.peering.peer_missing.setdefault(osd, Missing()).add(oid, v)
+
+    def request_recovery(self, oid: str) -> None:
+        self._recover_one(oid)
 
     @property
     def is_clean(self) -> bool:

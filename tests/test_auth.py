@@ -1,0 +1,257 @@
+"""cephx auth tests (src/auth/cephx mirror).
+
+Models the reference's auth behaviors: keyring file round trip, mutual
+challenge/response success, bad-key rejection, unknown-entity rejection
+without existence leaks, ticket verification, and the messenger-level
+handshake gating real connections.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.auth import AuthError, CephxAuth, KeyRing, generate_secret
+from ceph_tpu.msg.messages import MPing
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+
+
+class TestKeyRing:
+    def test_roundtrip(self, tmp_path):
+        kr = KeyRing()
+        s1 = kr.add("client.admin")
+        s2 = kr.add("osd.0")
+        path = str(tmp_path / "keyring")
+        kr.save(path)
+        loaded = KeyRing.load(path)
+        assert loaded.get("client.admin") == s1
+        assert loaded.get("osd.0") == s2
+        assert loaded.entities() == ["client.admin", "osd.0"]
+
+    def test_ini_format(self):
+        kr = KeyRing()
+        kr.add("mon.")
+        text = kr.dumps()
+        assert text.startswith("[mon.]")
+        assert "key = " in text
+
+
+class _Pipe:
+    """In-memory frame channel for protocol-level tests."""
+
+    def __init__(self):
+        self.a_to_b: asyncio.Queue = asyncio.Queue()
+        self.b_to_a: asyncio.Queue = asyncio.Queue()
+
+    def end_a(self):
+        async def send(tag, segs):
+            await self.a_to_b.put((tag, segs))
+
+        async def recv():
+            return await self.b_to_a.get()
+
+        return send, recv
+
+    def end_b(self):
+        async def send(tag, segs):
+            await self.b_to_a.put((tag, segs))
+
+        async def recv():
+            return await self.a_to_b.get()
+
+        return send, recv
+
+
+def run_handshake(client: CephxAuth, server: CephxAuth):
+    async def go():
+        pipe = _Pipe()
+        c = asyncio.create_task(client.client_auth(*pipe.end_a()))
+        s = asyncio.create_task(server.server_auth(*pipe.end_b()))
+        return await asyncio.gather(c, s)
+
+    return asyncio.run(go())
+
+
+class TestCephxProtocol:
+    def test_success_and_ticket(self):
+        kr = KeyRing()
+        secret = kr.add("client.admin")
+        server = CephxAuth("mon.a", kr.add("mon.a"), keyring=kr)
+        client = CephxAuth.for_client("client.admin", secret)
+        ticket, entity = run_handshake(client, server)
+        assert entity == "client.admin"
+        assert server.verify_ticket(ticket) == "client.admin"
+
+    def test_bad_key_rejected(self):
+        kr = KeyRing()
+        kr.add("client.admin")
+        server = CephxAuth("mon.a", kr.add("mon.a"), keyring=kr)
+        client = CephxAuth.for_client("client.admin", generate_secret())
+        with pytest.raises(AuthError):
+            run_handshake(client, server)
+
+    def test_unknown_entity_rejected(self):
+        kr = KeyRing()
+        server = CephxAuth("mon.a", kr.add("mon.a"), keyring=kr)
+        client = CephxAuth.for_client("client.ghost", generate_secret())
+        with pytest.raises(AuthError):
+            run_handshake(client, server)
+
+    def test_forged_ticket_rejected(self):
+        kr = KeyRing()
+        server = CephxAuth("mon.a", kr.add("mon.a"), keyring=kr)
+        other = CephxAuth("mon.b", generate_secret(), keyring=kr)
+        ticket = other.issue_ticket("client.evil")
+        assert server.verify_ticket(ticket) is None
+
+
+class _Sink(Dispatcher):
+    def __init__(self):
+        self.got = []
+
+    def ms_dispatch(self, conn, msg):
+        self.got.append(msg)
+        return True
+
+
+class TestMessengerAuth:
+    def test_authenticated_session(self):
+        async def run():
+            kr = KeyRing()
+            kr.add("osd.0")
+            kr.add("osd.1")
+            server_auth = CephxAuth.for_daemon("osd.0", kr)
+            client_auth = CephxAuth.for_daemon("osd.1", kr)
+            srv = Messenger("osd.0", auth=server_auth)
+            sink = _Sink()
+            srv.add_dispatcher_tail(sink)
+            await srv.bind("127.0.0.1:0")
+            cli = Messenger("osd.1", auth=client_auth)
+            await cli.send_to(srv.addr, MPing(stamp=1.0))
+            await asyncio.sleep(0.1)
+            assert len(sink.got) == 1
+            assert srv._accepted[0].auth_entity == "osd.1"
+            await cli.shutdown()
+            await srv.shutdown()
+
+        asyncio.run(run())
+
+    def test_wrong_key_cannot_connect(self):
+        async def run():
+            kr = KeyRing()
+            kr.add("osd.0")
+            kr.add("osd.1")
+            server_auth = CephxAuth.for_daemon("osd.0", kr)
+            bad = CephxAuth.for_client("osd.1", generate_secret())
+            srv = Messenger("osd.0", auth=server_auth)
+            sink = _Sink()
+            srv.add_dispatcher_tail(sink)
+            await srv.bind("127.0.0.1:0")
+            cli = Messenger("osd.1", auth=bad)
+            with pytest.raises((AuthError, ConnectionError)):
+                await cli.send_to(srv.addr, MPing(stamp=1.0))
+            await asyncio.sleep(0.1)
+            assert not sink.got
+            await cli.shutdown()
+            await srv.shutdown()
+
+        asyncio.run(run())
+
+    def test_unauthenticated_client_vs_auth_server(self):
+        async def run():
+            kr = KeyRing()
+            kr.add("osd.0")
+            server_auth = CephxAuth.for_daemon("osd.0", kr)
+            srv = Messenger("osd.0", auth=server_auth)
+            sink = _Sink()
+            srv.add_dispatcher_tail(sink)
+            await srv.bind("127.0.0.1:0")
+            cli = Messenger("client.x")  # no auth: sends a message frame
+            try:
+                await cli.send_to(srv.addr, MPing(stamp=1.0))
+            except ConnectionError:
+                pass
+            await asyncio.sleep(0.1)
+            assert not sink.got  # server never dispatched it
+            await cli.shutdown()
+            await srv.shutdown()
+
+        asyncio.run(run())
+
+
+class TestTicketFastPath:
+    def test_reconnect_skips_challenge(self):
+        """A ticket from the first handshake rides the second one
+        (CephxTicketManager fast path)."""
+
+        async def run():
+            kr = KeyRing()
+            secret = kr.add("client.admin")
+            server = CephxAuth("mon.a", kr.add("mon.a"), keyring=kr)
+            client = CephxAuth.for_client("client.admin", secret)
+
+            class Channel:
+                def __init__(self):
+                    self.c2s: asyncio.Queue = asyncio.Queue()
+                    self.s2c: asyncio.Queue = asyncio.Queue()
+                    self.rounds = 0
+
+                def client_end(self):
+                    async def send(tag, segs):
+                        self.rounds += 1
+                        await self.c2s.put((tag, segs))
+
+                    async def recv():
+                        return await self.s2c.get()
+
+                    return send, recv
+
+                def server_end(self):
+                    async def send(tag, segs):
+                        await self.s2c.put((tag, segs))
+
+                    async def recv():
+                        return await self.c2s.get()
+
+                    return send, recv
+
+            ch1 = Channel()
+            t1, e1 = await asyncio.gather(
+                client.client_auth(*ch1.client_end(), peer="mon-addr"),
+                server.server_auth(*ch1.server_end()),
+            )
+            assert e1 == "client.admin" and ch1.rounds == 2  # full handshake
+
+            ch2 = Channel()
+            t2, e2 = await asyncio.gather(
+                client.client_auth(*ch2.client_end(), peer="mon-addr"),
+                server.server_auth(*ch2.server_end()),
+            )
+            assert e2 == "client.admin"
+            assert ch2.rounds == 1  # ticket accepted: one client frame only
+            assert server.verify_ticket(t2) == "client.admin"
+
+        asyncio.run(run())
+
+    def test_mixed_config_does_not_deadlock(self):
+        """Auth client vs auth-less server: bounded failure, not a hang
+        (the server's read loop silently ignores auth frames)."""
+
+        async def run():
+            kr = KeyRing()
+            kr.add("osd.1")
+            srv = Messenger("osd.0")  # NO auth
+            sink = _Sink()
+            srv.add_dispatcher_tail(sink)
+            await srv.bind("127.0.0.1:0")
+            cli = Messenger("osd.1", auth=CephxAuth.for_daemon("osd.1", kr))
+            cli_conn = cli.get_connection(srv.addr)
+            cli_conn_auth_timeout = 5.0  # messenger clamps the handshake
+            with pytest.raises(ConnectionError):
+                await asyncio.wait_for(
+                    cli.send_to(srv.addr, MPing(stamp=1.0)),
+                    cli_conn_auth_timeout + 2.0,
+                )
+            await cli.shutdown()
+            await srv.shutdown()
+
+        asyncio.run(run())

@@ -1,0 +1,186 @@
+"""Scrub tests over a live cluster (src/osd/scrubber mirror).
+
+Models qa's scrub/repair behaviors: a clean deep scrub reports zero
+errors; silent shard corruption (flipped bytes on one OSD's store — the
+reference's EIO/corruption injection in test-erasure-eio.sh) is caught
+by the hinfo digest check; repair marks the shard missing and recovery
+rebuilds it byte-identically.
+"""
+
+import asyncio
+
+from ceph_tpu.client import Rados
+from ceph_tpu.osd.pg_backend import shard_coll
+from ceph_tpu.os.transaction import Transaction
+
+from test_cluster import start_cluster, stop_cluster, wait_until
+
+
+async def make_ec_cluster(objects: dict[str, bytes]):
+    monmap, mons, osds = await start_cluster(1, 4)
+    client = Rados(monmap)
+    await client.connect()
+    rv, rs, _ = await client.mon_command(
+        {
+            "prefix": "osd erasure-code-profile set",
+            "name": "sk2m1",
+            "profile": ["k=2", "m=1", "plugin=tpu"],
+        }
+    )
+    assert rv == 0, rs
+    await client.pool_create("spool", "erasure", profile="sk2m1", pg_num=1)
+    ioctx = await client.open_ioctx("spool")
+    for oid, data in objects.items():
+        await ioctx.write_full(oid, data)
+    return monmap, mons, osds, client, ioctx
+
+
+def find_primary_pg(osds, pool_name="spool"):
+    for o in osds:
+        for pg in o.pgs.values():
+            if pg.pool.name == pool_name and pg.peering.is_primary():
+                return o, pg
+    raise AssertionError("no primary pg")
+
+
+async def run_scrub(pg, deep=False, repair=False, timeout=5.0):
+    done = asyncio.get_event_loop().create_future()
+    assert pg.scrub(deep=deep, repair=repair, on_done=lambda r: done.set_result(r))
+    return await asyncio.wait_for(done, timeout)
+
+
+class TestScrub:
+    def test_clean_deep_scrub(self):
+        async def run():
+            objs = {f"s{i}": bytes([i + 1]) * (4096 * (i + 1)) for i in range(5)}
+            monmap, mons, osds, client, ioctx = await make_ec_cluster(objs)
+            osd, pg = find_primary_pg(osds)
+            res = await run_scrub(pg, deep=True)
+            assert res.clean, res.inconsistent
+            assert res.objects_scrubbed == len(objs)
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_detects_and_repairs_corruption(self):
+        async def run():
+            payload = bytes(range(256)) * 64  # 16 KiB = 2 stripes
+            monmap, mons, osds, client, ioctx = await make_ec_cluster(
+                {"victim": payload}
+            )
+            osd, pg = find_primary_pg(osds)
+            # Corrupt a non-primary shard's chunk bytes directly on disk
+            # (the scrub must catch what the write path never sees).
+            acting = pg.acting()
+            bad_shard = 1
+            bad_osd = next(o for o in osds if o.whoami == acting[bad_shard])
+            coll = shard_coll(pg.pgid, bad_shard)
+            good = bad_osd.store.read(coll, "victim", 0, 0)
+            corrupted = bytes([good[0] ^ 0xFF]) + good[1:]
+            bad_osd.store.queue_transaction(
+                Transaction().write(coll, "victim", 0, corrupted)
+            )
+
+            res = await run_scrub(pg, deep=True)
+            assert not res.clean
+            assert "victim" in res.inconsistent
+            assert acting[bad_shard] in res.inconsistent["victim"]
+
+            # Shallow scrub does NOT read data: corruption stays hidden
+            res_shallow = await run_scrub(pg, deep=False)
+            assert res_shallow.clean
+
+            # Repair: mark missing + recover, then the shard is clean again
+            res2 = await run_scrub(pg, deep=True, repair=True)
+            assert res2.repaired == 1
+            await wait_until(lambda: pg.is_clean, 5.0, "repair recovery")
+            assert bad_osd.store.read(coll, "victim", 0, 0) == good
+            res3 = await run_scrub(pg, deep=True)
+            assert res3.clean, res3.inconsistent
+            # and the object still reads back correctly
+            assert await ioctx.read("victim") == payload
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_chunked_scrub_covers_many_objects(self):
+        async def run():
+            objs = {f"m{i:03d}": bytes([i % 256]) * 4096 for i in range(60)}
+            monmap, mons, osds, client, ioctx = await make_ec_cluster(objs)
+            osd, pg = find_primary_pg(osds)
+            res = await run_scrub(pg, deep=True, timeout=15.0)
+            assert res.objects_scrubbed == 60  # > CHUNK_MAX forces chunking
+            assert res.clean
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestScrubRobustness:
+    def test_scrub_aborts_when_shard_dies(self):
+        """A crashed replica mid-gather aborts the scrub via the tick
+        timeout instead of wedging the scrubber forever."""
+
+        async def run():
+            objs = {"a": b"A" * 8192}
+            monmap, mons, osds, client, ioctx = await make_ec_cluster(objs)
+            osd, pg = find_primary_pg(osds)
+            pg.scrubber.gather_timeout = 0.3
+            # Kill a replica, then scrub before the mon notices it's down.
+            victim = next(o for o in osds if o.whoami != osd.whoami
+                          and o.whoami in pg.acting())
+            await victim.stop()
+            done = asyncio.get_event_loop().create_future()
+            assert pg.scrub(deep=True, on_done=lambda r: done.set_result(r))
+            res = await asyncio.wait_for(done, 10.0)
+            assert res.aborted and not res.clean
+            assert not pg.scrubber.active  # can scrub again later
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_replicated_repair_pulls_good_copy(self):
+        """A corrupt PRIMARY copy in a size-3 replicated pool is repaired
+        from a replica, not re-pushed (majority picks the good copy)."""
+        from test_cluster import start_cluster
+
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("rp3", "replicated", size=3, pg_num=1)
+            ioctx = await client.open_ioctx("rp3")
+            payload = b"good-bytes" * 100
+            await ioctx.write_full("robj", payload)
+
+            await wait_until(
+                lambda: sum(
+                    1 for o in osds
+                    for coll in o.store.list_collections()
+                    if o.store.exists(coll, "robj")
+                ) == 3,
+                3.0,
+                "3 replicas",
+            )
+            osd, pg = find_primary_pg(osds, "rp3")
+            coll = shard_coll(pg.pgid, -1)
+            # corrupt the PRIMARY's copy
+            bad = b"EVIL" + payload[4:]
+            osd.store.queue_transaction(Transaction().write(coll, "robj", 0, bad))
+
+            res = await run_scrub(pg, deep=True, repair=True)
+            assert not res.clean
+            assert osd.whoami in res.inconsistent["robj"]
+            await wait_until(lambda: pg.is_clean, 5.0, "repair recovery")
+            assert osd.store.read(coll, "robj", 0, 0) == payload
+            assert await ioctx.read("robj") == payload
+            res2 = await run_scrub(pg, deep=True)
+            assert res2.clean
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
