@@ -40,6 +40,7 @@ TAG_AUTH_BAD = 13
 
 CHALLENGE_LEN = 16
 TICKET_VALIDITY = 3600.0  # auth_service_ticket_ttl
+PROOF_FRESHNESS = 60.0  # seconds a ticket proof's timestamp stays valid
 
 
 class AuthError(Exception):
@@ -70,6 +71,8 @@ class CephxAuth:
         self.service_secret = service_secret or generate_secret()
         # peer addr -> ticket from that peer's service (CephxTicketManager)
         self._tickets: dict[str, bytes] = {}
+        # recently accepted ticket proofs (replay rejection window)
+        self._seen_proofs: dict[bytes, float] = {}
 
     @classmethod
     def for_daemon(cls, entity: str, keyring: KeyRing) -> "CephxAuth":
@@ -92,7 +95,16 @@ class CephxAuth:
         server accepts it the challenge round-trip is skipped (the
         reference's ticket-based fast path, CephxTicketManager)."""
         cached = self._tickets.get(peer, b"")
-        await send_frame(TAG_AUTH_REQUEST, [self.entity.encode(), cached])
+        if cached:
+            # Ticket + proof-of-secret: possession of a (plaintext-carried)
+            # ticket alone must not authenticate — the proof binds it to
+            # the entity key and a fresh timestamp (the reference's
+            # CEPHX_V2 authorizer carries the same freshness binding).
+            ts = str(time.time()).encode()
+            req = [self.entity.encode(), cached, ts, _hmac(self.secret, cached, ts)]
+        else:
+            req = [self.entity.encode()]
+        await send_frame(TAG_AUTH_REQUEST, req)
         tag, segs = await recv_frame()
         if tag == TAG_AUTH_DONE and cached:
             # Ticket accepted: server proves key knowledge over the ticket.
@@ -129,11 +141,17 @@ class CephxAuth:
             raise AuthError("protocol error: no auth request")
         entity = segs[0].decode()
         secret = self.keyring.get(entity) if self.keyring else None
-        presented = segs[1] if len(segs) > 1 else b""
-        if presented and secret is not None:
-            # Ticket fast path: a valid unexpired ticket we issued skips
-            # the challenge (mutual auth = HMAC over the ticket itself).
-            if self.verify_ticket(presented) == entity:
+        if len(segs) >= 4 and secret is not None:
+            # Ticket fast path: the ticket must verify AND the client must
+            # prove key knowledge over (ticket, fresh timestamp); replayed
+            # proofs are rejected (the reference's CEPHX_V2 nonce window).
+            presented, ts, proof = segs[1], segs[2], segs[3]
+            if (
+                self.verify_ticket(presented) == entity
+                and self._fresh(ts)
+                and hmac.compare_digest(proof, _hmac(secret, presented, ts))
+                and self._unseen(proof)
+            ):
                 confirm = _hmac(secret, presented)
                 renewed = self.issue_ticket(entity)
                 await send_frame(TAG_AUTH_DONE, [confirm, renewed])
@@ -158,6 +176,26 @@ class CephxAuth:
         ticket = self.issue_ticket(entity)
         await send_frame(TAG_AUTH_DONE, [confirm, ticket])
         return entity
+
+    # -- ticket proof helpers --------------------------------------------------
+
+    def _fresh(self, ts: bytes) -> bool:
+        try:
+            return abs(time.time() - float(ts.decode())) < PROOF_FRESHNESS
+        except ValueError:
+            return False
+
+    def _unseen(self, proof: bytes) -> bool:
+        """Reject replayed proofs inside the freshness window."""
+        seen = self._seen_proofs
+        now = time.time()
+        for p, exp in list(seen.items()):
+            if exp < now:
+                del seen[p]
+        if proof in seen:
+            return False
+        seen[proof] = now + PROOF_FRESHNESS
+        return True
 
     # -- tickets (CephxSessionHandler) -----------------------------------------
 

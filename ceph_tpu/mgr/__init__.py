@@ -1,0 +1,7 @@
+"""Manager daemon + module runtime (SURVEY.md §2.7; src/mgr +
+src/pybind/mgr)."""
+
+from .mgr import Mgr
+from .modules import MgrModule
+
+__all__ = ["Mgr", "MgrModule"]

@@ -1,0 +1,167 @@
+"""Mgr daemon — mirror of src/mgr/ (MgrStandby/Mgr/DaemonServer).
+
+Structure mirrored:
+
+- Boot: beacon to the monitors (MMgrBeacon → MgrMonitor); the mon map
+  decides who is active; standbys keep beaconing and take over on
+  failover (MgrStandby::send_beacon).
+- **DaemonServer** (src/mgr/DaemonServer.cc): receives MMgrReport from
+  every daemon, keeping per-daemon perf-counter and status state
+  (DaemonStateIndex analog) that modules consume.
+- **Module runtime** (src/mgr/PyModuleRegistry + src/pybind/mgr):
+  modules register on the active mgr and get a `serve`-style periodic
+  `tick()` plus access to the daemon state, the osdmap, and mon
+  commands — the same surface the reference's MgrModule exposes
+  (cluster maps via `self.get()`, `mon_command`, perf counters).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ..common.config import Config
+from ..common.log import dout
+from ..mon.client import MonClient
+from ..mon.monmap import MonMap
+from ..msg.messages import MMgrBeacon, MMgrMap, MMgrReport, MOSDMap
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..osd.osdmap import OSDMap, advance_map
+
+
+class DaemonState:
+    """One daemon's latest report (DaemonStateIndex entry)."""
+
+    def __init__(self) -> None:
+        self.perf: dict = {}
+        self.status: dict = {}
+        self.last_report = 0.0
+
+
+class Mgr(Dispatcher):
+    def __init__(
+        self,
+        name: str,
+        monmap: MonMap,
+        conf: Config | None = None,
+        addr: str = "127.0.0.1:0",
+    ):
+        self.name = name
+        self.monmap = monmap
+        self.conf = conf or Config({"name": f"mgr.{name}"})
+        self._bind_addr = addr
+        self.msgr = Messenger(f"mgr.{name}")
+        self.monc = MonClient(f"mgr.{name}", monmap)
+        self.osdmap = OSDMap()
+        self.mgrmap_epoch = 0
+        self.active = False
+        self.daemons: dict[str, DaemonState] = {}
+        self.modules: list = []
+        self._tasks: list[asyncio.Task] = []
+        self._running = False
+        self.beacon_interval = 1.0
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.msgr.bind(self._bind_addr)
+        self.msgr.add_dispatcher_head(self)
+        self.monc.on_osdmap = self._on_osdmap
+        self.monc.msgr.add_dispatcher_tail(self)  # mgrmap arrives here
+        self._running = True
+        await self.monc.subscribe("osdmap")
+        await self.monc.subscribe("mgrmap")
+        self._tasks.append(asyncio.create_task(self._beacon_loop()))
+        self._tasks.append(asyncio.create_task(self._module_loop()))
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        await self.msgr.shutdown()
+        await self.monc.msgr.shutdown()
+
+    async def wait_for_active(self, timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self.active:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"mgr.{self.name} never became active")
+            await asyncio.sleep(0.02)
+
+    # -- beacons / maps --------------------------------------------------------
+
+    async def _beacon_loop(self) -> None:
+        while self._running:
+            beacon = MMgrBeacon(name=self.name, addr=self.msgr.addr)
+            for mon_name in self.monmap.ranks:
+                try:
+                    await self.monc.msgr.send_to(self.monmap.addrs[mon_name], beacon)
+                except ConnectionError:
+                    continue
+            try:
+                await self.monc.resubscribe()
+            except ConnectionError:
+                pass
+            await asyncio.sleep(self.beacon_interval)
+
+    def _on_osdmap(self, msg: MOSDMap) -> None:
+        self.osdmap = advance_map(self.osdmap, msg)
+
+    # -- dispatch --------------------------------------------------------------
+
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMgrMap):
+            if msg.epoch > self.mgrmap_epoch:
+                self.mgrmap_epoch = msg.epoch
+                was = self.active
+                self.active = msg.active_name == self.name
+                if self.active and not was:
+                    dout("mgr", 1, f"mgr.{self.name} is now active")
+            return True
+        if isinstance(msg, MMgrReport):
+            st = self.daemons.setdefault(msg.daemon, DaemonState())
+            try:
+                st.perf = json.loads(msg.perf.decode() or "{}")
+                st.status = json.loads(msg.status.decode() or "{}")
+            except json.JSONDecodeError:
+                return True
+            st.last_report = time.monotonic()
+            return True
+        return False
+
+    # -- module runtime --------------------------------------------------------
+
+    def register_module(self, module) -> None:
+        module.mgr = self
+        self.modules.append(module)
+
+    async def _module_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(1.0)
+            if not self.active:
+                continue
+            for module in self.modules:
+                try:
+                    result = module.tick()
+                    if asyncio.iscoroutine(result):
+                        await result
+                except Exception as e:  # a module must not kill the mgr
+                    dout("mgr", 0, f"module {module.NAME} raised {e!r}")
+
+    # -- module-facing surface (MgrModule API analog) --------------------------
+
+    def get_daemon_perf(self, daemon: str) -> dict:
+        st = self.daemons.get(daemon)
+        return st.perf if st else {}
+
+    def get_daemon_status(self, daemon: str) -> dict:
+        st = self.daemons.get(daemon)
+        return st.status if st else {}
+
+    def list_daemons(self) -> list[str]:
+        return sorted(self.daemons)
+
+    async def mon_command(self, cmd: dict, timeout: float = 5.0):
+        return await self.monc.command(cmd, timeout)

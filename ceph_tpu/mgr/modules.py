@@ -1,0 +1,27 @@
+"""Mgr module base — mirror of the MgrModule surface
+(src/pybind/mgr/mgr_module.py)."""
+
+from __future__ import annotations
+
+
+class MgrModule:
+    """Base class modules subclass (mgr_module.py MgrModule): `tick()`
+    is the `serve()` loop body, called on the ACTIVE mgr about once a
+    second; `self.mgr` is the daemon handle (maps, daemon state, mon
+    commands); health checks surface like the reference's
+    `set_health_checks`."""
+
+    NAME = "module"
+
+    def __init__(self) -> None:
+        self.mgr = None  # set by Mgr.register_module
+        self.health_checks: dict[str, dict] = {}
+
+    def tick(self) -> None:  # may be async
+        pass
+
+    def set_health_check(self, code: str, severity: str, summary: str) -> None:
+        self.health_checks[code] = {"severity": severity, "summary": summary}
+
+    def clear_health_check(self, code: str) -> None:
+        self.health_checks.pop(code, None)

@@ -1,0 +1,96 @@
+"""pg_autoscaler mgr module — mirror of src/pybind/mgr/pg_autoscaler.
+
+The reference recommends (and in `on` mode applies) per-pool pg_num so
+each OSD carries about `mon_target_pg_per_osd` PGs, rounding to powers
+of two and only acting when the ideal differs from the actual by >3x
+(module.py _get_pool_pg_targets).  This module reproduces that math.
+
+Mode semantics: the default is **warn** (recommendations surface as a
+health check); `on` applies `osd pool set pg_num` — which this
+framework restricts to empty pools, since PG splitting (the reference's
+data-migration machinery behind pg_num changes) is not implemented.
+"""
+
+from __future__ import annotations
+
+from ..common.log import dout
+from .modules import MgrModule
+
+TARGET_PG_PER_OSD = 100  # mon_target_pg_per_osd
+
+
+def _nearest_power_of_two(n: float) -> int:
+    if n <= 1:
+        return 1
+    lo = 1 << (int(n).bit_length() - 1)
+    hi = lo << 1
+    return hi if n - lo > hi - n else lo
+
+
+class PgAutoscalerModule(MgrModule):
+    NAME = "pg_autoscaler"
+
+    def __init__(self, mode: str = "warn"):
+        super().__init__()
+        self.mode = mode  # "warn" | "on" | "off"
+        self.last_recommendations: dict[str, dict] = {}
+
+    def recommend(self) -> dict[str, dict]:
+        """pool -> {current, ideal, should_adjust}
+        (pg_autoscaler _get_pool_pg_targets)."""
+        osdmap = self.mgr.osdmap
+        n_osds = max(
+            1, sum(1 for i in osdmap.osds.values() if i.up and i.in_)
+        )
+        pools = list(osdmap.pools.values())
+        out: dict[str, dict] = {}
+        if not pools:
+            return out
+        # Without utilization stats, pools split the PG budget evenly
+        # (the reference biases by stored bytes; equal-share is the
+        # zero-data prior it also starts from).
+        budget = n_osds * TARGET_PG_PER_OSD
+        for pool in pools:
+            replication = pool.size
+            ideal_raw = budget / max(1, replication) / len(pools)
+            ideal = max(1, _nearest_power_of_two(ideal_raw))
+            current = pool.pg_num
+            # only flag >3x divergence (the reference's threshold)
+            should = ideal > current * 3 or current > ideal * 3
+            out[pool.name] = {
+                "current": current,
+                "ideal": ideal,
+                "should_adjust": should,
+            }
+        return out
+
+    async def tick(self) -> None:
+        recs = self.recommend()
+        self.last_recommendations = recs
+        flagged = {
+            name: r for name, r in recs.items() if r["should_adjust"]
+        }
+        if not flagged:
+            self.clear_health_check("POOL_PG_NUM")
+            return
+        summary = ", ".join(
+            f"{name}: {r['current']} -> {r['ideal']}" for name, r in flagged.items()
+        )
+        if self.mode != "on":
+            self.set_health_check(
+                "POOL_PG_NUM", "warning", f"pg_num suboptimal ({summary})"
+            )
+            return
+        for name, r in flagged.items():
+            rv, rs, _ = await self.mgr.mon_command(
+                {
+                    "prefix": "osd pool set",
+                    "pool": name,
+                    "var": "pg_num",
+                    "val": str(r["ideal"]),
+                    # `on` mode is documented as empty-pools-only: assert it
+                    "yes_i_really_mean_it": True,
+                }
+            )
+            if rv != 0:
+                dout("mgr", 1, f"pg_autoscaler: {name} pg_num set failed: {rs}")

@@ -1,0 +1,90 @@
+"""prometheus mgr module — mirror of src/pybind/mgr/prometheus.
+
+The reference's module exports every daemon's perf counters plus cluster
+state in Prometheus text exposition format over HTTP.  Same here: the
+module renders `scrape()` from DaemonServer state and (optionally)
+serves it on a TCP port via a minimal HTTP/1.0 responder, the analog of
+the reference's cherrypy server (module.py StandbyModule/Module).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .modules import MgrModule
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+class PrometheusModule(MgrModule):
+    NAME = "prometheus"
+
+    def __init__(self, port: int = 0):
+        super().__init__()
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self.addr = ""
+
+    # -- exposition ------------------------------------------------------------
+
+    def scrape(self) -> str:
+        """The /metrics payload (module.py collect)."""
+        out: list[str] = []
+        mgr = self.mgr
+        # cluster-level gauges (ceph_osd_up/ceph_osd_in analogs)
+        osdmap = mgr.osdmap
+        out.append("# HELP ceph_tpu_osd_up OSD up state")
+        out.append("# TYPE ceph_tpu_osd_up gauge")
+        for osd, info in sorted(osdmap.osds.items()):
+            out.append(f'ceph_tpu_osd_up{{osd="{osd}"}} {int(info.up)}')
+        out.append("# HELP ceph_tpu_osd_in OSD in state")
+        out.append("# TYPE ceph_tpu_osd_in gauge")
+        for osd, info in sorted(osdmap.osds.items()):
+            out.append(f'ceph_tpu_osd_in{{osd="{osd}"}} {int(info.in_)}')
+        out.append("# HELP ceph_tpu_osdmap_epoch current osdmap epoch")
+        out.append("# TYPE ceph_tpu_osdmap_epoch counter")
+        out.append(f"ceph_tpu_osdmap_epoch {osdmap.epoch}")
+        # per-daemon perf counters
+        seen_types: set[str] = set()
+        for daemon in mgr.list_daemons():
+            perf = mgr.get_daemon_perf(daemon)
+            for counter, value in sorted(perf.items()):
+                metric = f"ceph_tpu_{_sanitize(counter)}"
+                if isinstance(value, dict):  # long-run avg {avgcount, sum}
+                    value = value.get("sum", 0)
+                if metric not in seen_types:
+                    seen_types.add(metric)
+                    out.append(f"# TYPE {metric} counter")
+                out.append(f'{metric}{{daemon="{daemon}"}} {value}')
+        return "\n".join(out) + "\n"
+
+    # -- HTTP endpoint ---------------------------------------------------------
+
+    async def serve(self, host: str = "127.0.0.1") -> str:
+        """Start the /metrics HTTP listener; returns host:port."""
+
+        async def handle(reader, writer):
+            try:
+                await reader.readline()  # request line; rest ignored
+                body = self.scrape().encode()
+                writer.write(
+                    b"HTTP/1.0 200 OK\r\n"
+                    b"Content-Type: text/plain; version=0.0.4\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+                )
+                await writer.drain()
+            finally:
+                writer.close()
+
+        self._server = await asyncio.start_server(handle, host, self.port)
+        sock = self._server.sockets[0].getsockname()
+        self.addr = f"{sock[0]}:{sock[1]}"
+        return self.addr
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

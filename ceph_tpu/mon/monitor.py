@@ -26,6 +26,7 @@ import json
 
 from ..common.log import dout
 from ..msg.messages import (
+    MMgrBeacon,
     MMonCommand,
     MMonCommandAck,
     MMonElection,
@@ -38,6 +39,7 @@ from ..msg.messages import (
 from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
 from .elector import Elector
 from .monmap import MonMap
+from .mgr_monitor import MgrMonitor
 from .osd_monitor import OSDMonitor
 from .paxos import Paxos
 from ..common.errs import EAGAIN, EINVAL
@@ -62,9 +64,11 @@ class Monitor(Dispatcher):
         self.quorum: list[int] = []
         self.leader_rank: int | None = None
         self.osdmon = OSDMonitor(self)
+        self.mgrmon = MgrMonitor(self)
         # conn -> {what -> next epoch}
         self.subs: dict[Connection, dict[str, int]] = {}
         self._started = asyncio.Event()
+        self._tick_task: asyncio.Task | None = None
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -72,11 +76,23 @@ class Monitor(Dispatcher):
         await self.msgr.bind(self.monmap.addrs[self.name])
         self.msgr.add_dispatcher_head(self)
         self.elector.start()
+        self._tick_task = asyncio.create_task(self._tick_loop())
         self._started.set()
 
     async def stop(self) -> None:
         self.elector.cancel()
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            self._tick_task = None
         await self.msgr.shutdown()
+
+    async def _tick_loop(self) -> None:
+        """Monitor::tick: periodic service timers (mgr beacon grace,
+        future health checks) on the leader."""
+        while True:
+            await asyncio.sleep(1.0)
+            if self.is_leader():
+                self.mgrmon.tick()
 
     async def wait_for_quorum(self, timeout: float = 5.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -116,12 +132,14 @@ class Monitor(Dispatcher):
         self.leader_rank = self.rank
         self.paxos.leader_init(quorum)
         self.osdmon.on_active()
+        self.mgrmon.on_election_changed()
 
     def _lose_election(self, epoch: int, leader: int) -> None:
         self.quorum = []
         self.leader_rank = leader
         self.paxos.peon_init(leader)
         self.osdmon.on_election_lost()
+        self.mgrmon.on_election_changed()
 
     # -- commit application ----------------------------------------------------
 
@@ -131,6 +149,8 @@ class Monitor(Dispatcher):
         service, _, blob = value.partition(b"\x00")
         if service == b"osd":
             self.osdmon.apply_commit(blob)
+        elif service == b"mgr":
+            self.mgrmon.apply_commit(blob)
 
     def propose(self, service: str, blob: bytes, on_done=None) -> None:
         self.paxos.propose(service.encode() + b"\x00" + blob, on_done)
@@ -152,6 +172,9 @@ class Monitor(Dispatcher):
         elif isinstance(msg, MOSDFailure):
             if self.is_leader():
                 self.osdmon.prepare_failure(msg, reporter=msg.src)
+        elif isinstance(msg, MMgrBeacon):
+            if self.is_leader():
+                self.mgrmon.prepare_beacon(msg)
         else:
             return False
         return True
@@ -171,12 +194,19 @@ class Monitor(Dispatcher):
             subs[what] = start
             if what == "osdmap":
                 self.osdmon.check_sub(conn, subs)
+            elif what == "mgrmap":
+                self.mgrmon.check_sub(conn, subs)
 
     def publish_osdmap(self) -> None:
         """Push new epochs to every osdmap subscriber (on commit)."""
         for conn, subs in list(self.subs.items()):
             if "osdmap" in subs:
                 self.osdmon.check_sub(conn, subs)
+
+    def publish_mgrmap(self) -> None:
+        for conn, subs in list(self.subs.items()):
+            if "mgrmap" in subs:
+                self.mgrmon.check_sub(conn, subs)
 
     def send_to_conn(self, conn: Connection, msg) -> None:
         async def _send():

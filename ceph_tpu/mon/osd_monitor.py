@@ -212,6 +212,8 @@ class OSDMonitor:
             "osd dump": (self._cmd_dump, False),
             "osd out": (self._cmd_out, True),
             "osd in": (self._cmd_in, True),
+            "osd reweight": (self._cmd_reweight, True),
+            "osd pool set": (self._cmd_pool_set, True),
         }
         entry = handlers.get(prefix)
         if entry is None:
@@ -405,5 +407,62 @@ class OSDMonitor:
 
             m.set_osd_weight(osd, WEIGHT_ONE)
             return f"osd.{osd} in"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_reweight(self, cmd, reply) -> None:
+        """`osd reweight <id> <weight>` — the balancer's knob
+        (OSDMonitor reweight; weight in [0,1] scales CRUSH acceptance)."""
+        osd = int(cmd["id"])
+        weight = float(cmd["weight"])
+        if not 0.0 <= weight <= 1.0:
+            reply(-EINVAL, f"weight {weight} not in [0, 1]")
+            return
+
+        def mutate(m: OSDMap) -> str:
+            from ..crush.crush import WEIGHT_ONE
+
+            if osd not in m.osds:
+                raise KeyError(f"osd.{osd} does not exist")
+            m.set_osd_weight(osd, int(weight * WEIGHT_ONE))
+            return f"osd.{osd} reweighted to {weight}"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_pool_set(self, cmd, reply) -> None:
+        """`osd pool set <pool> <var> <val>` (OSDMonitor prepare_command
+        pool set).  pg_num changes remap existing objects and this
+        framework has no PG-splitting data migration, so they require the
+        caller to assert the pool is empty via `yes_i_really_mean_it`
+        (the reference's own force-flag convention for dangerous pool
+        mutations); the autoscaler defaults to warn-only mode for the
+        same reason."""
+        name = cmd["pool"]
+        var = cmd["var"]
+        val = cmd["val"]
+        if var == "pg_num" and not cmd.get("yes_i_really_mean_it"):
+            reply(
+                -EINVAL,
+                "pg_num changes move every object's placement and existing "
+                "data is NOT migrated (no PG splitting); pass "
+                "yes_i_really_mean_it for an empty pool",
+            )
+            return
+
+        def mutate(m: OSDMap) -> str:
+            pool = m.get_pool(name)
+            if pool is None:
+                raise KeyError(f"pool {name!r} does not exist")
+            if var == "pg_num":
+                pool.pg_num = int(val)
+            elif var == "size":
+                pool.size = int(val)
+            elif var == "min_size":
+                pool.min_size = int(val)
+            elif var == "fast_read":
+                pool.fast_read = str(val).lower() in ("1", "true", "yes")
+            else:
+                raise ValueError(f"unknown pool variable {var!r}")
+            return f"set pool {name} {var} to {val}"
 
         self._queue(mutate, lambda rv, rs: reply(rv, rs))

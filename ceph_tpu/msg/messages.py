@@ -441,3 +441,35 @@ class MOSDRepScrubMap(Message):
         ("scrub_tid", "u64"),
         ("scrub_map", "bytes"),
     ]
+
+
+# --- mgr ---------------------------------------------------------------------
+
+
+@message_type(29)
+class MMgrBeacon(Message):
+    """Mgr -> mon availability beacon (src/messages/MMgrBeacon.h);
+    drives MgrMonitor's active/standby election."""
+
+    FIELDS = [("name", "str"), ("addr", "str")]
+
+
+@message_type(30)
+class MMgrMap(Message):
+    """Mon -> subscribers: who the active mgr is
+    (src/messages/MMgrMap.h / MgrMap)."""
+
+    FIELDS = [
+        ("epoch", "u32"),
+        ("active_name", "str"),
+        ("active_addr", "str"),
+        ("standbys", ("list", "str")),
+    ]
+
+
+@message_type(31)
+class MMgrReport(Message):
+    """Daemon -> mgr perf/status report (src/messages/MMgrReport.h;
+    consumed by DaemonServer).  perf/status are JSON blobs."""
+
+    FIELDS = [("daemon", "str"), ("perf", "bytes"), ("status", "bytes")]

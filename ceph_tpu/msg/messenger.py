@@ -320,10 +320,15 @@ class Messenger:
             await writer.drain()
             if self.auth is not None:
                 try:
-                    conn.auth_entity = await self.auth.server_auth(
-                        *_frame_io(reader, writer, self.crc_data)
+                    # Bounded like the client side: a stalled peer must not
+                    # pin this accept task (and its socket) forever.
+                    conn.auth_entity = await asyncio.wait_for(
+                        self.auth.server_auth(
+                            *_frame_io(reader, writer, self.crc_data)
+                        ),
+                        timeout=5.0,
                     )
-                except Exception:  # AuthError and protocol noise alike
+                except Exception:  # AuthError, timeout, protocol noise
                     writer.close()
                     return
             await conn._attach(reader, writer)

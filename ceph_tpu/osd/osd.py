@@ -55,6 +55,8 @@ from ..msg.messages import (
     MOSDRepOpReply,
     MOSDRepScrub,
     MOSDRepScrubMap,
+    MMgrMap,
+    MMgrReport,
 )
 from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ..os.memstore import MemStore
@@ -119,6 +121,8 @@ class OSD(Dispatcher):
         self._tasks: list[asyncio.Task] = []
         self._running = False
         self.up = False
+        self.mgr_addr = ""  # active mgr (from the mgrmap subscription)
+        self._mgrmap_epoch = 0
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -129,7 +133,9 @@ class OSD(Dispatcher):
         self.msgr.add_dispatcher_head(self)
         self.monc.on_osdmap = self._on_osdmap_msg
         self._running = True
+        self.monc.msgr.add_dispatcher_tail(self)  # mgrmap rides the mon conn
         await self.monc.subscribe("osdmap")
+        await self.monc.subscribe("mgrmap")
         await self._send_boot()
         self._tasks.append(asyncio.create_task(self._op_worker()))
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
@@ -217,6 +223,32 @@ class OSD(Dispatcher):
         )
         pg.on_new_interval(self.osdmap.epoch, acting)
         return pg
+
+    # -- mgr reporting ---------------------------------------------------------
+
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MMgrMap):
+            if msg.epoch > self._mgrmap_epoch:
+                self._mgrmap_epoch = msg.epoch
+                self.mgr_addr = msg.active_addr
+            return True
+        return False
+
+    def _send_mgr_report(self) -> None:
+        """Periodic perf/status report to the active mgr
+        (MgrClient::send_report)."""
+        import json
+
+        if not self.mgr_addr:
+            return
+        self._send_addr(
+            self.mgr_addr,
+            MMgrReport(
+                daemon=f"osd.{self.whoami}",
+                perf=json.dumps(self.perf.dump()).encode(),
+                status=json.dumps(_osd_status(self)).encode(),
+            ),
+        )
 
     # -- dispatch --------------------------------------------------------------
 
@@ -362,6 +394,7 @@ class OSD(Dispatcher):
                 continue
             for pg in list(self.pgs.values()):
                 pg.tick()
+            self._send_mgr_report()
             if self.conf.get("heartbeat_inject_failure") > 0:
                 continue  # pretend our pings are lost (global.yaml.in:865)
             now = time.monotonic()
@@ -439,3 +472,13 @@ class OSD(Dispatcher):
 
     def all_clean(self) -> bool:
         return all(pg.is_clean for pg in self.pgs.values() if pg.peering.is_primary())
+
+
+def _osd_status(osd: "OSD") -> dict:
+    """The status blob the mgr aggregates (DaemonServer daemon status)."""
+    return {
+        "num_pgs": len(osd.pgs),
+        "up": osd.up,
+        "osdmap_epoch": osd.osdmap.epoch,
+        "clog_errors": len(osd.clog),
+    }

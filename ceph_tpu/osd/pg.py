@@ -21,6 +21,7 @@ client ops and drives recovery:
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import TYPE_CHECKING, Callable
 
@@ -470,14 +471,17 @@ class PG(PGListener):
         return True
 
     def send_scrub(self, osd: int, msg) -> None:
+        # Loopback via the event loop, not direct call: a synchronous
+        # self-delivery chain would recurse one stack frame per chunk
+        # (chunk -> map -> compare -> next chunk) and overflow on big PGs.
         if osd == self.osd.whoami:
-            self.scrubber.handle_rep_scrub(msg)
+            asyncio.get_event_loop().call_soon(self.scrubber.handle_rep_scrub, msg)
         else:
             self.osd.send_cluster(osd, msg)
 
     def send_scrub_reply(self, osd: int, msg) -> None:
         if osd == self.osd.whoami:
-            self.scrubber.handle_scrub_map(msg)
+            asyncio.get_event_loop().call_soon(self.scrubber.handle_scrub_map, msg)
         else:
             self.osd.send_cluster(osd, msg)
 

@@ -99,9 +99,10 @@ class PgScrubber:
             on_done(res)
 
     def tick(self, now: float) -> None:
-        """Abort a gather whose shard never answered (a crashed replica
-        must not wedge scrubbing forever)."""
-        if self.active and self._pending and now - self._chunk_started > self.gather_timeout:
+        """Abort a scrub that stopped making progress — a shard that never
+        answered, or an in-flight chunk wedged by an error (a crashed
+        replica or a raised compare must not disable scrubbing forever)."""
+        if self.active and now - self._chunk_started > self.gather_timeout:
             dout(
                 "osd", 1,
                 f"pg {self.pg.pgid} scrub: no map from {sorted(self._pending)} "
@@ -268,12 +269,15 @@ class PgScrubber:
                     + ", ".join(f"osd.{o} ({why})" for o, why in bad.items())
                 )
         start, end = self._chunk_range
-        self._flush_waiting_writes()  # chunk done; blocked writes proceed
+        # Advance (or finish) BEFORE releasing blocked writes: a write
+        # flushed while the old chunk range is still current would re-block
+        # against it and strand forever on the final chunk.
         if end:
             self._cursor = end
             self._next_chunk()
-            return
-        self._finish()
+        else:
+            self._finish()
+        self._flush_waiting_writes()
 
     def _compare_ec_object(self, oid: str, acting: list[int]) -> dict[int, str]:
         """EC comparison: every acting shard must hold the object, sized

@@ -1,0 +1,208 @@
+"""Mgr tests: beacon/active election, failover, DaemonServer
+aggregation, prometheus exposition, balancer planning, pg_autoscaler
+recommendations (src/mgr + src/pybind/mgr mirrors)."""
+
+import asyncio
+import json
+import urllib.request
+
+from ceph_tpu.client import Rados
+from ceph_tpu.mgr import Mgr
+from ceph_tpu.mgr.balancer import BalancerModule
+from ceph_tpu.mgr.pg_autoscaler import PgAutoscalerModule, TARGET_PG_PER_OSD
+from ceph_tpu.mgr.prometheus import PrometheusModule
+
+from test_cluster import start_cluster, stop_cluster, wait_until
+
+
+async def start_mgr(monmap, name="x"):
+    mgr = Mgr(name, monmap)
+    mgr.beacon_interval = 0.1
+    await mgr.start()
+    return mgr
+
+
+class TestMgrDaemon:
+    def test_active_election_and_reports(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            assert mons[0].mgrmon.map.active_name == "x"
+
+            # OSDs learn the mgr address and report perf counters
+            await wait_until(
+                lambda: len(mgr.daemons) == 3, 5.0, "3 daemon reports"
+            )
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("mp", "replicated", size=3, pg_num=4)
+            ioctx = await client.open_ioctx("mp")
+            await ioctx.write_full("o", b"x" * 4096)
+            await wait_until(
+                lambda: any(
+                    mgr.get_daemon_perf(d).get("op", 0) > 0
+                    for d in mgr.list_daemons()
+                ),
+                5.0,
+                "op counters reaching mgr",
+            )
+            status = mgr.get_daemon_status(mgr.list_daemons()[0])
+            assert status.get("up") is True
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_standby_failover(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 1)
+            mgr_a = await start_mgr(monmap, "a")
+            await mgr_a.wait_for_active()
+            mgr_b = await start_mgr(monmap, "b")
+            await asyncio.sleep(0.3)
+            assert not mgr_b.active
+            assert mons[0].mgrmon.map.standbys == {"b": mgr_b.msgr.addr}
+
+            # active dies; standby's beacons trigger the grace failover
+            import ceph_tpu.mon.mgr_monitor as mm
+
+            mons[0].mgrmon._last_beacon["a"] = -1000.0  # expire instantly
+            await mgr_a.stop()
+            await mgr_b.wait_for_active(timeout=10.0)
+            assert mons[0].mgrmon.map.active_name == "b"
+            await mgr_b.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestPrometheus:
+    def test_scrape_over_http(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 2)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            prom = PrometheusModule()
+            mgr.register_module(prom)
+            addr = await prom.serve()
+            await wait_until(lambda: len(mgr.daemons) == 2, 5.0, "reports")
+
+            text = await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda: urllib.request.urlopen(
+                    f"http://{addr}/metrics", timeout=5
+                ).read().decode(),
+            )
+            assert 'ceph_tpu_osd_up{osd="0"} 1' in text
+            assert 'ceph_tpu_osd_up{osd="1"} 1' in text
+            assert "ceph_tpu_osdmap_epoch" in text
+            assert 'ceph_tpu_op{daemon="osd.0"}' in text
+            await prom.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestBalancer:
+    def test_even_cluster_has_no_plan(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("bp", "replicated", size=3, pg_num=8)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            bal = BalancerModule()
+            mgr.register_module(bal)
+            # size==n_osds: every OSD holds every PG; perfectly even
+            assert abs(bal.score() - 1.0) < 1e-9
+            assert bal.optimize() == []
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_uneven_cluster_plans_reweight(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 4)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("bp", "replicated", size=2, pg_num=16)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            bal = BalancerModule(threshold=1.01, max_adjustments=1)
+            mgr.register_module(bal)
+            counts = bal.pg_counts()
+            assert sum(counts.values()) == 32  # 16 pgs x size 2
+            plan = bal.optimize()
+            if max(counts.values()) / (sum(counts.values()) / len(counts)) > 1.01:
+                assert plan, counts
+                assert plan[0]["to"] < plan[0]["from"]
+                # applying the plan through the mon moves the map
+                bal.active_mode = True
+                await bal.tick()
+                await wait_until(
+                    lambda: any(
+                        i.weight < 0x10000
+                        for i in mons[0].osdmon.osdmap.osds.values()
+                    ),
+                    5.0,
+                    "reweight commit",
+                )
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestPgAutoscaler:
+    def test_recommends_power_of_two_target(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("tiny", "replicated", size=3, pg_num=2)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            auto = PgAutoscalerModule(mode="warn")
+            mgr.register_module(auto)
+            recs = auto.recommend()
+            assert "tiny" in recs
+            r = recs["tiny"]
+            # 3 osds * 100 target / 3 replicas / 1 pool = 100 -> 128
+            assert r["ideal"] == 128
+            assert r["should_adjust"]  # 2 -> 128 is >3x off
+            await auto.tick()
+            assert "POOL_PG_NUM" in auto.health_checks
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_on_mode_applies_to_empty_pool(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("grow", "replicated", size=3, pg_num=2)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            auto = PgAutoscalerModule(mode="on")
+            mgr.register_module(auto)
+            await auto.tick()
+            await wait_until(
+                lambda: mons[0].osdmon.osdmap.get_pool("grow").pg_num == 128,
+                5.0,
+                "pg_num applied",
+            )
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
