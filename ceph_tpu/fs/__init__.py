@@ -1,0 +1,5 @@
+"""POSIX-style filesystem over RADOS (src/mds + src/client)."""
+
+from .fs import FileSystem, FsError
+
+__all__ = ["FileSystem", "FsError"]

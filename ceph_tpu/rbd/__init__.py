@@ -1,0 +1,5 @@
+"""RBD block layer (src/librbd)."""
+
+from .rbd import RBD, Image, RbdError
+
+__all__ = ["RBD", "Image", "RbdError"]

@@ -1,0 +1,321 @@
+"""RBD — block images over RADOS, mirror of src/librbd.
+
+Reference structure mirrored (librbd is 110k LoC; this is the core
+data-path slice — SURVEY.md §2.7 "Access layers"):
+
+- An image is a **header object** `rbd_header.<id>` holding size/order/
+  snapshot metadata (librbd's ImageCtx reads the same from its header),
+  plus data objects `rbd_data.<id>.<objno>` each covering `2^order`
+  bytes (librbd/io/ObjectRequest.cc object mapping; order default 22 =
+  4 MiB).
+- I/O maps logical extents onto data objects (io/ImageRequest.cc →
+  Striper math with stripe_count=1, the rbd default layout).
+- **Snapshots** are copy-on-write: the first write to an object after a
+  snapshot preserves the pre-write content under
+  `rbd_data.<id>.<objno>@<snap_id>` before the head is modified —
+  client-driven COW standing in for the reference's OSD-side SnapSet
+  clones (PrimaryLogPG make_writeable); reads from a snapshot pick the
+  oldest preserved copy at-or-after it, falling back to head.
+- The image directory object `rbd_directory` maps names → ids
+  (librbd's rbd_directory omap).
+
+Single-writer images (the reference guards multi-client access with its
+exclusive-lock feature; that is the assumed mode here).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+
+from ..common.errs import EEXIST, EINVAL, ENOENT
+
+DIRECTORY_OID = "rbd_directory"
+DEFAULT_ORDER = 22  # 4 MiB objects
+
+
+class RbdError(Exception):
+    def __init__(self, err: int, msg: str = ""):
+        self.errno = -abs(err)
+        super().__init__(f"{msg} (errno {self.errno})")
+
+
+class RBD:
+    """Pool-level image operations (librbd::RBD)."""
+
+    def __init__(self, ioctx):
+        self.ioctx = ioctx
+
+    async def _read_directory(self) -> dict[str, str]:
+        try:
+            raw = await self.ioctx.read(DIRECTORY_OID)
+            return json.loads(raw.decode() or "{}")
+        except Exception:
+            return {}
+
+    async def _write_directory(self, d: dict[str, str]) -> None:
+        await self.ioctx.write_full(DIRECTORY_OID, json.dumps(d).encode())
+
+    async def create(self, name: str, size: int, order: int = DEFAULT_ORDER) -> None:
+        """rbd create (librbd::create)."""
+        if not 12 <= order <= 26:
+            raise RbdError(EINVAL, f"order {order} out of range")
+        directory = await self._read_directory()
+        if name in directory:
+            raise RbdError(EEXIST, f"image {name!r} exists")
+        image_id = secrets.token_hex(8)
+        header = {
+            "id": image_id,
+            "size": size,
+            "max_size": size,  # high-water mark for cleanup after shrinks
+            "order": order,
+            "snaps": [],  # [{"id": int, "name": str}]
+            "snap_seq": 0,
+        }
+        await self.ioctx.write_full(
+            f"rbd_header.{image_id}", json.dumps(header).encode()
+        )
+        directory[name] = image_id
+        await self._write_directory(directory)
+
+    async def list(self) -> list[str]:
+        return sorted(await self._read_directory())
+
+    async def remove(self, name: str) -> None:
+        directory = await self._read_directory()
+        image_id = directory.get(name)
+        if image_id is None:
+            raise RbdError(ENOENT, f"image {name!r} not found")
+        img = await self.open(name)
+        # iterate the LARGEST size the image ever had: a shrunk image's
+        # snap objects live past the current end
+        span = max(img.size, img.header.get("max_size", img.size))
+        objects = (span + img.object_bytes - 1) // img.object_bytes
+        for objno in range(objects):
+            for oid in [img._data_oid(objno)] + [
+                img._snap_oid(objno, s["id"]) for s in img.header["snaps"]
+            ]:
+                try:
+                    await self.ioctx.remove(oid)
+                except Exception:
+                    pass
+        await self.ioctx.remove(f"rbd_header.{image_id}")
+        del directory[name]
+        await self._write_directory(directory)
+
+    async def open(self, name: str) -> "Image":
+        directory = await self._read_directory()
+        image_id = directory.get(name)
+        if image_id is None:
+            raise RbdError(ENOENT, f"image {name!r} not found")
+        img = Image(self.ioctx, name, image_id)
+        await img._load_header()
+        return img
+
+
+class Image:
+    """One open image (librbd::Image / ImageCtx)."""
+
+    def __init__(self, ioctx, name: str, image_id: str):
+        self.ioctx = ioctx
+        self.name = name
+        self.id = image_id
+        self.header: dict = {}
+
+    # -- header ----------------------------------------------------------------
+
+    @property
+    def _header_oid(self) -> str:
+        return f"rbd_header.{self.id}"
+
+    async def _load_header(self) -> None:
+        raw = await self.ioctx.read(self._header_oid)
+        self.header = json.loads(raw.decode())
+
+    async def _save_header(self) -> None:
+        await self.ioctx.write_full(self._header_oid, json.dumps(self.header).encode())
+
+    @property
+    def size(self) -> int:
+        return self.header["size"]
+
+    @property
+    def order(self) -> int:
+        return self.header["order"]
+
+    @property
+    def object_bytes(self) -> int:
+        return 1 << self.order
+
+    def _data_oid(self, objno: int) -> str:
+        return f"rbd_data.{self.id}.{objno:016x}"
+
+    def _snap_oid(self, objno: int, snap_id: int) -> str:
+        return f"rbd_data.{self.id}.{objno:016x}@{snap_id}"
+
+    def _extents(self, off: int, length: int):
+        """Logical range -> [(objno, obj_off, len)] (stripe_count=1)."""
+        out = []
+        ob = self.object_bytes
+        while length > 0:
+            objno = off // ob
+            obj_off = off % ob
+            take = min(ob - obj_off, length)
+            out.append((objno, obj_off, take))
+            off += take
+            length -= take
+        return out
+
+    # -- I/O -------------------------------------------------------------------
+
+    async def write(self, off: int, data: bytes) -> None:
+        if off + len(data) > self.size:
+            raise RbdError(EINVAL, "write past end of image")
+        cursor = 0
+        for objno, obj_off, ln in self._extents(off, len(data)):
+            await self._cow_preserve(objno)
+            await self.ioctx.write(
+                self._data_oid(objno), data[cursor : cursor + ln], obj_off
+            )
+            cursor += ln
+
+    async def _cow_preserve(self, objno: int) -> None:
+        """Before the first write to an object after the latest snapshot,
+        copy its current content to the snap object (the client-side
+        stand-in for PrimaryLogPG::make_writeable's clone)."""
+        snaps = self.header["snaps"]
+        if not snaps:
+            return
+        latest = snaps[-1]["id"]
+        snap_oid = self._snap_oid(objno, latest)
+        try:
+            await self.ioctx.stat(snap_oid)
+            return  # already preserved for this snap
+        except Exception:
+            pass
+        try:
+            current = await self.ioctx.read(self._data_oid(objno))
+        except Exception:
+            current = b""
+        # A never-written object preserves as one zero byte: block reads
+        # zero-fill past object ends, so it reads identically, and the
+        # copy reliably exists for the preserved-check above.
+        await self.ioctx.write_full(snap_oid, current or b"\x00")
+
+    async def read(self, off: int, length: int, snap_name: str | None = None) -> bytes:
+        if off >= self.size:
+            return b""
+        length = min(length, self.size - off)
+        snap_id = None
+        if snap_name is not None:
+            snap_id = self._snap_by_name(snap_name)["id"]
+        parts = []
+        for objno, obj_off, ln in self._extents(off, length):
+            data = await self._read_object(objno, snap_id)
+            parts.append(data[obj_off : obj_off + ln].ljust(ln, b"\x00"))
+        return b"".join(parts)
+
+    async def _read_object(self, objno: int, snap_id: int | None) -> bytes:
+        """Snapshot read resolution: the oldest preserved copy with
+        snap >= snap_id wins, else the head (librbd's snap read maps to
+        the SnapSet clone covering the snap)."""
+        if snap_id is not None:
+            for snap in self.header["snaps"]:
+                if snap["id"] >= snap_id:
+                    try:
+                        return await self.ioctx.read(self._snap_oid(objno, snap["id"]))
+                    except Exception:
+                        continue  # not preserved under this snap; try newer
+        try:
+            return await self.ioctx.read(self._data_oid(objno))
+        except Exception:
+            return b""
+
+    async def resize(self, new_size: int) -> None:
+        """librbd::resize; shrinking drops whole objects past the end —
+        after COW-preserving them, so existing snapshots survive the
+        shrink (librbd keeps clones across resize)."""
+        old = self.size
+        if new_size < old:
+            ob = self.object_bytes
+            first_dead = (new_size + ob - 1) // ob
+            last = (old - 1) // ob if old else 0
+            for objno in range(first_dead, last + 1):
+                await self._cow_preserve(objno)
+                try:
+                    await self.ioctx.remove(self._data_oid(objno))
+                except Exception:
+                    pass
+            if new_size % ob:
+                boundary = new_size // ob
+                await self._cow_preserve(boundary)
+                try:
+                    await self.ioctx.truncate(self._data_oid(boundary), new_size % ob)
+                except Exception:
+                    pass
+        self.header["size"] = new_size
+        self.header["max_size"] = max(self.header.get("max_size", old), new_size)
+        await self._save_header()
+
+    # -- snapshots ---------------------------------------------------------------
+
+    def _snap_by_name(self, name: str) -> dict:
+        for snap in self.header["snaps"]:
+            if snap["name"] == name:
+                return snap
+        raise RbdError(ENOENT, f"snapshot {name!r} not found")
+
+    async def snap_create(self, name: str) -> None:
+        """librbd snap_create: allocate a snap id; objects copy-on-write
+        lazily as the head is modified."""
+        if any(s["name"] == name for s in self.header["snaps"]):
+            raise RbdError(EEXIST, f"snapshot {name!r} exists")
+        self.header["snap_seq"] += 1
+        self.header["snaps"].append(
+            {"id": self.header["snap_seq"], "name": name, "size": self.size}
+        )
+        await self._save_header()
+
+    async def snap_list(self) -> list[str]:
+        return [s["name"] for s in self.header["snaps"]]
+
+    async def snap_rollback(self, name: str) -> None:
+        """librbd snap_rollback: head objects revert to the snapshot's
+        content.  Rollback writes are writes: they COW-preserve first, so
+        snapshots newer than the target keep their content."""
+        snap = self._snap_by_name(name)
+        objects = (self.size + self.object_bytes - 1) // self.object_bytes
+        for objno in range(objects):
+            data = await self._read_object(objno, snap["id"])
+            await self._cow_preserve(objno)
+            await self.ioctx.write_full(self._data_oid(objno), data or b"\x00")
+        self.header["size"] = snap.get("size", self.size)
+        await self._save_header()
+
+    async def snap_remove(self, name: str) -> None:
+        """librbd snap_remove.  A preserved copy at snap X covers every
+        snapshot back to the previous copy; removing X must hand the copy
+        down to the newest surviving snapshot in that range (the
+        reference's SnapSet clone-overlap merge on snap trim), else older
+        snapshots would silently read newer data."""
+        snap = self._snap_by_name(name)
+        remaining = [s for s in self.header["snaps"] if s["name"] != name]
+        older = [s for s in remaining if s["id"] < snap["id"]]
+        heir = older[-1] if older else None
+        objects = (self.size + self.object_bytes - 1) // self.object_bytes
+        for objno in range(objects):
+            src = self._snap_oid(objno, snap["id"])
+            try:
+                data = await self.ioctx.read(src)
+            except Exception:
+                continue  # never preserved under this snap
+            if heir is not None:
+                heir_oid = self._snap_oid(objno, heir["id"])
+                try:
+                    await self.ioctx.stat(heir_oid)
+                except Exception:
+                    # heir has no own copy: it was covered by X's
+                    await self.ioctx.write_full(heir_oid, data)
+            await self.ioctx.remove(src)
+        self.header["snaps"] = remaining
+        await self._save_header()

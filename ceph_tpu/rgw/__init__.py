@@ -1,0 +1,6 @@
+"""Object gateway — S3 semantics over RADOS (src/rgw)."""
+
+from .rgw import RgwError, ObjectGateway
+from .http import S3Server
+
+__all__ = ["ObjectGateway", "RgwError", "S3Server"]

@@ -1,0 +1,214 @@
+"""S3 REST front end — mirror of src/rgw's REST layer (rgw_rest_s3).
+
+A minimal HTTP/1.1 responder exposing the S3 surface the gateway core
+implements: bucket create/delete/list, object PUT/GET/HEAD/DELETE, and
+bucket listing with prefix/delimiter.  Requests authenticate with the
+AWS v2-style header `Authorization: AWS <access_key>:<signature>`, the
+signature being HMAC-SHA1 over the canonical string — the same scheme
+rgw_auth_s3.cc verifies (v4 is out of scope).
+
+Path-style addressing only: /<bucket>/<key>.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac
+from urllib.parse import parse_qs, unquote, urlparse
+from xml.sax.saxutils import escape as _x
+
+from .rgw import ObjectGateway, RgwError
+
+
+def sign_v2(secret_key: str, method: str, path: str, date: str) -> str:
+    """AWS signature v2 (rgw_auth_s3 string-to-sign, reduced to the
+    fields this server canonicalizes)."""
+    string_to_sign = f"{method}\n\n\n{date}\n{path}"
+    mac = hmac.new(secret_key.encode(), string_to_sign.encode(), hashlib.sha1)
+    return base64.b64encode(mac.digest()).decode()
+
+
+class S3Server:
+    def __init__(self, gateway: ObjectGateway, require_auth: bool = False):
+        self.gw = gateway
+        self.require_auth = require_auth
+        self._server: asyncio.AbstractServer | None = None
+        self.addr = ""
+
+    async def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        sock = self._server.sockets[0].getsockname()
+        self.addr = f"{sock[0]}:{sock[1]}"
+        return self.addr
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- request handling ------------------------------------------------------
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            request = await reader.readline()
+            if not request:
+                return
+            method, target, _version = request.decode().split(" ", 2)
+            headers: dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode().partition(":")
+                headers[name.strip().lower()] = value.strip()
+            body = b""
+            if "content-length" in headers:
+                body = await reader.readexactly(int(headers["content-length"]))
+            status, resp_headers, resp_body = await self._route(
+                method, target, headers, body
+            )
+            writer.write(f"HTTP/1.1 {status}\r\n".encode())
+            resp_headers.setdefault("Content-Length", str(len(resp_body)))
+            resp_headers.setdefault("Connection", "close")
+            for k, v in resp_headers.items():
+                writer.write(f"{k}: {v}\r\n".encode())
+            writer.write(b"\r\n")
+            writer.write(resp_body)
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, ValueError):
+            pass
+        finally:
+            writer.close()
+
+    async def _authenticate(self, method: str, path: str, headers: dict) -> bool:
+        if not self.require_auth:
+            return True
+        auth = headers.get("authorization", "")
+        if not auth.startswith("AWS "):
+            return False
+        try:
+            access_key, signature = auth[4:].split(":", 1)
+        except ValueError:
+            return False
+        user = await self.gw.user_by_access_key(access_key)
+        if user is None:
+            return False
+        expect = sign_v2(
+            user["secret_key"], method, path, headers.get("date", "")
+        )
+        return hmac.compare_digest(signature, expect)
+
+    async def _route(self, method: str, target: str, headers: dict, body: bytes):
+        url = urlparse(target)
+        path = unquote(url.path)
+        query = parse_qs(url.query, keep_blank_values=True)
+        if not await self._authenticate(method, path, headers):
+            return "403 Forbidden", {}, _error_xml("AccessDenied")
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        try:
+            if not bucket:  # service level: list buckets
+                if method == "GET":
+                    names = await self.gw.list_buckets()
+                    xml = "".join(f"<Bucket><Name>{_x(n)}</Name></Bucket>" for n in names)
+                    return (
+                        "200 OK",
+                        {"Content-Type": "application/xml"},
+                        f"<ListAllMyBucketsResult><Buckets>{xml}</Buckets>"
+                        f"</ListAllMyBucketsResult>".encode(),
+                    )
+                return "405 Method Not Allowed", {}, b""
+            if not key:
+                return await self._bucket_op(method, bucket, query)
+            return await self._object_op(method, bucket, key, body)
+        except RgwError as e:
+            status = {
+                "NoSuchBucket": "404 Not Found",
+                "NoSuchKey": "404 Not Found",
+                "NoSuchUpload": "404 Not Found",
+                "NoSuchUser": "404 Not Found",
+                "BucketAlreadyExists": "409 Conflict",
+                "BucketNotEmpty": "409 Conflict",
+                "UserAlreadyExists": "409 Conflict",
+            }.get(e.code, "400 Bad Request")
+            return status, {"Content-Type": "application/xml"}, _error_xml(e.code)
+
+    async def _bucket_op(self, method: str, bucket: str, query: dict):
+        if method == "PUT":
+            await self.gw.create_bucket(bucket)
+            return "200 OK", {}, b""
+        if method == "DELETE":
+            await self.gw.delete_bucket(bucket)
+            return "204 No Content", {}, b""
+        if method == "GET":
+            listing = await self.gw.list_objects(
+                bucket,
+                prefix=query.get("prefix", [""])[0],
+                delimiter=query.get("delimiter", [""])[0],
+                marker=query.get("marker", [""])[0],
+                max_keys=_int_arg(query.get("max-keys", ["1000"])[0]),
+            )
+            contents = "".join(
+                f"<Contents><Key>{_x(c['key'])}</Key><Size>{c['size']}</Size>"
+                f"<ETag>&quot;{c['etag']}&quot;</ETag></Contents>"
+                for c in listing["contents"]
+            )
+            prefixes = "".join(
+                f"<CommonPrefixes><Prefix>{_x(p)}</Prefix></CommonPrefixes>"
+                for p in listing["common_prefixes"]
+            )
+            trunc = "true" if listing["is_truncated"] else "false"
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<ListBucketResult><Name>{_x(bucket)}</Name>"
+                f"<IsTruncated>{trunc}</IsTruncated>"
+                f"{contents}{prefixes}</ListBucketResult>".encode(),
+            )
+        return "405 Method Not Allowed", {}, b""
+
+    async def _object_op(self, method: str, bucket: str, key: str, body: bytes):
+        if method == "PUT":
+            etag = await self.gw.put_object(bucket, key, body)
+            return "200 OK", {"ETag": f'"{etag}"'}, b""
+        if method == "GET":
+            data = await self.gw.get_object(bucket, key)
+            meta = await self.gw.head_object(bucket, key)
+            return (
+                "200 OK",
+                {
+                    "ETag": f'"{meta["etag"]}"',
+                    "Content-Type": "application/octet-stream",
+                },
+                data,
+            )
+        if method == "HEAD":
+            meta = await self.gw.head_object(bucket, key)
+            return (
+                "200 OK",
+                {"ETag": f'"{meta["etag"]}"', "Content-Length": str(meta["size"])},
+                b"",
+            )
+        if method == "DELETE":
+            await self.gw.delete_object(bucket, key)
+            return "204 No Content", {}, b""
+        return "405 Method Not Allowed", {}, b""
+
+
+def _error_xml(code: str) -> bytes:
+    return f"<Error><Code>{_x(code)}</Code></Error>".encode()
+
+
+def _int_arg(value: str) -> int:
+    """Query-string int with S3's InvalidArgument error (not a dropped
+    connection) on junk."""
+    try:
+        return int(value)
+    except ValueError:
+        from ..common.errs import EINVAL
+
+        raise RgwError(EINVAL, "InvalidArgument", f"bad integer {value!r}")

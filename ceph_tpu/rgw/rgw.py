@@ -1,0 +1,277 @@
+"""Object gateway core — mirror of src/rgw's storage layer (rgw_rados /
+the SAL RadosStore).
+
+The reference (236k LoC; SURVEY.md §2.7) layers S3/Swift semantics over
+RADOS: buckets with an index, objects whose head holds metadata and
+whose data stripes over tail objects, multipart uploads assembled from
+parts, users with access keys.  The same shapes here:
+
+- **Users** live in a registry object (`user.<id>` in the reference's
+  user pool; one JSON registry object here) carrying access/secret keys
+  (RGWUserInfo).
+- **Buckets**: a bucket record plus a **bucket index** object listing
+  keys → {size, etag, mtime} (the reference's bucket index omap,
+  cls_rgw); listing with prefix/marker/delimiter walks it exactly like
+  RGWRados::Bucket::List with CommonPrefixes.
+- **Objects**: data stripes over RADOS via the striper (the reference's
+  head+tail manifest, rgw_obj_manifest); etag = md5 of the body as S3
+  requires (RGWPutObj_ObjProcessor).
+- **Multipart**: parts upload as their own striped objects; complete
+  concatenates them into the final object and drops the parts
+  (RGWCompleteMultipart).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import secrets
+import time
+
+from ..common.errs import EEXIST, EINVAL, ENOENT
+from ..striper import StripedObject, StripePolicy
+
+USERS_OID = "rgw.users"
+BUCKETS_OID = "rgw.buckets"
+
+
+class RgwError(Exception):
+    def __init__(self, err: int, code: str, msg: str = ""):
+        self.errno = -abs(err)
+        self.code = code  # S3 error code (NoSuchBucket, ...)
+        super().__init__(f"{code}: {msg}")
+
+
+def _etag(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+class ObjectGateway:
+    """The gateway's storage operations (rgw::sal::RadosStore analog);
+    one instance per pool-backed zone."""
+
+    def __init__(self, ioctx, policy: StripePolicy | None = None):
+        self.ioctx = ioctx
+        self.policy = policy or StripePolicy(
+            stripe_unit=512 * 1024, stripe_count=1, object_size=4 * 1024 * 1024
+        )
+
+    # -- registries ------------------------------------------------------------
+
+    async def _load(self, oid: str) -> dict:
+        try:
+            raw = await self.ioctx.read(oid)
+            return json.loads(raw.decode() or "{}")
+        except Exception:
+            return {}
+
+    async def _store(self, oid: str, data: dict) -> None:
+        await self.ioctx.write_full(oid, json.dumps(data).encode())
+
+    # -- users (RGWUserInfo) ---------------------------------------------------
+
+    async def create_user(self, uid: str, display_name: str = "") -> dict:
+        users = await self._load(USERS_OID)
+        if uid in users:
+            raise RgwError(EEXIST, "UserAlreadyExists", uid)
+        user = {
+            "uid": uid,
+            "display_name": display_name or uid,
+            "access_key": secrets.token_hex(10).upper(),
+            "secret_key": secrets.token_hex(20),
+        }
+        users[uid] = user
+        await self._store(USERS_OID, users)
+        return user
+
+    async def get_user(self, uid: str) -> dict:
+        users = await self._load(USERS_OID)
+        if uid not in users:
+            raise RgwError(ENOENT, "NoSuchUser", uid)
+        return users[uid]
+
+    async def user_by_access_key(self, access_key: str) -> dict | None:
+        users = await self._load(USERS_OID)
+        for user in users.values():
+            if user["access_key"] == access_key:
+                return user
+        return None
+
+    # -- buckets ---------------------------------------------------------------
+
+    def _index_oid(self, bucket: str) -> str:
+        return f"rgw.bucket.index.{bucket}"
+
+    async def create_bucket(self, bucket: str, owner: str = "") -> None:
+        buckets = await self._load(BUCKETS_OID)
+        if bucket in buckets:
+            raise RgwError(EEXIST, "BucketAlreadyExists", bucket)
+        buckets[bucket] = {"owner": owner, "created": time.time()}
+        await self._store(BUCKETS_OID, buckets)
+        await self._store(self._index_oid(bucket), {})
+
+    async def list_buckets(self, owner: str | None = None) -> list[str]:
+        buckets = await self._load(BUCKETS_OID)
+        return sorted(
+            b for b, info in buckets.items()
+            if owner is None or info["owner"] == owner
+        )
+
+    async def delete_bucket(self, bucket: str) -> None:
+        buckets = await self._load(BUCKETS_OID)
+        if bucket not in buckets:
+            raise RgwError(ENOENT, "NoSuchBucket", bucket)
+        index = await self._load(self._index_oid(bucket))
+        if index:
+            raise RgwError(EINVAL, "BucketNotEmpty", bucket)
+        del buckets[bucket]
+        await self._store(BUCKETS_OID, buckets)
+        try:
+            await self.ioctx.remove(self._index_oid(bucket))
+        except Exception:
+            pass
+
+    async def _require_bucket(self, bucket: str) -> None:
+        buckets = await self._load(BUCKETS_OID)
+        if bucket not in buckets:
+            raise RgwError(ENOENT, "NoSuchBucket", bucket)
+
+    # -- objects ---------------------------------------------------------------
+
+    def _data(self, bucket: str, key: str) -> StripedObject:
+        return StripedObject(
+            self.ioctx, f"rgw.obj.{bucket}/{key}", policy=self.policy
+        )
+
+    async def put_object(self, bucket: str, key: str, data: bytes) -> str:
+        """PutObject; returns the etag (RGWPutObj)."""
+        await self._require_bucket(bucket)
+        obj = self._data(bucket, key)
+        await obj.remove()  # overwrite semantics
+        await obj.write(data)
+        etag = _etag(data)
+        index = await self._load(self._index_oid(bucket))
+        index[key] = {"size": len(data), "etag": etag, "mtime": time.time()}
+        await self._store(self._index_oid(bucket), index)
+        return etag
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        await self._require_bucket(bucket)
+        index = await self._load(self._index_oid(bucket))
+        if key not in index:
+            raise RgwError(ENOENT, "NoSuchKey", key)
+        return await self._data(bucket, key).read()
+
+    async def head_object(self, bucket: str, key: str) -> dict:
+        await self._require_bucket(bucket)
+        index = await self._load(self._index_oid(bucket))
+        if key not in index:
+            raise RgwError(ENOENT, "NoSuchKey", key)
+        return index[key]
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        await self._require_bucket(bucket)
+        index = await self._load(self._index_oid(bucket))
+        if key in index:
+            del index[key]
+            await self._store(self._index_oid(bucket), index)
+        await self._data(bucket, key).remove()
+
+    async def list_objects(
+        self,
+        bucket: str,
+        prefix: str = "",
+        delimiter: str = "",
+        marker: str = "",
+        max_keys: int = 1000,
+    ) -> dict:
+        """ListObjects with CommonPrefixes rollup
+        (RGWRados::Bucket::List::list_objects)."""
+        await self._require_bucket(bucket)
+        index = await self._load(self._index_oid(bucket))
+        keys = sorted(k for k in index if k.startswith(prefix) and k > marker)
+        contents: list[dict] = []
+        common: list[str] = []
+        truncated = False
+        for key in keys:
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
+            if delimiter:
+                rest = key[len(prefix):]
+                idx = rest.find(delimiter)
+                if idx >= 0:
+                    cp = prefix + rest[: idx + len(delimiter)]
+                    if cp not in common:
+                        common.append(cp)
+                    continue
+            contents.append({"key": key, **index[key]})
+        return {
+            "contents": contents,
+            "common_prefixes": common,
+            "is_truncated": truncated,
+        }
+
+    # -- multipart (RGWCompleteMultipart) --------------------------------------
+
+    async def initiate_multipart(self, bucket: str, key: str) -> str:
+        await self._require_bucket(bucket)
+        upload_id = secrets.token_hex(8)
+        await self._store(
+            f"rgw.multipart.{upload_id}",
+            {"bucket": bucket, "key": key, "parts": {}},
+        )
+        return upload_id
+
+    async def upload_part(
+        self, upload_id: str, part_number: int, data: bytes
+    ) -> str:
+        meta = await self._load(f"rgw.multipart.{upload_id}")
+        if not meta:
+            raise RgwError(ENOENT, "NoSuchUpload", upload_id)
+        part_obj = StripedObject(
+            self.ioctx, f"rgw.part.{upload_id}.{part_number}", policy=self.policy
+        )
+        await part_obj.remove()
+        await part_obj.write(data)
+        etag = _etag(data)
+        meta["parts"][str(part_number)] = {"size": len(data), "etag": etag}
+        await self._store(f"rgw.multipart.{upload_id}", meta)
+        return etag
+
+    async def complete_multipart(self, upload_id: str) -> str:
+        meta = await self._load(f"rgw.multipart.{upload_id}")
+        if not meta:
+            raise RgwError(ENOENT, "NoSuchUpload", upload_id)
+        bucket, key = meta["bucket"], meta["key"]
+        obj = self._data(bucket, key)
+        await obj.remove()
+        off = 0
+        md5s = []
+        for pn in sorted(meta["parts"], key=int):
+            part_obj = StripedObject(
+                self.ioctx, f"rgw.part.{upload_id}.{pn}", policy=self.policy
+            )
+            data = await part_obj.read()
+            await obj.write(data, off)
+            off += len(data)
+            md5s.append(bytes.fromhex(meta["parts"][pn]["etag"]))
+            await part_obj.remove()
+        # S3 multipart etag convention: md5-of-md5s + "-<nparts>"
+        etag = f"{hashlib.md5(b''.join(md5s)).hexdigest()}-{len(md5s)}"
+        index = await self._load(self._index_oid(bucket))
+        index[key] = {"size": off, "etag": etag, "mtime": time.time()}
+        await self._store(self._index_oid(bucket), index)
+        await self.ioctx.remove(f"rgw.multipart.{upload_id}")
+        return etag
+
+    async def abort_multipart(self, upload_id: str) -> None:
+        meta = await self._load(f"rgw.multipart.{upload_id}")
+        for pn in meta.get("parts", {}):
+            await StripedObject(
+                self.ioctx, f"rgw.part.{upload_id}.{pn}", policy=self.policy
+            ).remove()
+        try:
+            await self.ioctx.remove(f"rgw.multipart.{upload_id}")
+        except Exception:
+            pass

@@ -1,0 +1,367 @@
+"""Access-layer tests over a live cluster: striper, rbd, rgw, fs
+(src/libradosstriper, src/librbd, src/rgw, src/mds+client mirrors)."""
+
+import asyncio
+import urllib.request
+import urllib.error
+
+import pytest
+
+from ceph_tpu.client import Rados
+from ceph_tpu.fs import FileSystem, FsError
+from ceph_tpu.rbd import RBD, RbdError
+from ceph_tpu.rgw import ObjectGateway, RgwError, S3Server
+from ceph_tpu.rgw.http import sign_v2
+from ceph_tpu.striper import StripedObject, StripePolicy
+
+from test_cluster import start_cluster, stop_cluster
+
+
+async def make_client(pool="p", size=2, pg_num=4, n_osds=3):
+    monmap, mons, osds = await start_cluster(1, n_osds)
+    client = Rados(monmap)
+    await client.connect()
+    await client.pool_create(pool, "replicated", size=size, pg_num=pg_num)
+    ioctx = await client.open_ioctx(pool)
+    return monmap, mons, osds, client, ioctx
+
+
+class TestStripePolicy:
+    def test_extent_math_roundtrip(self):
+        """map_extent must partition any range exactly once
+        (Striper::file_to_extents invariants)."""
+        p = StripePolicy(stripe_unit=4096, stripe_count=3, object_size=16384)
+        covered = set()
+        for objno, obj_off, ln in p.map_extent(0, 200_000):
+            for b in range(ln):
+                key = (objno, obj_off + b)
+                assert key not in covered
+                covered.add(key)
+        assert len(covered) == 200_000
+        # logical order: walking extents in order covers bytes in order
+        total = sum(ln for _o, _off, ln in p.map_extent(1000, 99_000))
+        assert total == 99_000
+
+    def test_round_robin_layout(self):
+        p = StripePolicy(stripe_unit=10, stripe_count=2, object_size=20)
+        # units: u0->obj0, u1->obj1, u2->obj0, u3->obj1, u4->obj2 (set 2)...
+        assert p.map_extent(0, 10) == [(0, 0, 10)]
+        assert p.map_extent(10, 10) == [(1, 0, 10)]
+        assert p.map_extent(20, 10) == [(0, 10, 10)]
+        assert p.map_extent(30, 10) == [(1, 10, 10)]
+        assert p.map_extent(40, 10) == [(2, 0, 10)]
+
+
+class TestStriper:
+    def test_write_read_truncate(self):
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client()
+            policy = StripePolicy(stripe_unit=4096, stripe_count=2, object_size=8192)
+            so = StripedObject(ioctx, "striped", policy=policy)
+            payload = bytes(i % 251 for i in range(50_000))
+            await so.write(payload)
+            assert await so.size() == len(payload)
+            assert await so.read() == payload
+            # partial read across object boundaries
+            assert await so.read(9000, 3000) == payload[3000:12000]
+            # overwrite in the middle
+            await so.write(b"X" * 1000, 5000)
+            expect = payload[:5000] + b"X" * 1000 + payload[6000:]
+            assert await so.read() == expect
+            # shrink
+            await so.truncate(10_000)
+            assert await so.size() == 10_000
+            assert await so.read() == expect[:10_000]
+            await so.remove()
+            assert not await so.exists()
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestRbd:
+    def test_image_lifecycle(self):
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rbdp")
+            rbd = RBD(ioctx)
+            await rbd.create("vol1", 1 << 22, order=20)  # 4 MiB, 1 MiB objects
+            assert await rbd.list() == ["vol1"]
+            img = await rbd.open("vol1")
+            assert img.size == 1 << 22
+
+            block = bytes(range(256)) * 16  # 4 KiB
+            await img.write(0, block)
+            await img.write((1 << 20) - 2048, block)  # straddles objects
+            assert await img.read(0, 4096) == block
+            assert await img.read((1 << 20) - 2048, 4096) == block
+            # unwritten space reads as zeros
+            assert await img.read(1 << 21, 4096) == b"\x00" * 4096
+
+            with pytest.raises(RbdError):
+                await img.write(img.size, b"x")  # past the end
+
+            await rbd.remove("vol1")
+            assert await rbd.list() == []
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_snapshots_cow(self):
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rbds")
+            rbd = RBD(ioctx)
+            await rbd.create("snapvol", 1 << 20, order=16)  # 64 KiB objects
+            img = await rbd.open("snapvol")
+
+            v1 = b"1" * 65536
+            await img.write(0, v1)
+            await img.snap_create("s1")
+            v2 = b"2" * 65536
+            await img.write(0, v2)  # COW preserves v1 under s1
+            await img.snap_create("s2")
+            v3 = b"3" * 65536
+            await img.write(0, v3)
+
+            assert await img.read(0, 65536) == v3
+            assert await img.read(0, 65536, snap_name="s1") == v1
+            assert await img.read(0, 65536, snap_name="s2") == v2
+            assert await img.snap_list() == ["s1", "s2"]
+
+            # removing the middle snapshot must not corrupt s1
+            await img.snap_remove("s2")
+            assert await img.read(0, 65536, snap_name="s1") == v1
+
+            # rollback to s1
+            await img.snap_rollback("s1")
+            assert await img.read(0, 65536) == v1
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_rollback_preserves_newer_snapshots(self):
+        """snap_rollback's writes COW like any write: a snapshot taken
+        after the target must keep its content."""
+
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rbro")
+            rbd = RBD(ioctx)
+            await rbd.create("rb", 1 << 17, order=16)
+            img = await rbd.open("rb")
+            a, b = b"A" * 65536, b"B" * 65536
+            await img.write(0, a)
+            await img.snap_create("s1")
+            await img.write(0, b)
+            await img.snap_create("s2")
+            await img.snap_rollback("s1")  # head back to A
+            assert await img.read(0, 65536) == a
+            assert await img.read(0, 65536, snap_name="s2") == b  # not lost
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_shrink_preserves_snapshots(self):
+        """resize-shrink COW-preserves dropped objects so snapshot reads
+        of the shrunk region survive (librbd keeps clones across shrink)."""
+
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rbsh")
+            rbd = RBD(ioctx)
+            await rbd.create("sv", 1 << 18, order=16)  # 4 objects
+            img = await rbd.open("sv")
+            data = bytes(range(256)) * 1024  # 256 KiB
+            await img.write(0, data)
+            await img.snap_create("before")
+            await img.resize(1 << 16)  # drop 3 of 4 objects
+            await img.resize(1 << 18)
+            assert await img.read(1 << 16, 1 << 16, snap_name="before") == (
+                data[1 << 16 : 1 << 17]
+            )
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_resize(self):
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rbdr")
+            rbd = RBD(ioctx)
+            await rbd.create("rvol", 1 << 20, order=16)
+            img = await rbd.open("rvol")
+            await img.write(0, b"A" * (1 << 20))
+            await img.resize(1 << 19)
+            assert img.size == 1 << 19
+            await img.resize(1 << 20)
+            assert await img.read(0, 1 << 19) == b"A" * (1 << 19)
+            # the shrunk-then-grown region is zeros, not stale data
+            assert await img.read(1 << 19, 4096) == b"\x00" * 4096
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestRgw:
+    def test_bucket_and_object_ops(self):
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rgwp")
+            gw = ObjectGateway(ioctx)
+            user = await gw.create_user("alice")
+            assert user["access_key"] and user["secret_key"]
+
+            await gw.create_bucket("photos", owner="alice")
+            with pytest.raises(RgwError):
+                await gw.create_bucket("photos")
+            assert await gw.list_buckets() == ["photos"]
+
+            body = b"jpegdata" * 1000
+            etag = await gw.put_object("photos", "2026/cat.jpg", body)
+            import hashlib
+
+            assert etag == hashlib.md5(body).hexdigest()
+            assert await gw.get_object("photos", "2026/cat.jpg") == body
+            meta = await gw.head_object("photos", "2026/cat.jpg")
+            assert meta["size"] == len(body)
+
+            await gw.put_object("photos", "2026/dog.jpg", b"d")
+            await gw.put_object("photos", "2025/old.jpg", b"o")
+            listing = await gw.list_objects("photos", prefix="2026/")
+            assert [c["key"] for c in listing["contents"]] == [
+                "2026/cat.jpg",
+                "2026/dog.jpg",
+            ]
+            # delimiter rollup
+            listing = await gw.list_objects("photos", delimiter="/")
+            assert listing["common_prefixes"] == ["2025/", "2026/"]
+            assert listing["contents"] == []
+
+            with pytest.raises(RgwError):
+                await gw.delete_bucket("photos")  # not empty
+            for k in ("2026/cat.jpg", "2026/dog.jpg", "2025/old.jpg"):
+                await gw.delete_object("photos", k)
+            await gw.delete_bucket("photos")
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_multipart(self):
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rgwm")
+            gw = ObjectGateway(ioctx)
+            await gw.create_bucket("big")
+            upload = await gw.initiate_multipart("big", "huge.bin")
+            p1, p2 = b"a" * 700_000, b"b" * 300_000
+            await gw.upload_part(upload, 1, p1)
+            await gw.upload_part(upload, 2, p2)
+            etag = await gw.complete_multipart(upload)
+            assert etag.endswith("-2")
+            assert await gw.get_object("big", "huge.bin") == p1 + p2
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_s3_http_endpoint(self):
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rgwh")
+            gw = ObjectGateway(ioctx)
+            server = S3Server(gw)
+            addr = await server.serve()
+            base = f"http://{addr}"
+
+            def req(method, path, data=None):
+                r = urllib.request.Request(base + path, data=data, method=method)
+                return urllib.request.urlopen(r, timeout=5)
+
+            loop = asyncio.get_event_loop()
+            # create bucket, put, get, list, delete — full S3 round trip
+            assert (await loop.run_in_executor(None, req, "PUT", "/b1")).status == 200
+            put = await loop.run_in_executor(
+                None, lambda: req("PUT", "/b1/hello.txt", b"hello world")
+            )
+            assert put.status == 200 and put.headers["ETag"]
+            got = await loop.run_in_executor(None, req, "GET", "/b1/hello.txt")
+            assert got.read() == b"hello world"
+            listing = await loop.run_in_executor(None, req, "GET", "/b1")
+            assert b"<Key>hello.txt</Key>" in listing.read()
+            missing_is_404 = False
+            try:
+                await loop.run_in_executor(None, req, "GET", "/b1/ghost")
+            except urllib.error.HTTPError as e:
+                missing_is_404 = e.code == 404
+            assert missing_is_404
+            await server.shutdown()
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_v2_signature(self):
+        sig = sign_v2("secret", "GET", "/b/k", "Tue, 27 Mar 2007 19:36:42 +0000")
+        assert sign_v2("secret", "GET", "/b/k", "Tue, 27 Mar 2007 19:36:42 +0000") == sig
+        assert sign_v2("other", "GET", "/b/k", "Tue, 27 Mar 2007 19:36:42 +0000") != sig
+
+
+class TestFileSystem:
+    def test_namespace_and_io(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("fsmeta", "replicated", size=2, pg_num=2)
+            await client.pool_create("fsdata", "replicated", size=2, pg_num=4)
+            meta = await client.open_ioctx("fsmeta")
+            data = await client.open_ioctx("fsdata")
+            fs = FileSystem(meta, data)
+            await fs.mkfs()
+
+            await fs.mkdir("/home")
+            await fs.mkdir("/home/user")
+            assert await fs.listdir("/") == ["home"]
+            assert await fs.listdir("/home") == ["user"]
+            with pytest.raises(FsError):
+                await fs.mkdir("/home")  # EEXIST
+            with pytest.raises(FsError):
+                await fs.listdir("/ghost")
+
+            content = b"data " * 50_000  # multi-object file
+            await fs.write_file("/home/user/notes.txt", content)
+            assert await fs.read_file("/home/user/notes.txt") == content
+            assert await fs.read_file("/home/user/notes.txt", 10, 5) == content[5:15]
+            st = await fs.stat("/home/user/notes.txt")
+            assert st["type"] == "file" and st["size"] == len(content)
+
+            await fs.rename("/home/user/notes.txt", "/home/notes-v2.txt")
+            assert await fs.listdir("/home/user") == []
+            assert await fs.read_file("/home/notes-v2.txt") == content
+
+            # rename over an existing file replaces it (POSIX), over a
+            # directory fails
+            await fs.write_file("/home/other.txt", b"other")
+            await fs.rename("/home/other.txt", "/home/notes-v2.txt")
+            assert await fs.read_file("/home/notes-v2.txt") == b"other"
+            await fs.write_file("/home/f.txt", b"f")
+            with pytest.raises(FsError):
+                await fs.rename("/home/f.txt", "/home/user")  # dir target
+            await fs.unlink("/home/f.txt")
+            await fs.truncate_file("/home/notes-v2.txt", 100)
+            await fs.write_file("/home/notes-v2.txt", content)
+
+            await fs.truncate_file("/home/notes-v2.txt", 100)
+            assert await fs.read_file("/home/notes-v2.txt") == content[:100]
+
+            await fs.unlink("/home/notes-v2.txt")
+            with pytest.raises(FsError):
+                await fs.read_file("/home/notes-v2.txt")
+            await fs.rmdir("/home/user")
+            assert await fs.listdir("/home") == []
+            with pytest.raises(FsError):
+                await fs.rmdir("/home/ghost")
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
