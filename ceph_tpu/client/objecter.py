@@ -93,9 +93,11 @@ class Objecter(Dispatcher):
         oid: str,
         ops: list[OSDOp],
         timeout: float = 10.0,
+        ps: int | None = None,
     ) -> MOSDOpReply:
         """op_submit (Objecter.cc:2268): send + resend until a final
-        reply.  Raises TimeoutError past `timeout`."""
+        reply.  Raises TimeoutError past `timeout`.  `ps` targets a
+        specific PG instead of hashing `oid` (pg ops like PGLS)."""
         self._tid += 1
         reqid = ReqId(client=self.name, tid=self._tid)
         deadline = time.monotonic() + timeout
@@ -103,7 +105,13 @@ class Objecter(Dispatcher):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(f"op {reqid.key()} on {oid} timed out")
-            pgid, primary = self._calc_target(pool_id, oid)
+            if ps is not None:
+                _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(
+                    pool_id, ps
+                )
+                pgid = PgId(pool_id, ps, -1)
+            else:
+                pgid, primary = self._calc_target(pool_id, oid)
             if primary == PG_NONE:
                 # No live primary in this interval: wait for the map to move
                 await self._wait_map_change(min(remaining, 0.5))

@@ -137,3 +137,23 @@ class IoCtx:
         rep = await self._op(oid, [OSDOp(op=OSDOp.GETXATTR, name=name)])
         _check(rep.result, f"getxattr {oid}:{name}")
         return rep.outdata[0]
+
+    async def list_objects(self) -> list[str]:
+        """Pool-wide object enumeration (rados ls): PGLS against every
+        PG's primary, in parallel (Objecter pg-targeted NLIST ops)."""
+        import asyncio
+
+        pool = self.rados.objecter.osdmap.get_pool(self.pool_id)
+        replies = await asyncio.gather(
+            *(
+                self.rados.objecter.op_submit(
+                    self.pool_id, "", [OSDOp(op=OSDOp.PGLS)], ps=ps
+                )
+                for ps in range(pool.pg_num)
+            )
+        )
+        out: set[str] = set()
+        for ps, rep in enumerate(replies):
+            _check(rep.result, f"pgls {self.pool_id}.{ps}")
+            out.update(json.loads(rep.outdata[0].decode()))
+        return sorted(out)

@@ -135,6 +135,11 @@ class FileSystem:
         POSIX replace semantics: an existing destination FILE is
         replaced (its data objects removed); renaming over a directory
         fails (the MDS requires an empty dir target; we reject outright)."""
+        sparts, dparts = self._split(src), self._split(dst)
+        if dparts[: len(sparts)] == sparts:
+            # moving a directory into its own subtree would detach it into
+            # an unreachable cycle (POSIX/MDS: EINVAL)
+            raise FsError(EINVAL, f"cannot move {src} inside itself")
         sdino, sentries, sname = await self._walk_parent(src)
         if sname not in sentries:
             raise FsError(ENOENT, src)

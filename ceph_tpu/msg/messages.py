@@ -62,6 +62,7 @@ class OSDOp(Struct):
     APPEND = 7
     GETXATTR = 8
     SETXATTR = 9
+    PGLS = 10  # list objects in the PG (rados ls; PrimaryLogPG do_pgnls)
 
     FIELDS = [
         ("op", "u8"),

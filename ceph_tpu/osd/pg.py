@@ -346,6 +346,11 @@ class PG(PGListener):
                     result = -ENODATA
                     break
                 outdata[i] = val
+            elif op.op == OSDOp.PGLS:
+                # PrimaryLogPG::do_pgnls — enumerate this PG's objects
+                import json as _json
+
+                outdata[i] = _json.dumps(sorted(self._list_local())).encode()
             else:
                 result = -EINVAL
                 break

@@ -193,9 +193,17 @@ class Image:
             return  # already preserved for this snap
         except Exception:
             pass
+        from ..client.rados import RadosError
+        from ..common.errs import ENOENT
+
         try:
             current = await self.ioctx.read(self._data_oid(objno))
-        except Exception:
+        except RadosError as e:
+            # ONLY a genuinely absent object preserves as empty; any
+            # transport error must propagate, or a zero copy would be
+            # permanently recorded as the snapshot's content.
+            if e.errno != -ENOENT:
+                raise
             current = b""
         # A never-written object preserves as one zero byte: block reads
         # zero-fill past object ends, so it reads identically, and the
@@ -219,16 +227,23 @@ class Image:
         """Snapshot read resolution: the oldest preserved copy with
         snap >= snap_id wins, else the head (librbd's snap read maps to
         the SnapSet clone covering the snap)."""
+        from ..client.rados import RadosError
+        from ..common.errs import ENOENT
+
         if snap_id is not None:
             for snap in self.header["snaps"]:
                 if snap["id"] >= snap_id:
                     try:
                         return await self.ioctx.read(self._snap_oid(objno, snap["id"]))
-                    except Exception:
+                    except RadosError as e:
+                        if e.errno != -ENOENT:
+                            raise
                         continue  # not preserved under this snap; try newer
         try:
             return await self.ioctx.read(self._data_oid(objno))
-        except Exception:
+        except RadosError as e:
+            if e.errno != -ENOENT:
+                raise
             return b""
 
     async def resize(self, new_size: int) -> None:
@@ -284,7 +299,8 @@ class Image:
         content.  Rollback writes are writes: they COW-preserve first, so
         snapshots newer than the target keep their content."""
         snap = self._snap_by_name(name)
-        objects = (self.size + self.object_bytes - 1) // self.object_bytes
+        span = max(self.size, self.header.get("max_size", self.size))
+        objects = (span + self.object_bytes - 1) // self.object_bytes
         for objno in range(objects):
             data = await self._read_object(objno, snap["id"])
             await self._cow_preserve(objno)
@@ -302,7 +318,8 @@ class Image:
         remaining = [s for s in self.header["snaps"] if s["name"] != name]
         older = [s for s in remaining if s["id"] < snap["id"]]
         heir = older[-1] if older else None
-        objects = (self.size + self.object_bytes - 1) // self.object_bytes
+        span = max(self.size, self.header.get("max_size", self.size))
+        objects = (span + self.object_bytes - 1) // self.object_bytes
         for objno in range(objects):
             src = self._snap_oid(objno, snap["id"])
             try:

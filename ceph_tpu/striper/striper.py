@@ -122,11 +122,16 @@ class StripedObject:
         if off >= size:
             return b""
         length = min(length or size - off, size - off)
+        from ..client.rados import RadosError
+        from ..common.errs import ENOENT
+
         parts = []
         for objno, obj_off, ln in self.policy.map_extent(off, length):
             try:
                 chunk = await self.ioctx.read(self._obj(objno), ln, obj_off)
-            except Exception:
+            except RadosError as e:
+                if e.errno != -ENOENT:
+                    raise  # transport errors must not read as zeros
                 chunk = b""  # sparse / never-written object
             parts.append(chunk.ljust(ln, b"\x00"))
         return b"".join(parts)

@@ -1,0 +1,102 @@
+"""ceph CLI — mirror of src/ceph.in (the admin command shell).
+
+Sends JSON commands to the monitors exactly as the reference CLI builds
+cmdmaps, printing the reply:
+
+    python -m ceph_tpu.tools.ceph_cli status
+    python -m ceph_tpu.tools.ceph_cli osd dump
+    python -m ceph_tpu.tools.ceph_cli osd pool create mypool replicated
+    python -m ceph_tpu.tools.ceph_cli osd erasure-code-profile set p1 k=4 m=2
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..mon.client import MonClient
+from .vstart import CLUSTER_FILE, load_monmap
+
+# prefix word-counts the mon understands, longest match first
+_PREFIXES = [
+    "osd erasure-code-profile set",
+    "osd erasure-code-profile get",
+    "osd erasure-code-profile ls",
+    "osd erasure-code-profile rm",
+    "osd pool create",
+    "osd pool set",
+    "osd pool ls",
+    "osd pool rm",
+    "osd reweight",
+    "osd dump",
+    "osd out",
+    "osd in",
+    "quorum_status",
+    "status",
+]
+
+
+def build_cmd(words: list[str]) -> dict:
+    """Tokens → cmdmap (ceph.in's json_command translation)."""
+    joined = " ".join(words)
+    for prefix in _PREFIXES:
+        if joined == prefix or joined.startswith(prefix + " "):
+            rest = words[len(prefix.split()):]
+            cmd: dict = {"prefix": prefix}
+            if prefix == "osd pool create":
+                for i, k in enumerate(["pool", "pool_type", "erasure_code_profile"]):
+                    if i < len(rest):
+                        cmd[k] = rest[i]
+            elif prefix == "osd pool set":
+                for i, k in enumerate(["pool", "var", "val"]):
+                    if i < len(rest):
+                        cmd[k] = rest[i]
+                if "yes_i_really_mean_it" in rest:
+                    cmd["yes_i_really_mean_it"] = True
+            elif prefix in ("osd pool rm",):
+                if rest:
+                    cmd["pool"] = rest[0]
+            elif prefix == "osd reweight":
+                cmd["id"], cmd["weight"] = rest[0], rest[1]
+            elif prefix in ("osd out", "osd in"):
+                cmd["id"] = rest[0]
+            elif prefix.startswith("osd erasure-code-profile"):
+                if rest:
+                    cmd["name"] = rest[0]
+                    kvs = [r for r in rest[1:] if "=" in r]
+                    if kvs:
+                        cmd["profile"] = kvs
+            return cmd
+    return {"prefix": joined}
+
+
+async def _run(args) -> int:
+    monmap = load_monmap(args.cluster_file)
+    client = MonClient("client.ceph-cli", monmap)
+    try:
+        cmd = build_cmd(args.words)
+        rv, rs, out = await client.command(cmd, timeout=args.timeout)
+        if out:
+            try:
+                print(json.dumps(json.loads(out.decode()), indent=2))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                sys.stdout.buffer.write(out)
+        if rs:
+            print(rs, file=sys.stderr)
+        return 0 if rv == 0 else 1
+    finally:
+        await client.msgr.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cluster-file", default=CLUSTER_FILE)
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("words", nargs="+")
+    sys.exit(asyncio.run(_run(p.parse_args())))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""vstart — dev cluster in one process, mirror of src/vstart.sh.
+
+The reference's vstart.sh boots MON/MGR/OSD daemons on localhost for
+development (defaults MON=3 OSD=3 MGR=1, vstart.sh:120-123).  Here the
+daemons are asyncio objects in one process; `DevCluster` is the library
+surface (used by tools and tests), and running the module starts a
+cluster, writes its monmap to `./dev-cluster.json` for the `rados` /
+`ceph` CLIs, and serves until interrupted:
+
+    python -m ceph_tpu.tools.vstart --mons 1 --osds 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import socket
+
+from ..common.config import Config
+from ..mgr import Mgr
+from ..mon import MonMap, Monitor
+from ..osd.osd import OSD
+
+CLUSTER_FILE = "dev-cluster.json"
+
+
+def _free_port_addrs(n: int) -> dict[str, str]:
+    addrs = {}
+    socks = []
+    for i in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        addrs[chr(ord("a") + i)] = f"127.0.0.1:{s.getsockname()[1]}"
+    for s in socks:
+        s.close()
+    return addrs
+
+
+class DevCluster:
+    """mons + osds + mgr in-process (the vstart topology)."""
+
+    def __init__(
+        self,
+        n_mons: int = 1,
+        n_osds: int = 3,
+        with_mgr: bool = True,
+        conf_overrides: dict | None = None,
+    ):
+        self.n_mons = n_mons
+        self.n_osds = n_osds
+        self.with_mgr = with_mgr
+        self.conf_overrides = conf_overrides or {}
+        self.monmap: MonMap | None = None
+        self.mons: list[Monitor] = []
+        self.osds: list[OSD] = []
+        self.mgr: Mgr | None = None
+
+    async def start(self) -> MonMap:
+        self.monmap = MonMap(addrs=_free_port_addrs(self.n_mons))
+        self.mons = [
+            Monitor(name, self.monmap, election_timeout=0.3)
+            for name in self.monmap.addrs
+        ]
+        for m in self.mons:
+            await m.start()
+        for m in self.mons:
+            await m.wait_for_quorum()
+        for i in range(self.n_osds):
+            conf = Config(
+                {"name": f"osd.{i}", **self.conf_overrides}, env=False
+            )
+            osd = OSD(i, self.monmap, conf=conf)
+            await osd.start()
+            self.osds.append(osd)
+        for osd in self.osds:
+            await osd.wait_for_up()
+        if self.with_mgr:
+            self.mgr = Mgr("x", self.monmap)
+            self.mgr.beacon_interval = 0.5
+            await self.mgr.start()
+            await self.mgr.wait_for_active()
+        return self.monmap
+
+    async def stop(self) -> None:
+        if self.mgr is not None:
+            await self.mgr.stop()
+        for osd in self.osds:
+            if osd._running:
+                await osd.stop()
+        for m in self.mons:
+            await m.stop()
+        await asyncio.sleep(0.05)
+
+    def write_cluster_file(self, path: str = CLUSTER_FILE) -> None:
+        """Connection info for out-of-process CLIs."""
+        with open(path, "w") as f:
+            json.dump({"mon_addrs": self.monmap.addrs}, f)
+
+
+def load_monmap(path: str = CLUSTER_FILE) -> MonMap:
+    with open(path) as f:
+        info = json.load(f)
+    return MonMap(addrs=info["mon_addrs"])
+
+
+async def _main(args) -> None:
+    cluster = DevCluster(args.mons, args.osds, with_mgr=not args.no_mgr)
+    await cluster.start()
+    cluster.write_cluster_file(args.cluster_file)
+    print(f"cluster up: {args.mons} mon(s), {args.osds} osd(s); "
+          f"monmap -> {args.cluster_file}")
+    print("mon addrs:", ", ".join(cluster.monmap.addrs.values()))
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await cluster.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mons", type=int, default=1)
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--no-mgr", action="store_true")
+    p.add_argument("--cluster-file", default=CLUSTER_FILE)
+    args = p.parse_args()
+    try:
+        asyncio.run(_main(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,228 @@
+"""Tool tests: vstart DevCluster, rados/ceph CLI plumbing, PGLS object
+listing, objectstore tool (src/vstart.sh, src/tools mirrors)."""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.client import Rados
+from ceph_tpu.os.filestore import FileStore
+from ceph_tpu.os.transaction import Transaction
+from ceph_tpu.tools.ceph_cli import build_cmd
+from ceph_tpu.tools.objectstore_tool import main as ost_main
+from ceph_tpu.tools.vstart import DevCluster, load_monmap
+
+
+class TestDevCluster:
+    def test_boot_write_read(self):
+        async def run():
+            cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=True)
+            await cluster.start()
+            assert cluster.mgr.active
+            client = Rados(cluster.monmap)
+            await client.connect()
+            await client.pool_create("vp", "replicated", size=3, pg_num=4)
+            ioctx = await client.open_ioctx("vp")
+            await ioctx.write_full("hello", b"world")
+            assert await ioctx.read("hello") == b"world"
+            await client.shutdown()
+            await cluster.stop()
+
+        asyncio.run(run())
+
+    def test_cluster_file_roundtrip(self, tmp_path):
+        async def run():
+            cluster = DevCluster(n_mons=1, n_osds=1, with_mgr=False)
+            await cluster.start()
+            path = str(tmp_path / "cluster.json")
+            cluster.write_cluster_file(path)
+            monmap = load_monmap(path)
+            assert monmap.addrs == cluster.monmap.addrs
+            await cluster.stop()
+
+        asyncio.run(run())
+
+
+class TestPgls:
+    def test_rados_ls(self):
+        async def run():
+            cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=False)
+            await cluster.start()
+            client = Rados(cluster.monmap)
+            await client.connect()
+            await client.pool_create("lsp", "replicated", size=2, pg_num=4)
+            ioctx = await client.open_ioctx("lsp")
+            names = [f"obj-{i}" for i in range(12)]
+            for n in names:
+                await ioctx.write_full(n, n.encode())
+            assert await ioctx.list_objects() == sorted(names)
+            await ioctx.remove("obj-0")
+            assert "obj-0" not in await ioctx.list_objects()
+            await client.shutdown()
+            await cluster.stop()
+
+        asyncio.run(run())
+
+
+class TestCephCliCmdBuilder:
+    def test_build_cmds(self):
+        assert build_cmd(["status"]) == {"prefix": "status"}
+        assert build_cmd(["osd", "dump"]) == {"prefix": "osd dump"}
+        cmd = build_cmd(["osd", "pool", "create", "p1", "erasure", "prof"])
+        assert cmd == {
+            "prefix": "osd pool create",
+            "pool": "p1",
+            "pool_type": "erasure",
+            "erasure_code_profile": "prof",
+        }
+        cmd = build_cmd(
+            ["osd", "erasure-code-profile", "set", "p1", "k=4", "m=2"]
+        )
+        assert cmd["name"] == "p1" and cmd["profile"] == ["k=4", "m=2"]
+        assert build_cmd(["osd", "reweight", "3", "0.5"]) == {
+            "prefix": "osd reweight",
+            "id": "3",
+            "weight": "0.5",
+        }
+
+
+class TestObjectstoreTool:
+    def _mkstore(self, path) -> None:
+        store = FileStore(str(path))
+        store.mount()
+        txn = (
+            Transaction()
+            .create_collection("1.0s0")
+            .touch("1.0s0", "objA")
+            .write("1.0s0", "objA", 0, b"AAAA")
+            .setattr("1.0s0", "objA", "_", b"\x01\x02")
+            .touch("1.0s0", "objB")
+            .write("1.0s0", "objB", 0, b"BBBB")
+        )
+        store.queue_transaction(txn)
+        store.umount()
+
+    def test_list_dump_export_import(self, tmp_path, capsys):
+        src = tmp_path / "osd0"
+        self._mkstore(src)
+
+        assert ost_main(["--data-path", str(src), "--op", "list"]) == 0
+        out = capsys.readouterr().out
+        assert json.loads(out.splitlines()[0]) == ["1.0s0", "objA"]
+
+        assert (
+            ost_main(
+                ["--data-path", str(src), "--op", "dump",
+                 "--coll", "1.0s0", "--oid", "objA"]
+            )
+            == 0
+        )
+        dump = json.loads(capsys.readouterr().out)
+        assert dump["size"] == 4
+        assert "_" in dump["attrs"]
+
+        export_file = str(tmp_path / "pg.export")
+        assert (
+            ost_main(
+                ["--data-path", str(src), "--op", "export",
+                 "--coll", "1.0s0", "--file", export_file]
+            )
+            == 0
+        )
+        # import into a fresh store — disaster-recovery round trip
+        dst = tmp_path / "osd1"
+        assert (
+            ost_main(
+                ["--data-path", str(dst), "--op", "import", "--file", export_file]
+            )
+            == 0
+        )
+        store = FileStore(str(dst))
+        store.mount()
+        assert store.read("1.0s0", "objA", 0, 0) == b"AAAA"
+        assert store.read("1.0s0", "objB", 0, 0) == b"BBBB"
+        assert store.getattr("1.0s0", "objA", "_") == b"\x01\x02"
+        store.umount()
+
+    def test_get_set_bytes(self, tmp_path, capsys):
+        src = tmp_path / "osd0"
+        self._mkstore(src)
+        out_file = str(tmp_path / "obj.bin")
+        assert (
+            ost_main(
+                ["--data-path", str(src), "--op", "get-bytes",
+                 "--coll", "1.0s0", "--oid", "objA", "--file", out_file]
+            )
+            == 0
+        )
+        assert open(out_file, "rb").read() == b"AAAA"
+        with open(out_file, "wb") as f:
+            f.write(b"PATCHED")
+        assert (
+            ost_main(
+                ["--data-path", str(src), "--op", "set-bytes",
+                 "--coll", "1.0s0", "--oid", "objA", "--file", out_file]
+            )
+            == 0
+        )
+        store = FileStore(str(src))
+        store.mount()
+        assert store.read("1.0s0", "objA", 0, 0) == b"PATCHED"
+        store.umount()
+
+
+class TestCliSubprocess:
+    def test_vstart_plus_rados_cli_end_to_end(self, tmp_path):
+        """The CLIs work from a separate process against a live cluster —
+        the qa-standalone shape (daemons + shell tools)."""
+
+        async def run():
+            cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=False)
+            await cluster.start()
+            cfile = str(tmp_path / "cluster.json")
+            cluster.write_cluster_file(cfile)
+            # create a pool via the ceph CLI (subprocess)
+            loop = asyncio.get_event_loop()
+
+            def ceph(*words):
+                return subprocess.run(
+                    [sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
+                     "--cluster-file", cfile, *words],
+                    capture_output=True, timeout=60, cwd="/root/repo",
+                    env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                         "PYTHONPATH": "/root/repo"},
+                )
+
+            def rados(*argv):
+                return subprocess.run(
+                    [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+                     "--cluster-file", cfile, *argv],
+                    capture_output=True, timeout=60, cwd="/root/repo",
+                    env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                         "PYTHONPATH": "/root/repo"},
+                )
+
+            r = await loop.run_in_executor(
+                None, lambda: ceph("osd", "pool", "create", "clip")
+            )
+            assert r.returncode == 0, r.stderr
+            src = tmp_path / "payload.bin"
+            src.write_bytes(b"cli-payload" * 100)
+            r = await loop.run_in_executor(
+                None, lambda: rados("-p", "clip", "put", "obj1", str(src))
+            )
+            assert r.returncode == 0, r.stderr
+            r = await loop.run_in_executor(
+                None, lambda: rados("-p", "clip", "get", "obj1")
+            )
+            assert r.returncode == 0 and r.stdout == b"cli-payload" * 100
+            r = await loop.run_in_executor(None, lambda: rados("-p", "clip", "ls"))
+            assert r.returncode == 0 and b"obj1" in r.stdout
+            r = await loop.run_in_executor(None, lambda: ceph("status"))
+            assert r.returncode == 0 and b"num_up_osds" in r.stdout
+            await cluster.stop()
+
+        asyncio.run(run())
