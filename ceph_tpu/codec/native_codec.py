@@ -1,0 +1,152 @@
+"""Native-engine RS codec — the isa-style CPU SIMD path.
+
+Mirror of the reference `isa` plugin's division of labor
+(/root/reference/src/erasure-code/isa/ErasureCodeIsa.cc): host C++ class
+does matrices/caches, the native library does the byte crunching.  Here
+the host side is ErasureCodeTpuRs's geometry/matrix logic (identical
+math → byte-identical chunks vs the TPU path), and the hot region loops
+run in `libec_native.so` (native/ec_native.cc, the ec_encode_data /
+region_xor twin), dlopen-loaded through the registry's dynamic path
+exactly as the reference loads `libec_isa.so`.
+
+Decode tables are cached per erasure signature in a bounded LRU holding
+native table handles (ErasureCodeIsaTableCache's decode LRU, capacity
+2516, ErasureCodeIsaTableCache.h:48).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections import OrderedDict
+from typing import Mapping
+
+import numpy as np
+
+from ceph_tpu.gf import isa_decode_matrix
+
+from .interface import EcError
+from .matrix_codec import DECODE_LRU_CAPACITY
+from .rs import ErasureCodeTpuRs
+
+EIO = 5
+
+
+class _NativeTables:
+    """RAII over an ec_tables handle."""
+
+    def __init__(self, lib, rows: int, cols: int, matrix: np.ndarray):
+        self._lib = lib
+        self.rows = rows
+        self.cols = cols
+        self._handle = lib.ec_tables_new(
+            rows, cols, np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
+        )
+
+    def apply(self, inputs: list[np.ndarray], length: int) -> list[np.ndarray]:
+        outs = [np.empty(length, dtype=np.uint8) for _ in range(self.rows)]
+        in_arr = (ctypes.c_void_p * self.cols)(*[i.ctypes.data for i in inputs])
+        out_arr = (ctypes.c_void_p * self.rows)(*[o.ctypes.data for o in outs])
+        self._lib.ec_tables_apply(self._handle, in_arr, out_arr, length)
+        return outs
+
+    def __del__(self):
+        try:
+            self._lib.ec_tables_free(self._handle)
+        except Exception:
+            pass
+
+
+class ErasureCodeNative(ErasureCodeTpuRs):
+    """RS(k, m) with native (C++) region coding — plugin `native`."""
+
+    def __init__(self, lib: ctypes.CDLL, technique: str = "reed_sol_van") -> None:
+        super().__init__(technique=technique)
+        self._lib = lib
+        self._encode_tables: _NativeTables | None = None
+        self._decode_lru: OrderedDict[str, tuple[_NativeTables, list[int]]] = (
+            OrderedDict()
+        )
+
+    def invalidate_matrix(self) -> None:
+        super().invalidate_matrix()
+        self._encode_tables = None
+        self._decode_lru = OrderedDict()
+
+    # -- hot paths through the native engine ---------------------------------
+
+    def _get_encode_tables(self) -> _NativeTables:
+        if self._encode_tables is None:
+            mat = self.distribution_matrix()
+            self._encode_tables = _NativeTables(
+                self._lib, self.m, self.k, mat[self.k :]
+            )
+        return self._encode_tables
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        k, m = self.k, self.m
+        data = [
+            np.ascontiguousarray(chunks[self.chunk_index(i)], dtype=np.uint8)
+            for i in range(k)
+        ]
+        length = len(data[0])
+        if m == 1 and self._xor_row_available():
+            # region_xor fast path (ErasureCodeIsa.cc:125-131)
+            out = np.empty(length, dtype=np.uint8)
+            in_arr = (ctypes.c_void_p * k)(*[d.ctypes.data for d in data])
+            self._lib.ec_region_xor(in_arr, k, out.ctypes.data, length)
+            np.copyto(chunks[self.chunk_index(k)], out)
+            return
+        parity = self._get_encode_tables().apply(data, length)
+        for i in range(m):
+            np.copyto(chunks[self.chunk_index(k + i)], parity[i])
+
+    def _decode_tables(self, erasures: list[int]) -> tuple[_NativeTables, list[int]]:
+        # signature string exactly like the reference's "+avail-erased" keys
+        # (ErasureCodeIsa.cc:227-240)
+        sig = "-" + ",".join(map(str, sorted(erasures)))
+        cached = self._decode_lru.get(sig)
+        if cached is not None:
+            self._decode_lru.move_to_end(sig)
+            return cached
+        plan = isa_decode_matrix(self.distribution_matrix(), erasures, self.k)
+        if plan is None:
+            raise EcError(EIO, f"cannot invert decode matrix for {erasures}")
+        c_matrix, index = plan
+        tables = _NativeTables(self._lib, len(erasures), self.k, c_matrix)
+        self._decode_lru[sig] = (tables, index)
+        while len(self._decode_lru) > DECODE_LRU_CAPACITY:
+            self._decode_lru.popitem(last=False)
+        return tables, index
+
+    def decode_chunks(
+        self,
+        want_to_read: set[int],
+        chunks: Mapping[int, np.ndarray],
+        decoded: dict[int, np.ndarray],
+    ) -> None:
+        k, m = self.k, self.m
+        raw_of = self.chunk_index
+        erasures = [i for i in range(k + m) if raw_of(i) not in chunks]
+        if not erasures:
+            return
+        if len(erasures) > m:
+            raise EcError(EIO, f"{len(erasures)} erasures > m={m}")
+        if self._use_xor_decode(erasures):
+            sources = [i for i in range(k + m) if raw_of(i) in chunks][:k]
+            data = [
+                np.ascontiguousarray(decoded[raw_of(i)], dtype=np.uint8)
+                for i in sources
+            ]
+            length = len(data[0])
+            out = np.empty(length, dtype=np.uint8)
+            in_arr = (ctypes.c_void_p * len(data))(*[d.ctypes.data for d in data])
+            self._lib.ec_region_xor(in_arr, len(data), out.ctypes.data, length)
+            np.copyto(decoded[raw_of(erasures[0])], out)
+            return
+        tables, index = self._decode_tables(erasures)
+        survivors = [
+            np.ascontiguousarray(decoded[raw_of(i)], dtype=np.uint8) for i in index
+        ]
+        rec = tables.apply(survivors, len(survivors[0]))
+        for p, e in enumerate(erasures):
+            np.copyto(decoded[raw_of(e)], rec[p])

@@ -114,15 +114,78 @@ class ErasureCodePluginRegistry:
             )
         return ec
 
-    def preload(self, plugins_csv: str) -> None:
-        """Load a comma-separated plugin list at startup
+    def preload(self, plugins_list: str) -> None:
+        """Load a comma- or space-separated plugin list at startup
         (ErasureCodePlugin.cc:180-196; used by OSD boot via
         osd_erasure_code_plugins)."""
-        for name in plugins_csv.split(","):
-            name = name.strip()
+        for name in plugins_list.replace(",", " ").split():
             if name:
                 self.load(name)
 
 
 def instance() -> ErasureCodePluginRegistry:
     return ErasureCodePluginRegistry.instance()
+
+
+# -- native plugin dlopen path (ErasureCodePlugin.cc:126-163) -----------------
+
+# The C-ABI version native plugins must export (the reference checks
+# CEPH_GIT_NICE_VER; ours is the native ABI string in native/ec_native.cc).
+EC_NATIVE_ABI_VERSION = "ceph-tpu-ec-1.0"
+
+
+def load_dynamic(name: str, directory: str):
+    """dlopen `libec_<name>.so` with the reference's contract:
+
+    - load with RTLD_NOW (ErasureCodePlugin.cc:126-128);
+    - missing `__erasure_code_version` or a mismatch -> -EXDEV (:134-143);
+    - missing `__erasure_code_init` -> -ENOENT; nonzero init return
+      propagates (:145-163).
+
+    Returns the loaded CDLL with the region-engine symbols typed."""
+    import ctypes
+    import os
+
+    path = os.path.join(directory, f"libec_{name}.so")
+    if not os.path.exists(path):
+        raise EcError(ENOENT, f"plugin library {path} not found")
+    try:
+        lib = ctypes.CDLL(path, mode=ctypes.RTLD_LOCAL | os.RTLD_NOW)
+    except OSError as e:
+        raise EcError(EXDEV, f"dlopen {path} failed: {e}") from e
+    try:
+        version_fn = lib.__erasure_code_version
+    except AttributeError as e:
+        raise EcError(EXDEV, f"{path} missing __erasure_code_version") from e
+    version_fn.restype = ctypes.c_char_p
+    version = version_fn().decode()
+    if version != EC_NATIVE_ABI_VERSION:
+        raise EcError(
+            EXDEV, f"{path} version {version!r} != expected {EC_NATIVE_ABI_VERSION!r}"
+        )
+    try:
+        init_fn = lib.__erasure_code_init
+    except AttributeError as e:
+        raise EcError(ENOENT, f"{path} missing __erasure_code_init") from e
+    init_fn.restype = ctypes.c_int
+    init_fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    rc = init_fn(name.encode(), directory.encode())
+    if rc != 0:
+        raise EcError(abs(rc) or EXDEV, f"{path} init failed ({rc})")
+    # type the region-engine surface (plugins beyond the entry points)
+    for sym, restype, argtypes in [
+        ("ec_tables_new", ctypes.c_void_p,
+         [ctypes.c_int, ctypes.c_int, ctypes.c_char_p]),
+        ("ec_tables_apply", None,
+         [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]),
+        ("ec_tables_free", None, [ctypes.c_void_p]),
+        ("ec_gf_invert_matrix", ctypes.c_int,
+         [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int]),
+        ("ec_region_xor", None,
+         [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t]),
+    ]:
+        fn = getattr(lib, sym, None)
+        if fn is not None:
+            fn.restype = restype
+            fn.argtypes = argtypes
+    return lib

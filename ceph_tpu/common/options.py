@@ -75,7 +75,7 @@ OPTIONS: dict[str, Option] = _opts(
     Option(
         "osd_erasure_code_plugins",
         str,
-        "tpu jerasure lrc shec clay",
+        "tpu native jerasure lrc shec clay",
         A,
         "space-separated plugins preloaded at OSD boot (global.yaml.in:2541)",
     ),

@@ -128,6 +128,16 @@ class OSD(Dispatcher):
 
     async def start(self) -> None:
         """Boot sequence (ceph_osd.cc main → OSD::init)."""
+        # preload codec plugins (global_init_preload_erasure_code,
+        # src/global/global_init.cc:593; option global.yaml.in:2541)
+        from ..codec.registry import instance as ec_registry
+        from ..common.log import dout as _dout
+
+        for name in self.conf.get("osd_erasure_code_plugins").split():
+            try:
+                ec_registry().load(name)
+            except Exception as e:
+                _dout("osd", 1, f"osd.{self.whoami}: preload {name} failed: {e}")
         self.store.mount()
         await self.msgr.bind(self._bind_addr)
         self.msgr.add_dispatcher_head(self)

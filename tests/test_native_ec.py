@@ -1,0 +1,171 @@
+"""Native EC plugin tests — mirror of the reference tier-1 pattern:
+TestErasureCodeIsa.cc round trips vs the oracle, plus the hostile-plugin
+registry fixtures (TestErasureCodePlugin.cc +
+ErasureCodePluginFailToInitialize/MissingVersion/MissingEntryPoint.cc)."""
+
+import pathlib
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codec.interface import EcError
+from ceph_tpu.codec.registry import (
+    EC_NATIVE_ABI_VERSION,
+    instance,
+    load_dynamic,
+)
+
+NATIVE_DIR = str(pathlib.Path(__file__).resolve().parents[1] / "native")
+
+HAVE_GXX = shutil.which("g++") is not None
+
+
+def _codec(plugin, k, m, technique="reed_sol_van"):
+    profile = {"k": str(k), "m": str(m), "plugin": plugin}
+    if technique != "reed_sol_van":
+        profile["technique"] = technique
+    return instance().factory(plugin, profile)
+
+
+class TestNativeCodec:
+    def test_roundtrip_all_single_erasures(self):
+        ec = _codec("native", 4, 2)
+        rng = np.random.default_rng(1)
+        obj = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+        enc = ec.encode(set(range(6)), obj)
+        for lost in range(6):
+            avail = {i: enc[i] for i in range(6) if i != lost}
+            dec = ec.decode({lost}, avail)
+            assert np.array_equal(dec[lost], enc[lost]), f"erasure {lost}"
+
+    def test_double_erasures(self):
+        ec = _codec("native", 6, 3, "cauchy")
+        rng = np.random.default_rng(2)
+        obj = rng.integers(0, 256, 30_000, dtype=np.uint8).tobytes()
+        enc = ec.encode(set(range(9)), obj)
+        for a in range(9):
+            for b in range(a + 1, 9):
+                avail = {i: enc[i] for i in range(9) if i not in (a, b)}
+                dec = ec.decode({a, b}, avail)
+                assert np.array_equal(dec[a], enc[a])
+                assert np.array_equal(dec[b], enc[b])
+
+    @pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy"])
+    @pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 3), (10, 4)])
+    def test_byte_identity_vs_tpu_plugin(self, k, m, technique):
+        """The native engine and the TPU bitsliced path must produce
+        byte-identical chunks (both mirror ISA-L's math)."""
+        if technique == "reed_sol_van" and m == 4 and k > 21:
+            pytest.skip("outside the Vandermonde MDS envelope")
+        native = _codec("native", k, m, technique)
+        tpu = _codec("tpu", k, m, technique)
+        rng = np.random.default_rng(k * 100 + m)
+        obj = rng.integers(0, 256, 64 * 1024 + 123, dtype=np.uint8).tobytes()
+        n = k + m
+        enc_n = native.encode(set(range(n)), obj)
+        enc_t = tpu.encode(set(range(n)), obj)
+        for i in range(n):
+            assert np.array_equal(enc_n[i], enc_t[i]), f"chunk {i} differs"
+
+    def test_m1_xor_fast_path(self):
+        ec = _codec("native", 5, 1)
+        obj = bytes(range(256)) * 100
+        enc = ec.encode(set(range(6)), obj)
+        expect = np.zeros_like(np.asarray(enc[0]))
+        for i in range(5):
+            expect ^= np.asarray(enc[i])
+        assert np.array_equal(enc[5], expect)
+
+    def test_decode_lru_reuse(self):
+        ec = _codec("native", 4, 2)
+        obj = b"z" * 8192
+        enc = ec.encode(set(range(6)), obj)
+        avail = {i: enc[i] for i in range(6) if i not in (0, 5)}
+        ec.decode({0, 5}, avail)
+        assert len(ec._decode_lru) == 1
+        ec.decode({0, 5}, avail)  # same signature: no new entry
+        assert len(ec._decode_lru) == 1
+
+
+class TestNativeInvert:
+    def test_invert_matches_python(self):
+        import ctypes
+
+        from ceph_tpu.gf import gf_matmul, isa_cauchy_matrix
+
+        lib = load_dynamic("native", NATIVE_DIR)
+        mat = np.ascontiguousarray(isa_cauchy_matrix(4, 4)[4:], dtype=np.uint8)
+        inv = np.zeros((4, 4), dtype=np.uint8)
+        rc = lib.ec_gf_invert_matrix(mat.tobytes(), inv.ctypes.data, 4)
+        assert rc == 0
+        assert np.array_equal(gf_matmul(mat, inv), np.eye(4, dtype=np.uint8))
+
+    def test_singular_returns_error(self):
+        lib = load_dynamic("native", NATIVE_DIR)
+        sing = np.ones((3, 3), dtype=np.uint8)  # rank 1
+        out = np.zeros((3, 3), dtype=np.uint8)
+        assert lib.ec_gf_invert_matrix(sing.tobytes(), out.ctypes.data, 3) == -1
+
+
+FIXTURES = {
+    # reference ErasureCodePluginMissingVersion.cc
+    "missingversion": "",
+    # reference ErasureCodePluginMissingEntryPoint.cc
+    "missingentrypoint": """
+extern "C" const char* __erasure_code_version(void) { return "%s"; }
+""" % EC_NATIVE_ABI_VERSION,
+    # bad version string (the -EXDEV check, ErasureCodePlugin.cc:134-143)
+    "badversion": """
+extern "C" const char* __erasure_code_version(void) { return "wrong-1"; }
+extern "C" int __erasure_code_init(const char*, const char*) { return 0; }
+""",
+    # reference ErasureCodePluginFailToInitialize.cc
+    "failinit": """
+extern "C" const char* __erasure_code_version(void) { return "%s"; }
+extern "C" int __erasure_code_init(const char*, const char*) { return -22; }
+""" % EC_NATIVE_ABI_VERSION,
+}
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="no g++ for plugin fixtures")
+class TestHostilePlugins:
+    """Registry failure modes with freshly compiled hostile plugins."""
+
+    @pytest.fixture()
+    def fixture_dir(self, tmp_path):
+        for name, src in FIXTURES.items():
+            cc = tmp_path / f"{name}.cc"
+            cc.write_text(src or "// empty: exports nothing\n")
+            subprocess.run(
+                ["g++", "-shared", "-fPIC", "-o",
+                 str(tmp_path / f"libec_{name}.so"), str(cc)],
+                check=True, capture_output=True,
+            )
+        return str(tmp_path)
+
+    def test_missing_library(self, tmp_path):
+        with pytest.raises(EcError) as e:
+            load_dynamic("nosuch", str(tmp_path))
+        assert e.value.errno == -2  # ENOENT
+
+    def test_missing_version_symbol(self, fixture_dir):
+        with pytest.raises(EcError) as e:
+            load_dynamic("missingversion", fixture_dir)
+        assert e.value.errno == -18  # EXDEV
+
+    def test_version_mismatch(self, fixture_dir):
+        with pytest.raises(EcError) as e:
+            load_dynamic("badversion", fixture_dir)
+        assert e.value.errno == -18
+
+    def test_missing_entry_point(self, fixture_dir):
+        with pytest.raises(EcError) as e:
+            load_dynamic("missingentrypoint", fixture_dir)
+        assert e.value.errno == -2
+
+    def test_init_failure(self, fixture_dir):
+        with pytest.raises(EcError) as e:
+            load_dynamic("failinit", fixture_dir)
+        assert e.value.errno == -22  # the init's own errno propagates
