@@ -81,16 +81,62 @@ class PgAutoscalerModule(MgrModule):
                 "POOL_PG_NUM", "warning", f"pg_num suboptimal ({summary})"
             )
             return
+        skipped = []
         for name, r in flagged.items():
+            # The mon interlock requires `yes_i_really_mean_it` as the
+            # caller's assertion that the pool is EMPTY (pg_num changes remap
+            # every object with no PG-split migration).  Only assert it when
+            # the OSD status reports actually verify emptiness; pools that
+            # cannot be verified degrade to the warn-mode health check.
+            if not self._pool_verified_empty(name):
+                dout(
+                    "mgr",
+                    4,
+                    f"pg_autoscaler: {name} not verifiably empty; not applying",
+                )
+                skipped.append(f"{name}: {r['current']} -> {r['ideal']}")
+                continue
             rv, rs, _ = await self.mgr.mon_command(
                 {
                     "prefix": "osd pool set",
                     "pool": name,
                     "var": "pg_num",
                     "val": str(r["ideal"]),
-                    # `on` mode is documented as empty-pools-only: assert it
                     "yes_i_really_mean_it": True,
                 }
             )
             if rv != 0:
-                dout("mgr", 1, f"pg_autoscaler: {name} pg_num set failed: {rs}")
+                dout("mgr", 1, f"pg_autoscaler: {name} pg_num set refused: {rs}")
+                skipped.append(f"{name}: {r['current']} -> {r['ideal']}")
+        if skipped:
+            self.set_health_check(
+                "POOL_PG_NUM",
+                "warning",
+                f"pg_num suboptimal, not auto-applied ({', '.join(skipped)})",
+            )
+        else:
+            self.clear_health_check("POOL_PG_NUM")
+
+    def _pool_verified_empty(self, pool_name: str) -> bool:
+        """True only when every up+in OSD has reported a status blob and all
+        of them show zero objects for the pool.  An OSD that has not yet
+        reported (or predates pool_objects) makes the pool unverifiable."""
+        osdmap = self.mgr.osdmap
+        pool = next(
+            (p for p in osdmap.pools.values() if p.name == pool_name), None
+        )
+        if pool is None:
+            return False
+        pid = str(pool.id)
+        for osd_id, info in osdmap.osds.items():
+            if not (info.up and info.in_):
+                # A down/out OSD may still hold this pool's only copies of
+                # data that no reporting OSD sees — unverifiable, not empty.
+                return False
+            status = self.mgr.get_daemon_status(f"osd.{osd_id}")
+            counts = status.get("pool_objects")
+            if counts is None:
+                return False
+            if counts.get(pid, 0) != 0:
+                return False
+        return True

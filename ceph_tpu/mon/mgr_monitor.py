@@ -51,6 +51,15 @@ class MgrMonitor:
     def on_election_changed(self) -> None:
         self._proposing = False
         self._pending.clear()
+        # Re-baseline beacon timestamps: a newly elected leader has an empty
+        # _last_beacon map, and tick() comparing against 0.0 would instantly
+        # fail over a healthy active mgr.  Give every known daemon one full
+        # grace period from election before judging it (the reference
+        # re-baselines beacons on election, MgrMonitor.cc).
+        now = time.monotonic()
+        for name in [self.map.active_name, *self.map.standbys]:
+            if name:
+                self._last_beacon[name] = now
 
     # -- beacons ---------------------------------------------------------------
 

@@ -352,6 +352,10 @@ class MOSDPGLog(Message):
         ("log", "bytes"),
         ("epoch", "u32"),
         ("from_osd", "u32"),
+        # the version the delta starts after — lets the receiver detect
+        # local entries in (since, head] absent from the delta as divergent
+        ("since_epoch", "u32"),
+        ("since_ver", "u64"),
     ]
 
 

@@ -262,6 +262,10 @@ class FileStore(ObjectStore):
         d = self._require_coll(coll)
         return sorted(bytes.fromhex(f).decode() for f in os.listdir(d))
 
+    def count_objects(self, coll: str) -> int:
+        # no decode/sort — one readdir, for stat polling
+        return len(os.listdir(self._require_coll(coll)))
+
     def list_collections(self) -> list[str]:
         out = []
         for d in os.listdir(self.path):

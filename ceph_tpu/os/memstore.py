@@ -115,5 +115,8 @@ class MemStore(ObjectStore):
     def list_objects(self, coll: str) -> list[str]:
         return sorted(self._coll(coll))
 
+    def count_objects(self, coll: str) -> int:
+        return len(self._coll(coll))
+
     def list_collections(self) -> list[str]:
         return sorted(self._colls)

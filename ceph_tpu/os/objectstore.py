@@ -118,6 +118,11 @@ class ObjectStore:
     def list_objects(self, coll: str) -> list[str]:
         raise NotImplementedError
 
+    def count_objects(self, coll: str) -> int:
+        """Object count for a collection.  Backends override with an O(1)
+        path where they can (stat polling must not enumerate the store)."""
+        return len(self.list_objects(coll))
+
     def list_collections(self) -> list[str]:
         raise NotImplementedError
 

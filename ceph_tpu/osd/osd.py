@@ -486,9 +486,17 @@ class OSD(Dispatcher):
 
 def _osd_status(osd: "OSD") -> dict:
     """The status blob the mgr aggregates (DaemonServer daemon status)."""
+    pool_objects: dict[str, int] = {}
+    for pg in osd.pgs.values():
+        pid = str(pg.pool.id)
+        pool_objects[pid] = pool_objects.get(pid, 0) + pg.local_object_count()
     return {
         "num_pgs": len(osd.pgs),
         "up": osd.up,
         "osdmap_epoch": osd.osdmap.epoch,
         "clog_errors": len(osd.clog),
+        # per-pool local object counts — the pg-stats slice the autoscaler
+        # needs to verify a pool is empty before a pg_num change
+        # (the reference's richer MPGStats -> mgr flow)
+        "pool_objects": pool_objects,
     }

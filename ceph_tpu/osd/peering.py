@@ -66,6 +66,7 @@ class PeeringState:
         send: Callable[[int, object], None],
         on_active: Callable[[], None],
         list_local_objects: Callable[[], list[str]],
+        drop_local_object: Callable[[str], None] | None = None,
     ):
         self.pgid = pgid
         self.whoami = whoami
@@ -74,6 +75,7 @@ class PeeringState:
         self.send = send
         self.on_active = on_active
         self.list_local_objects = list_local_objects
+        self.drop_local_object = drop_local_object
 
         self.state = PeerState.RESET
         self.epoch = 0
@@ -150,11 +152,12 @@ class PeeringState:
                 ),
             )
         elif msg.op == MOSDPGQuery.LOG:
-            since = Eversion(msg.since_epoch, msg.since_ver)
+            since = self._common_point(Eversion(msg.since_epoch, msg.since_ver))
             if self.log.can_catch_up(since):
                 entries = self.log.entries_after(since)
             else:
                 entries = list(self.log.entries)  # best effort full log
+                since = self.log.tail
             blob = _pack_entries(entries)
             self.send(
                 msg.from_osd,
@@ -164,8 +167,28 @@ class PeeringState:
                     log=blob,
                     epoch=msg.epoch,
                     from_osd=self.whoami,
+                    since_epoch=since.epoch,
+                    since_ver=since.version,
                 ),
             )
+
+    def _common_point(self, v: Eversion) -> Eversion:
+        """Newest point of agreement with a peer claiming head `v`.
+
+        If `v` is not an entry of our log (and is inside our log window),
+        the peer's head is DIVERGENT — it logged writes the surviving
+        acting set never saw (e.g. an old primary that crashed before
+        replicating).  The delta must then start from our newest entry
+        below `v`, so the peer can detect and rewind everything past it
+        (PeeringState::proc_replica_log / PGLog::rewind_divergent_log)."""
+        if (
+            not v
+            or v <= self.log.tail
+            or any(e.version == v for e in self.log.entries)
+        ):
+            return v
+        older = [e.version for e in self.log.entries if e.version < v]
+        return max(older) if older else self.log.tail
 
     def handle_notify(self, msg: MOSDPGNotify) -> None:
         """proc_replica_info: gather infos during GetInfo."""
@@ -205,23 +228,75 @@ class PeeringState:
         if msg.epoch != self.epoch:
             return
         entries = _unpack_entries(msg.log)
+        since = Eversion(msg.since_epoch, msg.since_ver)
         if self.state == PeerState.GETLOG and msg.from_osd == getattr(
             self, "auth_osd", None
         ):
-            self._merge_log(entries)
             auth_info = PgInfo.frombytes(msg.info)
+            self._merge_log(entries, auth_last=auth_info.last_update, since=since)
             self.info.last_update = auth_info.last_update
             self._activate()
         elif self.state in (PeerState.STRAY, PeerState.REPLICA_ACTIVE):
-            self._merge_log(entries)
+            auth_info = PgInfo.frombytes(msg.info)
+            self._merge_log(entries, auth_last=auth_info.last_update, since=since)
             self.info.last_update = self.log.head
             self.info.last_epoch_started = msg.epoch
             self.state = PeerState.REPLICA_ACTIVE
             dout("osd", 10, f"pg {self.pgid} replica active @ {self.log.head}")
 
-    def _merge_log(self, entries: list[LogEntry]) -> None:
-        """PGLog::merge_log: append unseen entries; each one names an
-        object version we do not have on disk yet → missing."""
+    def _merge_log(
+        self,
+        entries: list[LogEntry],
+        auth_last: Eversion | None = None,
+        since: Eversion | None = None,
+    ) -> None:
+        """PGLog::merge_log: adopt the authoritative delta.
+
+        `since` is the point the sender computed the delta from (its newest
+        entry at/below our claimed head).  Local entries past `since` that
+        are absent from the delta are DIVERGENT — writes the rest of the
+        acting set never saw, including the canonical failover case where a
+        dead primary's unreplicated write sits at an *older* epoch than the
+        new auth head.  The reference rewinds them to prior_version
+        (PGLog::_merge_divergent_entries); here the entry is dropped from
+        the log, the divergent on-disk copy is dropped (so recovery PULLS
+        the authoritative version instead of pushing the stale copy back
+        out), and the object is marked missing at prior_version."""
+        if auth_last is not None:
+            start = since if since is not None else auth_last
+            delta_versions = {
+                (e.version.epoch, e.version.version) for e in entries
+            }
+            divergent = [
+                e
+                for e in self.log.entries
+                if e.version > start
+                and (e.version.epoch, e.version.version) not in delta_versions
+            ]
+            if divergent:
+                keep = {id(e) for e in divergent}
+                self.log.entries = [
+                    e for e in self.log.entries if id(e) not in keep
+                ]
+                rewound: set[str] = set()
+                for e in divergent:
+                    if e.oid in rewound:
+                        continue
+                    rewound.add(e.oid)
+                    dout(
+                        "osd",
+                        5,
+                        f"pg {self.pgid} rewinding divergent {e.oid} "
+                        f"{e.version} -> {e.prior_version}",
+                    )
+                    if self.drop_local_object is not None:
+                        self.drop_local_object(e.oid)
+                    if e.prior_version:
+                        self.missing.add(e.oid, e.prior_version)
+                    else:
+                        # created by the divergent write: it simply should
+                        # not exist; nothing to recover
+                        self.missing.rm(e.oid)
         for entry in entries:
             if entry.version > self.log.head:
                 self.log.append(entry)
@@ -237,13 +312,19 @@ class PeeringState:
         head = self.log.head
         for osd in self._up_peers():
             pinfo = self.peer_info.get(osd, PgInfo())
-            if pinfo.last_update >= head:
+            # A peer whose claimed head is not in our (authoritative) log
+            # holds divergent entries: rewind its effective head to the
+            # newest agreed point so the delta spans the divergent region
+            # and the peer can detect + rewind it (proc_replica_log).
+            peer_head = self._common_point(pinfo.last_update)
+            if pinfo.last_update >= head and peer_head == pinfo.last_update:
                 self.peer_missing[osd] = Missing()
                 continue
-            if self.log.can_catch_up(pinfo.last_update):
+            if self.log.can_catch_up(peer_head):
                 # proc_replica_log: delta past the peer's head = its missing
-                self.peer_missing[osd] = self.log.missing_from(pinfo.last_update)
-                delta = self.log.entries_after(pinfo.last_update)
+                self.peer_missing[osd] = self.log.missing_from(peer_head)
+                delta = self.log.entries_after(peer_head)
+                delta_since = peer_head
             else:
                 # Log trimmed past the peer: backfill (everything we have)
                 self.backfill_targets.add(osd)
@@ -252,6 +333,7 @@ class PeeringState:
                     m.add(oid, head)
                 self.peer_missing[osd] = m
                 delta = list(self.log.entries)
+                delta_since = self.log.tail
             blob = _pack_entries(delta)
             self.send(
                 osd,
@@ -261,6 +343,8 @@ class PeeringState:
                     log=blob,
                     epoch=self.epoch,
                     from_osd=self.whoami,
+                    since_epoch=delta_since.epoch,
+                    since_ver=delta_since.version,
                 ),
             )
         dout(

@@ -35,6 +35,7 @@ from ..msg.messages import (
     MOSDPGQuery,
     OSDOp,
     PgId,
+    ReqId,
 )
 from ..os.transaction import Transaction
 from .ec_transaction import PGTransaction
@@ -74,6 +75,7 @@ class PG(PGListener):
             send=self._send_peering,
             on_active=self._on_active,
             list_local_objects=self._list_local,
+            drop_local_object=self._drop_local_object,
         )
         self.backend = build_pg_backend(pool, profiles, self, osd.store)
         from .scrubber import PgScrubber
@@ -131,9 +133,52 @@ class PG(PGListener):
         except Exception:
             return []
 
+    def local_object_count(self) -> int:
+        """O(1)/one-readdir count for stat reporting (no enumeration)."""
+        coll = shard_coll(self.pgid, self.whoami_shard())
+        try:
+            return self.osd.store.count_objects(coll)
+        except Exception:
+            return 0
+
+    def _drop_local_object(self, oid: str) -> None:
+        """Divergent-rewind hook: a stale-but-present local copy must be
+        dropped so recovery PULLS the authoritative version instead of
+        treating the local bytes as good (recover_object's exists() check
+        would otherwise push the divergent copy back out as 'repair')."""
+        coll = shard_coll(self.pgid, self.whoami_shard())
+        try:
+            if self.osd.store.exists(coll, oid):
+                self.osd.store.queue_transaction(Transaction().remove(coll, oid))
+        except Exception:
+            pass
+
     def _on_active(self) -> None:
         self._version = max(self._version, self.pg_log.head.version)
+        self._rebuild_dup_window()
         self._kick_recovery()
+
+    def _rebuild_dup_window(self) -> None:
+        """Replay reqid dup detection from the PG log on activation.
+
+        The in-memory dup maps die with the old primary; the Objecter's
+        resend loop reuses the same tid, so without replay a non-idempotent
+        op (APPEND, offset WRITE) that already committed would re-execute on
+        the new primary.  The reference rebuilds dups from the pg log
+        (PGLog::dups / PrimaryLogPG already-complete checks); here every
+        logged write's reqid is reinstated as a completed-op reply."""
+        self._reqid_results.clear()
+        self._inflight_reqids.clear()
+        for e in self.pg_log.entries[-1000:]:  # same bound as the live window
+            if e.reqid == ("", 0):
+                continue
+            self._reqid_results[e.reqid] = MOSDOpReply(
+                reqid=ReqId(*e.reqid),
+                result=0,
+                outdata=[],
+                version=e.version.version,
+                epoch=self._epoch,
+            )
 
     def handle_peering_message(self, msg) -> bool:
         if isinstance(msg, MOSDPGQuery):

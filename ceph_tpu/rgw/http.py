@@ -22,12 +22,25 @@ from xml.sax.saxutils import escape as _x
 from .rgw import ObjectGateway, RgwError
 
 
-def sign_v2(secret_key: str, method: str, path: str, date: str) -> str:
-    """AWS signature v2 (rgw_auth_s3 string-to-sign, reduced to the
-    fields this server canonicalizes)."""
-    string_to_sign = f"{method}\n\n\n{date}\n{path}"
+def sign_v2(
+    secret_key: str,
+    method: str,
+    path: str,
+    date: str,
+    content_md5: str = "",
+    content_type: str = "",
+) -> str:
+    """AWS signature v2 string-to-sign, as rgw_auth_s3 canonicalizes it:
+    Method, Content-MD5, Content-Type, Date, CanonicalizedResource.
+    Covering Content-MD5 binds the signature to the request body."""
+    string_to_sign = f"{method}\n{content_md5}\n{content_type}\n{date}\n{path}"
     mac = hmac.new(secret_key.encode(), string_to_sign.encode(), hashlib.sha1)
     return base64.b64encode(mac.digest()).decode()
+
+
+# AWS rejects requests whose Date is more than 15 minutes off the server
+# clock (rgw's RGW_AUTH_GRACE); limits replay of a captured signature.
+DATE_SKEW_S = 15 * 60
 
 
 class S3Server:
@@ -83,7 +96,9 @@ class S3Server:
         finally:
             writer.close()
 
-    async def _authenticate(self, method: str, path: str, headers: dict) -> bool:
+    async def _authenticate(
+        self, method: str, path: str, headers: dict, body: bytes
+    ) -> bool:
         if not self.require_auth:
             return True
         auth = headers.get("authorization", "")
@@ -93,19 +108,53 @@ class S3Server:
             access_key, signature = auth[4:].split(":", 1)
         except ValueError:
             return False
+        date = headers.get("date", "")
+        if not self._date_fresh(date):
+            return False
+        # The signature covers Content-MD5; when the client sends it, the
+        # body must actually hash to it, or an attacker could replay a
+        # captured signature with a different body attached.  (v2 treats
+        # Content-MD5 as optional — stock clients omit it on PUT — so a
+        # body without the header is accepted, as rgw/AWS do; transport
+        # security covers that gap.)
+        content_md5 = headers.get("content-md5", "")
+        if content_md5:
+            actual = base64.b64encode(hashlib.md5(body).digest()).decode()
+            if not hmac.compare_digest(content_md5, actual):
+                return False
         user = await self.gw.user_by_access_key(access_key)
         if user is None:
             return False
         expect = sign_v2(
-            user["secret_key"], method, path, headers.get("date", "")
+            user["secret_key"],
+            method,
+            path,
+            date,
+            content_md5=content_md5,
+            content_type=headers.get("content-type", ""),
         )
         return hmac.compare_digest(signature, expect)
+
+    @staticmethod
+    def _date_fresh(date: str) -> bool:
+        from email.utils import parsedate_to_datetime
+
+        try:
+            sent = parsedate_to_datetime(date)
+        except (TypeError, ValueError):
+            return False
+        import datetime
+
+        if sent.tzinfo is None:
+            sent = sent.replace(tzinfo=datetime.timezone.utc)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return abs((now - sent).total_seconds()) <= DATE_SKEW_S
 
     async def _route(self, method: str, target: str, headers: dict, body: bytes):
         url = urlparse(target)
         path = unquote(url.path)
         query = parse_qs(url.query, keep_blank_values=True)
-        if not await self._authenticate(method, path, headers):
+        if not await self._authenticate(method, path, headers, body):
             return "403 Forbidden", {}, _error_xml("AccessDenied")
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]

@@ -1,0 +1,261 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+Each class pins one fixed defect so it cannot reappear:
+- S3 v2 auth: signature must bind the body (Content-MD5) and the Date must
+  be fresh (rgw_auth_s3 canonicalization + RGW_AUTH_GRACE).
+- Peering merge_log must rewind divergent entries (PGLog merge_log).
+- MgrMonitor must re-baseline beacons on election (MgrMonitor.cc).
+- PG dup detection must be rebuilt from the PG log on activation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from email.utils import formatdate
+
+from ceph_tpu.mon.mgr_monitor import MgrMonitor
+from ceph_tpu.msg.messages import PgId
+from ceph_tpu.osd.peering import PeeringState
+from ceph_tpu.osd.pg_log import Eversion, LogEntry, PGLog, PgInfo
+from ceph_tpu.rgw.http import S3Server, sign_v2
+
+
+class _FakeGateway:
+    async def user_by_access_key(self, access_key):
+        return {"secret_key": "secret"} if access_key == "AK" else None
+
+
+def _auth(server, method, path, headers, body=b""):
+    return asyncio.run(server._authenticate(method, path, headers, body))
+
+
+def _signed_headers(method, path, body=b"", date=None, secret="secret"):
+    import base64
+    import hashlib
+
+    date = date or formatdate(usegmt=True)
+    md5 = base64.b64encode(hashlib.md5(body).digest()).decode() if body else ""
+    sig = sign_v2(secret, method, path, date, content_md5=md5)
+    headers = {"authorization": f"AWS AK:{sig}", "date": date}
+    if md5:
+        headers["content-md5"] = md5
+    return headers
+
+
+class TestS3AuthV2:
+    def test_fresh_signed_request_accepted(self):
+        server = S3Server(_FakeGateway(), require_auth=True)
+        body = b"hello world"
+        headers = _signed_headers("PUT", "/b/k", body)
+        assert _auth(server, "PUT", "/b/k", headers, body)
+
+    def test_stale_date_rejected(self):
+        server = S3Server(_FakeGateway(), require_auth=True)
+        stale = "Tue, 27 Mar 2007 19:36:42 GMT"
+        headers = _signed_headers("GET", "/b/k", date=stale)
+        assert not _auth(server, "GET", "/b/k", headers)
+
+    def test_tampered_body_rejected(self):
+        # Captured signature replayed with a different body must fail: the
+        # Content-MD5 in the canonical string no longer matches the bytes.
+        server = S3Server(_FakeGateway(), require_auth=True)
+        headers = _signed_headers("PUT", "/b/k", b"original")
+        assert not _auth(server, "PUT", "/b/k", headers, b"attacker payload")
+
+    def test_body_without_md5_accepted(self):
+        # v2 treats Content-MD5 as optional; stock clients omit it on PUT.
+        server = S3Server(_FakeGateway(), require_auth=True)
+        headers = _signed_headers("PUT", "/b/k")  # signed without a body
+        assert _auth(server, "PUT", "/b/k", headers, b"plain v2 client body")
+
+    def test_wrong_md5_rejected(self):
+        server = S3Server(_FakeGateway(), require_auth=True)
+        headers = _signed_headers("PUT", "/b/k", b"original")
+        headers["content-md5"] = "AAAAAAAAAAAAAAAAAAAAAA=="
+        assert not _auth(server, "PUT", "/b/k", headers, b"original")
+
+    def test_wrong_secret_rejected(self):
+        server = S3Server(_FakeGateway(), require_auth=True)
+        headers = _signed_headers("GET", "/b/k", secret="other")
+        assert not _auth(server, "GET", "/b/k", headers)
+
+
+def _entry(oid, epoch, version, prior=None, reqid=("", 0)):
+    return LogEntry(
+        oid=oid,
+        version=Eversion(epoch, version),
+        prior_version=prior or Eversion(),
+        reqid=reqid,
+    )
+
+
+def _peering(log):
+    return PeeringState(
+        PgId(1, 0, -1),
+        whoami=0,
+        log=log,
+        info=PgInfo(),
+        send=lambda osd, msg: None,
+        on_active=lambda: None,
+        list_local_objects=lambda: [],
+    )
+
+
+class TestDivergentRewind:
+    def test_divergent_entries_rewound_and_marked_missing(self):
+        log = PGLog()
+        log.append(_entry("a", 1, 1))
+        log.append(_entry("b", 1, 2, prior=Eversion()))
+        log.append(_entry("b", 1, 3, prior=Eversion(1, 2)))  # divergent write
+        ps = _peering(log)
+
+        # authoritative shard's head is (1,2): entry (1,3) was never seen by
+        # the rest of the acting set and must be rewound to prior (1,2).
+        ps._merge_log([], auth_last=Eversion(1, 2))
+        assert ps.log.head == Eversion(1, 2)
+        assert "b" in ps.missing
+        assert "a" not in ps.missing
+
+    def test_divergent_object_rewinds_to_prior_version(self):
+        log = PGLog()
+        log.append(_entry("a", 1, 1))
+        log.append(_entry("a", 1, 5, prior=Eversion(1, 1)))
+        ps = _peering(log)
+        ps._merge_log([], auth_last=Eversion(1, 1))
+        assert ps.log.head == Eversion(1, 1)
+        need, _have = ps.missing.items["a"]
+        assert need == Eversion(1, 1)
+
+    def test_divergence_across_epochs(self):
+        # The canonical failover case: old primary A logged an unreplicated
+        # write (epoch 1, v7) and crashed; the new primary's head is
+        # (2, 8) > (1, 7), so a naive head-vs-auth-head comparison never
+        # fires.  The delta's `since` (newest agreed point) + absence from
+        # the delta must still identify (1,7) as divergent.
+        log = PGLog()
+        log.append(_entry("a", 1, 6))
+        log.append(_entry("x", 1, 7, prior=Eversion()))  # unreplicated write
+        dropped = []
+        ps = PeeringState(
+            PgId(1, 0, -1),
+            whoami=0,
+            log=log,
+            info=PgInfo(),
+            send=lambda osd, msg: None,
+            on_active=lambda: None,
+            list_local_objects=lambda: [],
+            drop_local_object=dropped.append,
+        )
+        delta = [_entry("b", 2, 8, prior=Eversion())]
+        ps._merge_log(delta, auth_last=Eversion(2, 8), since=Eversion(1, 6))
+        versions = [e.version for e in ps.log.entries]
+        assert Eversion(1, 7) not in versions
+        assert Eversion(2, 8) in versions
+        assert dropped == ["x"]  # stale on-disk copy dropped -> pull path
+        assert "x" not in ps.missing  # created by the divergent write only
+
+    def test_common_point_rewinds_unknown_head(self):
+        log = PGLog()
+        log.append(_entry("a", 1, 6))
+        log.append(_entry("b", 2, 8))
+        ps = _peering(log)
+        # peer claims (1,7) which we never saw -> newest agreed point (1,6)
+        assert ps._common_point(Eversion(1, 7)) == Eversion(1, 6)
+        # a head we do have is its own common point
+        assert ps._common_point(Eversion(2, 8)) == Eversion(2, 8)
+
+    def test_no_rewind_when_log_matches_auth(self):
+        log = PGLog()
+        log.append(_entry("a", 1, 1))
+        ps = _peering(log)
+        ps._merge_log(
+            [_entry("c", 1, 2, prior=Eversion())], auth_last=Eversion(1, 2)
+        )
+        assert ps.log.head == Eversion(1, 2)
+        assert "a" not in ps.missing
+        assert "c" in ps.missing  # merged entry we don't have on disk yet
+
+
+class TestDupWindowRebuild:
+    def _pg(self):
+        from ceph_tpu.os.memstore import MemStore
+        from ceph_tpu.osd.osdmap import PgPool
+        from ceph_tpu.osd.pg import PG
+
+        class FakeOsd:
+            whoami = 0
+            store = MemStore()
+
+        FakeOsd.store.mount()
+        pool = PgPool(id=1, name="p", size=2, min_size=1)
+        return PG(FakeOsd(), pool, 0, profiles={})
+
+    def test_rebuild_from_pg_log_on_activation(self):
+        # A new primary must recognize the Objecter's resend (same reqid) of
+        # a write that committed under the old primary: the dup window is
+        # replayed from the PG log, not kept only in the dead primary's RAM.
+        pg = self._pg()
+        pg._epoch = 3
+        pg.pg_log.append(
+            _entry("obj1", 2, 7, prior=Eversion(), reqid=("client.4", 11))
+        )
+        pg.pg_log.append(
+            _entry("obj2", 2, 8, prior=Eversion(), reqid=("client.4", 12))
+        )
+        pg._rebuild_dup_window()
+        rep = pg._reqid_results[("client.4", 11)]
+        assert rep.result == 0 and rep.version == 7
+        assert ("client.4", 12) in pg._reqid_results
+
+    def test_entries_without_reqid_skipped(self):
+        pg = self._pg()
+        pg.pg_log.append(_entry("obj1", 2, 7))  # e.g. a recovery/clone entry
+        pg._rebuild_dup_window()
+        assert pg._reqid_results == {}
+
+
+class _FakeMon:
+    def __init__(self):
+        self.proposals = []
+
+    def is_leader(self):
+        return True
+
+    def propose(self, service, blob, on_done=None):
+        self.proposals.append((service, blob))
+        if on_done:
+            on_done(1)
+
+    def publish_mgrmap(self):
+        pass
+
+
+class TestMgrBeaconRebaseline:
+    def test_new_leader_does_not_failover_healthy_mgr(self):
+        mon = _FakeMon()
+        mm = MgrMonitor(mon)
+        mm.map.active_name = "x"
+        mm.map.active_addr = "addr"
+        mm.map.standbys = {"y": "addr2"}
+        # Newly elected leader: beacon map is empty.  Without re-baselining,
+        # tick() compares against 0.0 and instantly fails over.
+        mm.on_election_changed()
+        mm.tick()
+        assert mon.proposals == []
+        assert mm.map.active_name == "x"
+
+    def test_failover_still_happens_after_grace(self, monkeypatch):
+        import ceph_tpu.mon.mgr_monitor as mod
+
+        mon = _FakeMon()
+        mm = MgrMonitor(mon)
+        mm.map.active_name = "x"
+        mm.map.standbys = {"y": "addr2"}
+        mm.on_election_changed()
+        # advance time past the grace window
+        base = mm._last_beacon["x"]
+        monkeypatch.setattr(
+            mod.time, "monotonic", lambda: base + mod.BEACON_GRACE + 1
+        )
+        mm.tick()
+        assert mon.proposals, "expected a failover proposal after grace expiry"
