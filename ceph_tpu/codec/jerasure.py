@@ -17,11 +17,19 @@ Techniques:
                    ones (packetsize accepted for profile compat; the TPU
                    kernel has no packet concept)
 
-w (Galois field width) is fixed at 8: the TPU field core is GF(2^8), which is
-the reference default.  w=16/32 profiles are rejected with EINVAL rather than
-silently re-encoded differently.  The liberation/blaum_roth/liber8tion
-bitmatrix techniques (w prime, packet-layout-dependent) are not yet
-implemented.
+For the GF(2^8) matrix techniques, w (Galois field width) is fixed at 8: the
+TPU field core is GF(2^8), which is the reference default.  w=16/32 profiles
+are rejected with EINVAL rather than silently re-encoded differently.
+
+The liberation / blaum_roth / liber8tion techniques
+(ErasureCodeJerasure.h:169-253) are packetized GF(2) BIT-MATRIX codes: every
+chunk is w packets of `packetsize` bytes and coding XORs whole packets
+selected by a (2w, kw) 0/1 matrix (RAID-6, m=2 only).  Their TPU mapping
+(`ErasureCodeJerasureBitmatrix`) reshapes chunks to (super-packets, k*w,
+packetsize) plane tensors and runs one gf2_plane_matmul launch per encode —
+the packet loop of the reference's jerasure_schedule_encode becomes the
+batch axis.  Matrix constructions are re-derived in gf/gf2.py (the jerasure
+submodule that defines them is not vendored in the reference checkout).
 """
 
 from __future__ import annotations
@@ -34,12 +42,19 @@ from ceph_tpu.gf import (
     jerasure_r6_matrix,
     jerasure_vandermonde_matrix,
 )
+from ceph_tpu.gf.gf2 import (
+    blaum_roth_bitmatrix,
+    liber8tion_bitmatrix,
+    liberation_bitmatrix,
+)
+from ceph_tpu.ops.xor_mm import gf2_plane_matmul
 
-from .base import EINVAL, ErasureCode
+from .base import EINVAL, EIO, ErasureCode
 from .interface import EcError, Profile
-from .matrix_codec import MatrixCodecMixin
+from .matrix_codec import PLAN_CACHE, MatrixCodecMixin
 
 TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good")
+BITMATRIX_TECHNIQUES = ("liberation", "blaum_roth", "liber8tion")
 
 
 class ErasureCodeJerasure(MatrixCodecMixin, ErasureCode):
@@ -91,3 +106,142 @@ class ErasureCodeJerasure(MatrixCodecMixin, ErasureCode):
 
     def get_data_chunk_count(self) -> int:
         return self.k
+
+
+class ErasureCodeJerasureBitmatrix(ErasureCode):
+    """liberation / blaum_roth / liber8tion — packetized GF(2) bit-matrix
+    RAID-6 codes on the plane-granular XOR-matmul kernel.
+
+    Chunk layout (jerasure bit-matrix convention): a chunk of S*w*packetsize
+    bytes is S super-packets of w packets each; coding row r of super-packet
+    s is the XOR of the data packets its matrix row selects.  The reference
+    walks packets in a C loop with a precomputed XOR schedule
+    (jerasure_schedule_encode); here all S super-packets for all rows go in
+    one gf2_plane_matmul launch, with S the batch axis on the MXU.
+    """
+
+    DEFAULT_PACKETSIZE = "2048"  # ErasureCodeJerasure.h:141
+
+    def __init__(self, technique: str) -> None:
+        super().__init__()
+        if technique not in BITMATRIX_TECHNIQUES:
+            raise EcError(EINVAL, f"unknown bitmatrix technique {technique}")
+        self.technique = technique
+        self.k = 0
+        self.m = 0
+        self.w = 0
+        self.packetsize = 0
+        self._bitmatrix: np.ndarray | None = None
+
+    # defaults per reference class declarations (ErasureCodeJerasure.h)
+    def _defaults(self) -> tuple[str, str, str]:
+        if self.technique == "liber8tion":
+            return "2", "2", "8"
+        return "2", "2", "7"
+
+    def parse(self, profile: Profile) -> None:
+        super().parse(profile)
+        dk, dm, dw = self._defaults()
+        self.k = self.to_int("k", profile, dk)
+        self.m = self.to_int("m", profile, dm)
+        self.w = self.to_int("w", profile, dw)
+        self.packetsize = self.to_int("packetsize", profile, self.DEFAULT_PACKETSIZE)
+        self.sanity_check_k_m(self.k, self.m)
+        if self.m != 2:
+            raise EcError(
+                EINVAL, f"{self.technique} is RAID-6 only: m must be 2, got {self.m}"
+            )
+        if self.k > self.w:
+            raise EcError(
+                EINVAL, f"k={self.k} must be <= w={self.w} ({self.technique})"
+            )
+        if self.packetsize <= 0 or self.packetsize % 4:
+            # check_packetsize: multiple of sizeof(int)
+            raise EcError(
+                EINVAL, f"packetsize={self.packetsize} must be a positive multiple of 4"
+            )
+        try:
+            if self.technique == "liberation":
+                self._bitmatrix = liberation_bitmatrix(self.k, self.w)
+            elif self.technique == "blaum_roth":
+                self._bitmatrix = blaum_roth_bitmatrix(self.k, self.w)
+            else:
+                if self.w != 8:
+                    raise ValueError(f"liber8tion requires w=8, got w={self.w}")
+                self._bitmatrix = liber8tion_bitmatrix(self.k)
+        except ValueError as e:
+            raise EcError(EINVAL, str(e))
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        # chunks must be whole super-packets; keep the TPU lane alignment too
+        import math
+
+        return math.lcm(self.w * self.packetsize, self.ALIGNMENT)
+
+    # -- coding ------------------------------------------------------------
+
+    def _planes(self, arrays: list[np.ndarray]) -> np.ndarray:
+        """k chunks of S*w*packetsize bytes -> (S, k*w, packetsize)."""
+        w, P = self.w, self.packetsize
+        stacked = np.stack([np.asarray(a, dtype=np.uint8) for a in arrays])
+        S = stacked.shape[1] // (w * P)
+        # (k, S*w*P) -> (k, S, w, P) -> (S, k, w, P) -> (S, k*w, P)
+        return (
+            stacked.reshape(len(arrays), S, w, P)
+            .transpose(1, 0, 2, 3)
+            .reshape(S, len(arrays) * w, P)
+        )
+
+    def _unplanes(self, planes: np.ndarray, n: int) -> np.ndarray:
+        """(S, n*w, P) -> (n, S*w*P) chunk bytes."""
+        S, _, P = planes.shape
+        return (
+            planes.reshape(S, n, self.w, P).transpose(1, 0, 2, 3).reshape(n, -1)
+        )
+
+    def _check_size(self, size: int) -> None:
+        if size % (self.w * self.packetsize):
+            raise EcError(
+                EINVAL,
+                f"chunk size {size} not a multiple of w*packetsize "
+                f"{self.w * self.packetsize}",
+            )
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        k, m = self.k, self.m
+        raw_of = self.chunk_index
+        self._check_size(len(chunks[raw_of(0)]))
+        planes = self._planes([chunks[raw_of(i)] for i in range(k)])
+        coded = np.asarray(gf2_plane_matmul(self._bitmatrix, planes))
+        out = self._unplanes(coded, m)
+        for i in range(m):
+            np.copyto(chunks[raw_of(k + i)], out[i])
+
+    def decode_chunks(
+        self,
+        want_to_read: set[int],
+        chunks,
+        decoded: dict[int, np.ndarray],
+    ) -> None:
+        k, m, w = self.k, self.m, self.w
+        raw_of = self.chunk_index
+        erasures = [i for i in range(k + m) if raw_of(i) not in chunks]
+        if not erasures:
+            return
+        if len(erasures) > m:
+            raise EcError(EIO, f"{len(erasures)} erasures > m={m}")
+        self._check_size(len(next(iter(chunks.values()))))
+        dec, decode_index = PLAN_CACHE.gf2_decode_plan(
+            self._bitmatrix, k, w, erasures
+        )
+        planes = self._planes([decoded[raw_of(i)] for i in decode_index])
+        rec = np.asarray(gf2_plane_matmul(dec, planes))
+        out = self._unplanes(rec, len(erasures))
+        for p, e in enumerate(erasures):
+            np.copyto(decoded[raw_of(e)], out[p])

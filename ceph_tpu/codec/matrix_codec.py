@@ -75,6 +75,44 @@ class _GlobalPlanCache:
                 self._decode.popitem(last=False)
         return bm
 
+    def gf2_decode_plan(
+        self, bitmatrix: np.ndarray, k: int, w: int, erasures: list[int]
+    ) -> tuple[np.ndarray, list[int]]:
+        """Decode plan for a packetized GF(2) bit-matrix RAID-6 code
+        (liberation family): (decode matrix (len(erasures)*w, k*w),
+        decode_index).  Shares the one decode LRU so total decode-table
+        memory stays within DECODE_LRU_CAPACITY."""
+        from ceph_tpu.gf.gf2 import gf2_inv, gf2_matmul
+
+        n = k + bitmatrix.shape[0] // w
+        erased = set(erasures)
+        decode_index = [c for c in range(n) if c not in erased][:k]
+        if len(decode_index) < k:
+            raise EcError(EIO, f"not enough survivors for erasures {erasures}")
+        key = (bitmatrix.shape, bitmatrix.tobytes(), "#gf2", tuple(erasures))
+        with self._lock:
+            cached = self._decode.get(key)
+            if cached is not None:
+                self._decode.move_to_end(key)
+                return cached
+        # full generator: data identity rows then the coding rows (the
+        # bitmatrix already carries both the P-identity and Q blocks)
+        full = np.zeros((n * w, k * w), dtype=np.uint8)
+        full[: k * w] = np.eye(k * w, dtype=np.uint8)
+        full[k * w :] = bitmatrix
+        survivors = np.vstack([full[c * w : (c + 1) * w] for c in decode_index])
+        inv = gf2_inv(survivors)
+        if inv is None:
+            raise EcError(EIO, f"singular decode matrix for erasures {erasures}")
+        erased_rows = np.vstack([full[c * w : (c + 1) * w] for c in erasures])
+        plan = (gf2_matmul(erased_rows, inv), decode_index)
+        with self._lock:
+            self._decode[key] = plan
+            self._decode.move_to_end(key)
+            while len(self._decode) > DECODE_LRU_CAPACITY:
+                self._decode.popitem(last=False)
+        return plan
+
     def decode_plan(
         self, dist_matrix: np.ndarray, erasures: list[int], k: int
     ) -> tuple[jnp.ndarray, list[int]]:

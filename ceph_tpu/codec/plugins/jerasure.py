@@ -5,7 +5,11 @@ ErasureCodePluginJerasure.cc: technique selection via the `technique` profile
 key (default reed_sol_van).
 """
 
-from ceph_tpu.codec.jerasure import ErasureCodeJerasure
+from ceph_tpu.codec.jerasure import (
+    BITMATRIX_TECHNIQUES,
+    ErasureCodeJerasure,
+    ErasureCodeJerasureBitmatrix,
+)
 from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePlugin
 
 __erasure_code_version__ = EC_VERSION
@@ -13,7 +17,10 @@ __erasure_code_version__ = EC_VERSION
 
 def _factory(profile):
     technique = profile.get("technique") or "reed_sol_van"
-    ec = ErasureCodeJerasure(technique=technique)
+    if technique in BITMATRIX_TECHNIQUES:
+        ec = ErasureCodeJerasureBitmatrix(technique)
+    else:
+        ec = ErasureCodeJerasure(technique=technique)
     ec.init(profile)
     return ec
 

@@ -68,6 +68,35 @@ def xor_matmul(bit_matrix: jax.Array, data: jax.Array) -> jax.Array:
 
 
 @jax.jit
+def gf2_plane_matmul(bit_matrix: jax.Array, planes: jax.Array) -> jax.Array:
+    """XOR-accumulate matmul at PLANE granularity: B (R, Q) 0/1 applied to
+    (..., Q, P) uint8 planes -> (..., R, P), out[r] = XOR of planes[q]
+    where B[r, q] = 1.
+
+    The packetized coding step of the jerasure bit-matrix family
+    (liberation / blaum_roth / liber8tion; jerasure_schedule_encode in the
+    reference's submodule): a "bit" selects a whole packet, and XOR is a
+    carryless bytewise add, so each of a byte's 8 bit-lanes rides the same
+    MXU matmul independently.
+
+    NOT redundant with `xor_matmul(expand_matrix(B), planes)`: that is
+    bit-for-bit equivalent (coeff 1 expands to an 8x8 identity block) but
+    contracts over an 8x longer axis with an 8x taller matrix — 8x the MXU
+    FLOPs and 64x the matrix operand — because byte-granular selection
+    doesn't need per-bit matrix rows.
+    """
+    shifts = jnp.arange(8, dtype=jnp.uint8).reshape(8, 1)
+    bits = (planes[..., :, None, :] >> shifts) & jnp.uint8(1)  # (..., Q, 8, P)
+    acc = jnp.einsum(
+        "rq,...qbp->...rbp",
+        bit_matrix.astype(jnp.int8),
+        bits.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )
+    return ((acc & 1).astype(jnp.uint8) << shifts).sum(axis=-2, dtype=jnp.uint8)
+
+
+@jax.jit
 def xor_reduce(data: jax.Array) -> jax.Array:
     """XOR-fold chunks: (..., k, L) uint8 -> (..., L) uint8.
 

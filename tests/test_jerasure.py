@@ -82,3 +82,152 @@ def test_plugin_registration():
     decoded = ec.decode({0, 5}, {i: encoded[i] for i in (1, 2, 3, 4)})
     assert np.array_equal(decoded[0], encoded[0])
     assert np.array_equal(decoded[5], encoded[5])
+
+
+# -- liberation / blaum_roth / liber8tion (packetized GF(2) bit-matrix) ------
+
+
+def make_bm(technique, k, w=None, packetsize=8):
+    from ceph_tpu.codec.jerasure import ErasureCodeJerasureBitmatrix
+
+    profile = {"k": str(k), "m": "2", "packetsize": str(packetsize)}
+    if w is not None:
+        profile["w"] = str(w)
+    ec = ErasureCodeJerasureBitmatrix(technique)
+    ec.init(profile)
+    return ec
+
+
+@pytest.mark.parametrize(
+    "technique,k,w",
+    [
+        ("liberation", 2, 3),
+        ("liberation", 5, 5),
+        ("liberation", 7, 7),
+        ("blaum_roth", 4, 4),
+        ("blaum_roth", 6, 6),
+        ("blaum_roth", 7, 10),  # w+1 = 11 prime
+        ("liber8tion", 2, 8),
+        ("liber8tion", 6, 8),
+        ("liber8tion", 8, 8),
+    ],
+)
+def test_bitmatrix_roundtrip_all_erasures(technique, k, w):
+    ec = make_bm(technique, k, w=w)
+    raw = payload(k * w * 8 * 2 + 13, seed=3)
+    n = k + 2
+    encoded = ec.encode(set(range(n)), raw)
+    chunk_size = ec.get_chunk_size(len(raw))
+    assert chunk_size % (ec.w * ec.packetsize) == 0
+    for nerr in (1, 2):
+        for erasures in itertools.combinations(range(n), nerr):
+            avail = {i: encoded[i] for i in range(n) if i not in erasures}
+            decoded = ec.decode(set(erasures), avail)
+            for e in erasures:
+                assert np.array_equal(decoded[e], encoded[e]), (technique, erasures)
+
+
+def test_blaum_roth_legacy_w7_single_erasure_only():
+    # The reference tolerates w=7 (Firefly default) even though w+1=8 is
+    # not prime (ErasureCodeJerasure.cc:459-472).  In that ring the modulus
+    # is (x-1)^7, so every data-pair decode matrix shares a (1+x) factor
+    # and is singular: the code is single-erasure-strength only.  Accept
+    # the profile, round-trip single erasures, and surface EIO for pairs.
+    ec = make_bm("blaum_roth", 4, w=7)
+    raw = payload(4 * 7 * 8, seed=6)
+    encoded = ec.encode(set(range(6)), raw)
+    for e in range(6):
+        avail = {i: encoded[i] for i in range(6) if i != e}
+        decoded = ec.decode({e}, avail)
+        assert np.array_equal(decoded[e], encoded[e])
+    with pytest.raises(EcError):
+        ec.decode({0, 1}, {i: encoded[i] for i in range(2, 6)})
+
+
+def test_bitmatrix_p_drive_is_xor():
+    # The first coding drive of every RAID-6 bit-matrix code is the plain
+    # XOR of the data drives (identity blocks).
+    ec = make_bm("liberation", 4, w=5)
+    raw = payload(4 * 5 * 8, seed=4)
+    encoded = ec.encode(set(range(6)), raw)
+    expect = encoded[0].copy()
+    for i in range(1, 4):
+        expect ^= encoded[i]
+    assert np.array_equal(encoded[4], expect)
+
+
+def test_bitmatrix_profile_validation():
+    from ceph_tpu.codec.jerasure import ErasureCodeJerasureBitmatrix
+
+    # m != 2
+    with pytest.raises(EcError):
+        make = ErasureCodeJerasureBitmatrix("liberation")
+        make.init({"k": "3", "m": "3", "w": "5"})
+    # w not prime (liberation)
+    with pytest.raises(EcError):
+        ErasureCodeJerasureBitmatrix("liberation").init({"k": "3", "m": "2", "w": "6"})
+    # k > w
+    with pytest.raises(EcError):
+        ErasureCodeJerasureBitmatrix("liberation").init({"k": "6", "m": "2", "w": "5"})
+    # blaum_roth: w+1 must be prime (w=8 -> 9 not prime)
+    with pytest.raises(EcError):
+        ErasureCodeJerasureBitmatrix("blaum_roth").init({"k": "3", "m": "2", "w": "8"})
+    # liber8tion: w pinned to 8
+    with pytest.raises(EcError):
+        ErasureCodeJerasureBitmatrix("liber8tion").init({"k": "3", "m": "2", "w": "7"})
+    # packetsize must be a positive multiple of 4
+    with pytest.raises(EcError):
+        ErasureCodeJerasureBitmatrix("liberation").init(
+            {"k": "3", "m": "2", "w": "5", "packetsize": "6"}
+        )
+
+
+def test_bitmatrix_defaults_match_reference():
+    # ErasureCodeJerasure.h: liberation/blaum_roth default k=2 m=2 w=7;
+    # liber8tion defaults k=2 m=2 w=8.
+    from ceph_tpu.codec.jerasure import ErasureCodeJerasureBitmatrix
+
+    lib = ErasureCodeJerasureBitmatrix("liberation")
+    lib.init({})
+    assert (lib.k, lib.m, lib.w, lib.packetsize) == (2, 2, 7, 2048)
+    l8 = ErasureCodeJerasureBitmatrix("liber8tion")
+    l8.init({})
+    assert (l8.k, l8.m, l8.w) == (2, 2, 8)
+
+
+def test_bitmatrix_via_registry():
+    r = ErasureCodePluginRegistry.instance()
+    for technique, w in (("liberation", "5"), ("blaum_roth", "6"), ("liber8tion", "8")):
+        ec = r.factory(
+            "jerasure",
+            {"technique": technique, "k": "4", "m": "2", "w": w, "packetsize": "8"},
+        )
+        raw = payload(4 * int(w) * 8, seed=5)
+        encoded = ec.encode(set(range(6)), raw)
+        avail = {i: encoded[i] for i in range(6) if i not in (0, 5)}
+        decoded = ec.decode({0, 5}, avail)
+        assert np.array_equal(decoded[0], encoded[0])
+        assert np.array_equal(decoded[5], encoded[5])
+
+
+def test_bitmatrix_respects_chunk_mapping():
+    # mapping= remaps logical chunk positions (ErasureCode.cc:260-279); the
+    # bit-matrix class must route through chunk_index like the GF(2^8) one.
+    ec = make_bm("liberation", 4, w=5)
+    ec.init({"k": "4", "m": "2", "w": "5", "packetsize": "8",
+             "mapping": "_DDDD_"})
+    raw = payload(4 * 5 * 8, seed=7)
+    n = 6
+    encoded = ec.encode(set(range(n)), raw)
+    # data lives at remapped positions; round-trip through two erasures
+    avail = {i: encoded[i] for i in range(n) if i not in (1, 2)}
+    decoded = ec.decode({1, 2}, avail)
+    assert np.array_equal(decoded[1], encoded[1])
+    assert np.array_equal(decoded[2], encoded[2])
+
+
+def test_bitmatrix_decode_rejects_misaligned_chunks():
+    ec = make_bm("liberation", 3, w=5, packetsize=8)  # w*P = 40
+    bad = {i: np.zeros(100, dtype=np.uint8) for i in range(1, 5)}
+    with pytest.raises(EcError):
+        ec.decode({0}, bad)
