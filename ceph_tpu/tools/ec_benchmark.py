@@ -42,7 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("-S", "--size", type=int, default=1 << 20)
     p.add_argument("-i", "--iterations", type=int, default=1)
-    p.add_argument("-w", "--workload", choices=("encode", "decode"), default="encode")
+    p.add_argument(
+        "-w",
+        "--workload",
+        choices=("encode", "decode", "repair"),
+        default="encode",
+    )
     p.add_argument("-e", "--erasures", type=int, default=1)
     p.add_argument(
         "--erased",
@@ -109,6 +114,45 @@ def run_decode(ec, args) -> float:
     return elapsed
 
 
+def run_repair(ec, args) -> tuple[float, int, int]:
+    """Single-chunk repair via minimum_to_decode's sub-chunk read plan.
+
+    The regenerating-code metric (BASELINE config 4): a CLAY codec's plan
+    reads d helpers x sub_chunk_no/q sub-chunks — d/(d-k+1) chunks' worth —
+    where an MDS code reads k full chunks.  Returns (elapsed, bytes_read,
+    bytes_repaired); the read plan mirrors ECBackend's fragmented sub-chunk
+    reads (/root/reference/src/osd/ECBackend.cc:1047-1068; repair plan
+    clay/ErasureCodeClay.cc:363-377).
+    """
+    n = ec.get_chunk_count()
+    buf = np.random.default_rng(0).integers(0, 256, args.size, dtype=np.uint8)
+    encoded = ec.encode(set(range(n)), buf)
+    chunk_size = len(encoded[0])
+    sub = chunk_size // ec.get_sub_chunk_count()
+    rng = random.Random(0)
+
+    elapsed, bytes_read, bytes_repaired = 0.0, 0, 0
+    for i in range(args.iterations):
+        lost = args.erased[0] if args.erased else rng.randrange(n)
+        avail = set(range(n)) - {lost}
+        minimum = ec.minimum_to_decode({lost}, avail)
+        helpers: dict[int, np.ndarray] = {}
+        for node, runs in minimum.items():
+            frags = [
+                encoded[node][off * sub : (off + count) * sub]
+                for off, count in runs
+            ]
+            helpers[node] = np.concatenate(frags)
+            bytes_read += len(helpers[node])
+        t0 = time.perf_counter()
+        decoded = ec.decode({lost}, helpers, chunk_size)
+        elapsed += time.perf_counter() - t0
+        if not np.array_equal(decoded[lost], encoded[lost]):
+            raise SystemExit(f"repair mismatch for lost chunk {lost}")
+        bytes_repaired += chunk_size
+    return elapsed, bytes_read, bytes_repaired
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -118,8 +162,17 @@ def main(argv=None) -> int:
         return 1
     if args.workload == "encode":
         elapsed = run_encode(ec, args)
-    else:
+    elif args.workload == "decode":
         elapsed = run_decode(ec, args)
+    else:
+        elapsed, bytes_read, bytes_repaired = run_repair(ec, args)
+        # repair emits an extra TAB field pair: read/repaired byte ratio —
+        # the regenerating-code repair-bandwidth saving
+        print(
+            f"{elapsed:.6f}\t{args.iterations * args.size / 1024:.0f}"
+            f"\t{bytes_read}\t{bytes_repaired}"
+        )
+        return 0
     print(f"{elapsed:.6f}\t{args.iterations * args.size / 1024:.0f}")
     return 0
 

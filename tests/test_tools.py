@@ -102,3 +102,52 @@ class TestBenchmark:
             ]
         )
         assert rc == 0
+
+
+class TestRepairWorkload:
+    def test_clay_repair_reads_fraction(self, capsys):
+        from ceph_tpu.tools import ec_benchmark
+
+        rc = ec_benchmark.main(
+            ["-w", "repair", "-p", "clay", "-P", "k=4", "-P", "m=2",
+             "-P", "d=5", "-S", "16384", "-i", "2"]
+        )
+        assert rc == 0
+        parts = capsys.readouterr().out.strip().split("\t")
+        assert len(parts) == 4
+        bytes_read, bytes_repaired = int(parts[2]), int(parts[3])
+        # CLAY(4,2,d=5): q=2 -> reads d/q = 2.5 chunks' worth, not k=4
+        assert bytes_read == int(2.5 * bytes_repaired)
+
+    def test_rs_repair_reads_k_chunks(self, capsys):
+        from ceph_tpu.tools import ec_benchmark
+
+        rc = ec_benchmark.main(
+            ["-w", "repair", "-p", "tpu", "-P", "k=4", "-P", "m=2",
+             "-S", "16384", "-i", "2"]
+        )
+        assert rc == 0
+        parts = capsys.readouterr().out.strip().split("\t")
+        assert int(parts[2]) == 4 * int(parts[3])  # k full chunks read
+
+
+class TestBaselineSweep:
+    def test_baseline_mode_emits_all_configs(self, capsys):
+        from ceph_tpu.tools import bench_sweep
+
+        rc = bench_sweep.main(["--baseline", "--iterations", "1"])
+        assert rc == 0
+        import json
+
+        lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        names = {r["config"] for r in lines}
+        assert len(names) == len(bench_sweep.BASELINE_CONFIGS)
+        by_name = {r["config"]: r for r in lines}
+        clay = by_name["clay_8_4_d11_subchunk_repair"]
+        assert "error" not in clay, clay
+        assert clay["read_amplification"] == 2.75  # d/(d-k+1) = 11/4
+        for r in lines:
+            assert "error" not in r, r
