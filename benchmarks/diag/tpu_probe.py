@@ -1,0 +1,23 @@
+"""Long-deadline axon backend-init probe: does jax.devices() EVER return?
+Logs progress with timestamps; dumps all-thread stacks every 120s."""
+import faulthandler, sys, time, threading
+
+LOG = "/root/repo/benchmarks/diag/tpu_probe.log"
+f = open(LOG, "a", buffering=1)
+def log(m): f.write(f"{time.strftime('%H:%M:%S')} +{time.time()-T0:8.1f}s {m}\n")
+T0 = time.time()
+log("=== probe start ===")
+faulthandler.dump_traceback_later(120, repeat=True, file=f)
+import jax
+log(f"jax {jax.__version__} imported")
+try:
+    devs = jax.devices()
+    log(f"SUCCESS devices={devs}")
+    import numpy as np
+    x = jax.numpy.ones((256, 256), dtype=jax.numpy.bfloat16)
+    t1 = time.time()
+    y = (x @ x).block_until_ready()
+    log(f"matmul ok in {time.time()-t1:.1f}s result_sum={float(y.sum()):.1f} platform={devs[0].platform}")
+except Exception as e:
+    log(f"FAILED {type(e).__name__}: {e}")
+log("=== probe end ===")

@@ -169,6 +169,23 @@ class ErasureCodeJerasureBitmatrix(ErasureCode):
                 if self.w != 8:
                     raise ValueError(f"liber8tion requires w=8, got w={self.w}")
                 self._bitmatrix = liber8tion_bitmatrix(self.k)
+                # The published minimum-density liber8tion matrices live in
+                # the jerasure submodule, which the reference checkout does
+                # not vendor; this plugin fills the same (k, m=2, w=8)
+                # envelope with a re-derived MDS bit-matrix.  Same fault
+                # tolerance, different parity bytes — so chunks written by
+                # upstream jerasure under this profile name are NOT
+                # byte-interchangeable.  Say so where profile users see it.
+                from ..common.log import dout
+
+                dout(
+                    "codec",
+                    1,
+                    "jerasure technique=liber8tion uses a re-derived MDS "
+                    "bit-matrix (published minimum-density matrices not "
+                    "vendored); parity bytes are not interchangeable with "
+                    "upstream jerasure liber8tion chunks",
+                )
         except ValueError as e:
             raise EcError(EINVAL, str(e))
 

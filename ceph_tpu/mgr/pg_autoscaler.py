@@ -128,6 +128,11 @@ class PgAutoscalerModule(MgrModule):
         if pool is None:
             return False
         pid = str(pool.id)
+        if not any(i.up and i.in_ for i in osdmap.osds.values()):
+            # No up+in OSD is reporting at all — nothing can vouch that the
+            # pool is empty, so treat it as unverifiable rather than letting
+            # the loop below pass vacuously.
+            return False
         for osd_id, info in osdmap.osds.items():
             if not (info.up and info.in_):
                 # A down/out OSD may still hold this pool's only copies of

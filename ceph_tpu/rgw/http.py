@@ -29,11 +29,17 @@ def sign_v2(
     date: str,
     content_md5: str = "",
     content_type: str = "",
+    amz_date: str = "",
 ) -> str:
     """AWS signature v2 string-to-sign, as rgw_auth_s3 canonicalizes it:
-    Method, Content-MD5, Content-Type, Date, CanonicalizedResource.
-    Covering Content-MD5 binds the signature to the request body."""
-    string_to_sign = f"{method}\n{content_md5}\n{content_type}\n{date}\n{path}"
+    Method, Content-MD5, Content-Type, Date, CanonicalizedAmzHeaders,
+    CanonicalizedResource.  Covering Content-MD5 binds the signature to
+    the request body.  When the client authenticates with x-amz-date
+    instead of Date, v2 uses an empty Date line and the x-amz-date value
+    rides in the canonicalized amz headers — so the freshness timestamp
+    is still signature-covered either way."""
+    amz = f"x-amz-date:{amz_date}\n" if amz_date else ""
+    string_to_sign = f"{method}\n{content_md5}\n{content_type}\n{date}\n{amz}{path}"
     mac = hmac.new(secret_key.encode(), string_to_sign.encode(), hashlib.sha1)
     return base64.b64encode(mac.digest()).decode()
 
@@ -109,7 +115,15 @@ class S3Server:
         except ValueError:
             return False
         date = headers.get("date", "")
-        if not self._date_fresh(date):
+        amz_date = headers.get("x-amz-date", "")
+        if amz_date:
+            # v2: x-amz-date overrides Date; the Date line in the
+            # string-to-sign becomes empty and freshness is checked on
+            # the amz header instead (rgw accepts either).
+            date = ""
+            if not self._date_fresh(amz_date):
+                return False
+        elif not self._date_fresh(date):
             return False
         # The signature covers Content-MD5; when the client sends it, the
         # body must actually hash to it, or an attacker could replay a
@@ -132,6 +146,7 @@ class S3Server:
             date,
             content_md5=content_md5,
             content_type=headers.get("content-type", ""),
+            amz_date=amz_date,
         )
         return hmac.compare_digest(signature, expect)
 

@@ -77,11 +77,10 @@ def run_bulk(ec, size: int, batch: int, iters: int) -> tuple[float, int]:
     out = ec.encode_array(data)  # warm/compile
     out.block_until_ready()
     t0 = time.perf_counter()
-    for i in range(iters):
-        # device-side perturbation: defeats identical-launch caching without
-        # re-uploading the batch from host each iteration (the measurement
-        # must cover the encode, not host->HBM transfer)
-        data = data.at[0, 0, 0].set(data[0, 0, 0] ^ np.uint8(i + 1))
+    for _ in range(iters):
+        # JAX dispatches every call — there is no result memoization for
+        # identical launches — so re-encoding the same resident batch is a
+        # clean steady-state measurement with no per-iteration device copy.
         out = ec.encode_array(data)
     jax.block_until_ready(out)
     return time.perf_counter() - t0, batch * k * chunk * iters

@@ -79,6 +79,22 @@ class TestS3AuthV2:
         headers = _signed_headers("GET", "/b/k", secret="other")
         assert not _auth(server, "GET", "/b/k", headers)
 
+    def test_amz_date_without_date_accepted(self):
+        # Clients that send x-amz-date instead of Date sign with an empty
+        # Date line and the timestamp in the canonicalized amz headers.
+        server = S3Server(_FakeGateway(), require_auth=True)
+        now = formatdate(usegmt=True)
+        sig = sign_v2("secret", "GET", "/b/k", "", amz_date=now)
+        headers = {"authorization": f"AWS AK:{sig}", "x-amz-date": now}
+        assert _auth(server, "GET", "/b/k", headers)
+
+    def test_stale_amz_date_rejected(self):
+        server = S3Server(_FakeGateway(), require_auth=True)
+        stale = "Tue, 27 Mar 2007 19:36:42 GMT"
+        sig = sign_v2("secret", "GET", "/b/k", "", amz_date=stale)
+        headers = {"authorization": f"AWS AK:{sig}", "x-amz-date": stale}
+        assert not _auth(server, "GET", "/b/k", headers)
+
 
 def _entry(oid, epoch, version, prior=None, reqid=("", 0)):
     return LogEntry(
@@ -259,3 +275,41 @@ class TestMgrBeaconRebaseline:
         )
         mm.tick()
         assert mon.proposals, "expected a failover proposal after grace expiry"
+
+
+class TestAutoscalerEmptyVerification:
+    """_pool_verified_empty must not pass vacuously when no OSD reports.
+
+    Round-2 advisor: with osdmap.osds empty (or every OSD down/out) the
+    per-OSD loop never ran, so an unverifiable pool was treated as
+    verified-empty and pg_num was force-applied.
+    """
+
+    @staticmethod
+    def _module(osds):
+        from types import SimpleNamespace
+
+        from ceph_tpu.mgr.pg_autoscaler import PgAutoscalerModule
+
+        pool = SimpleNamespace(name="p", id=7)
+        auto = PgAutoscalerModule(mode="on")
+        auto.mgr = SimpleNamespace(
+            osdmap=SimpleNamespace(pools={7: pool}, osds=osds),
+            get_daemon_status=lambda name: {"pool_objects": {"7": 0}},
+        )
+        return auto
+
+    def test_no_osds_is_unverifiable(self):
+        assert not self._module({})._pool_verified_empty("p")
+
+    def test_all_down_osds_is_unverifiable(self):
+        from types import SimpleNamespace
+
+        osds = {0: SimpleNamespace(up=False, in_=False)}
+        assert not self._module(osds)._pool_verified_empty("p")
+
+    def test_reporting_empty_pool_is_verified(self):
+        from types import SimpleNamespace
+
+        osds = {0: SimpleNamespace(up=True, in_=True)}
+        assert self._module(osds)._pool_verified_empty("p")
