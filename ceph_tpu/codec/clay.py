@@ -31,7 +31,6 @@ from typing import Mapping
 import numpy as np
 
 from ceph_tpu.gf import GF_MUL_TABLE, gf_inv, gf_invert_matrix
-from ceph_tpu.ops.xor_mm import xor_matmul
 
 from .base import EINVAL, EIO, ErasureCode
 from .interface import EcError, Profile
@@ -271,7 +270,7 @@ class ErasureCodeClay(ErasureCode):
         U = np.zeros_like(C)
         erased_sorted = sorted(erased)
         dist = self._inner.distribution_matrix()
-        bm, decode_index = PLAN_CACHE.decode_plan(
+        coder, decode_index = PLAN_CACHE.decode_coder(
             dist, erased_sorted, self.k + self.nu
         )
         alive = [i for i in range(qt) if i not in erased]
@@ -286,7 +285,7 @@ class ErasureCodeClay(ErasureCode):
             #    over (|planes|, k+nu, sc)
             survivors = U[decode_index][:, planes]  # (k+nu, P, sc)
             rec = np.asarray(
-                xor_matmul(bm, np.ascontiguousarray(survivors.transpose(1, 0, 2)))
+                coder(np.ascontiguousarray(survivors.transpose(1, 0, 2)))
             )  # (P, nerr, sc)
             for p, e in enumerate(erased_sorted):
                 U[e, planes] = rec[:, p]
@@ -468,7 +467,7 @@ class ErasureCodeClay(ErasureCode):
         U = np.zeros_like(C)
         erased_sorted = sorted(erased)
         dist = self._inner.distribution_matrix()
-        bm, decode_index = PLAN_CACHE.decode_plan(
+        coder, decode_index = PLAN_CACHE.decode_coder(
             dist, erased_sorted, self.k + self.nu
         )
         out = np.zeros((self.sub_chunk_no, sc), dtype=np.uint8)
@@ -536,7 +535,7 @@ class ErasureCodeClay(ErasureCode):
             # 2. batched inner MDS decode for erased U's.
             survivors = U[decode_index][:, planes]
             rec = np.asarray(
-                xor_matmul(bm, np.ascontiguousarray(survivors.transpose(1, 0, 2)))
+                coder(np.ascontiguousarray(survivors.transpose(1, 0, 2)))
             )
             for p, e in enumerate(erased_sorted):
                 U[e, planes] = rec[:, p]

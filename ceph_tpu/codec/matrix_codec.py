@@ -22,12 +22,51 @@ import jax.numpy as jnp
 import numpy as np
 
 from ceph_tpu.gf import expand_matrix, isa_decode_matrix
+from ceph_tpu.ops.pallas_gf import CodingPlan
 from ceph_tpu.ops.xor_mm import xor_matmul, xor_reduce
 
 from .base import EIO
 from .interface import EcError
 
 DECODE_LRU_CAPACITY = 2516
+
+_PLATFORM: str | None = None
+
+
+def _on_tpu() -> bool:
+    """True when the default jax backend is a TPU (cached; backend init is
+    expensive and the answer cannot change within a process)."""
+    global _PLATFORM
+    if _PLATFORM is None:
+        try:
+            import jax
+
+            _PLATFORM = jax.devices()[0].platform
+        except Exception:
+            _PLATFORM = "cpu"
+    return _PLATFORM == "tpu"
+
+
+class _DeviceCoder:
+    """One cached coding operator: the fused Pallas kernel on TPU for
+    lane-aligned chunks, the jnp bitsliced matmul everywhere else.
+
+    This is the dispatch the reference does by linking `ec_encode_data` to
+    the best SIMD flavor at plugin load (isa/ErasureCodeIsa.cc:83-91): the
+    production `encode_chunks`/`decode_chunks` path and the bulk device path
+    both land on the fast kernel — the benchmark measures what ships.
+    """
+
+    __slots__ = ("bm", "plan")
+
+    def __init__(self, bm: jnp.ndarray, plan: CodingPlan | None):
+        self.bm = bm
+        self.plan = plan
+
+    def __call__(self, data: jnp.ndarray) -> jnp.ndarray:
+        if self.plan is not None and data.shape[-1] % 128 == 0:
+            return self.plan(data)
+        return xor_matmul(self.bm, data)
 
 
 class _GlobalPlanCache:
@@ -36,9 +75,21 @@ class _GlobalPlanCache:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._encode: dict[bytes, jnp.ndarray] = {}
+        self._encode_coders: dict[bytes, _DeviceCoder] = {}
         self._decode: OrderedDict[tuple[bytes, str], tuple[jnp.ndarray, list[int]]] = (
             OrderedDict()
         )
+        self._decode_coders: OrderedDict[tuple, _DeviceCoder] = OrderedDict()
+
+    def _make_coder(self, gf_rows: np.ndarray, bm: jnp.ndarray) -> _DeviceCoder:
+        plan = CodingPlan(gf_rows) if _on_tpu() else None
+        return _DeviceCoder(bm, plan)
+
+    def _lru_put_coder(self, key, coder: _DeviceCoder) -> None:
+        self._decode_coders[key] = coder
+        self._decode_coders.move_to_end(key)
+        while len(self._decode_coders) > DECODE_LRU_CAPACITY:
+            self._decode_coders.popitem(last=False)
 
     def encode_bit_matrix(self, coding_rows: np.ndarray) -> jnp.ndarray:
         """Per-geometry encode matrices: one entry per codec instance's
@@ -52,6 +103,31 @@ class _GlobalPlanCache:
         with self._lock:
             self._encode.setdefault(key, bm)
             return self._encode[key]
+
+    def encode_coder(self, coding_rows: np.ndarray) -> _DeviceCoder:
+        """Cached coding operator for an encode matrix (TPU plan + jnp bm)."""
+        key = (coding_rows.shape, coding_rows.tobytes())
+        with self._lock:
+            coder = self._encode_coders.get(key)
+        if coder is not None:
+            return coder
+        coder = self._make_coder(coding_rows, self.encode_bit_matrix(coding_rows))
+        with self._lock:
+            return self._encode_coders.setdefault(key, coder)
+
+    def lru_coder(self, matrix: np.ndarray) -> _DeviceCoder:
+        """Coding operator for a decode-time matrix, bounded by the decode
+        LRU (SHEC's searched inverses and other raw-matrix decode paths)."""
+        key = (matrix.shape, matrix.tobytes(), "#raw")
+        with self._lock:
+            coder = self._decode_coders.get(key)
+            if coder is not None:
+                self._decode_coders.move_to_end(key)
+                return coder
+        coder = self._make_coder(matrix, self.lru_bit_matrix(matrix))
+        with self._lock:
+            self._lru_put_coder(key, coder)
+        return coder
 
     def lru_bit_matrix(self, matrix: np.ndarray) -> jnp.ndarray:
         """Bit-matrix for a decode-time matrix, bounded by the decode LRU.
@@ -116,22 +192,18 @@ class _GlobalPlanCache:
     def decode_plan(
         self, dist_matrix: np.ndarray, erasures: list[int], k: int
     ) -> tuple[jnp.ndarray, list[int]]:
-        km = dist_matrix.shape[0]
-        erased = set(erasures)
-        decode_index: list[int] = []
-        r = 0
-        for _ in range(k):
-            while r in erased:
-                r += 1
-            if r >= km:
-                raise EcError(EIO, f"not enough survivors for erasures {erasures}")
-            decode_index.append(r)
-            r += 1
-        # Reference signature format, ErasureCodeIsa.cc:233-248.
-        sig = "".join(f"+{r}" for r in decode_index) + "".join(
-            f"-{e}" for e in erasures
-        )
-        key = (dist_matrix.shape, dist_matrix.tobytes(), sig)
+        bitmat, decode_index, _ = self._decode_entry(dist_matrix, erasures, k)
+        return bitmat, decode_index
+
+    def _decode_entry(
+        self, dist_matrix: np.ndarray, erasures: list[int], k: int, key=None
+    ) -> tuple[jnp.ndarray, list[int], np.ndarray]:
+        """(bit-matrix, decode_index, GF decode matrix) for an erasure
+        pattern, LRU-cached.  The GF matrix rides along so a coder rebuild
+        after a coder-LRU eviction is a cheap re-arrangement, not a second
+        Gaussian inversion."""
+        if key is None:
+            key = self._decode_key(dist_matrix, erasures, k)
         with self._lock:
             cached = self._decode.get(key)
             if cached is not None:
@@ -143,11 +215,49 @@ class _GlobalPlanCache:
         c, decode_index = plan
         bitmat = jnp.asarray(expand_matrix(c), dtype=jnp.uint8)
         with self._lock:
-            self._decode[key] = (bitmat, decode_index)
+            self._decode[key] = (bitmat, decode_index, c)
             self._decode.move_to_end(key)
             while len(self._decode) > DECODE_LRU_CAPACITY:
                 self._decode.popitem(last=False)
-        return bitmat, decode_index
+        return bitmat, decode_index, c
+
+    def _decode_key(
+        self, dist_matrix: np.ndarray, erasures: list[int], k: int
+    ) -> tuple:
+        """Reference signature format, ErasureCodeIsa.cc:233-248 (the
+        survivor part uses the first-k-non-erased rows, matching decode_plan's
+        key derivation even when isa_decode_matrix picks different rows)."""
+        km = dist_matrix.shape[0]
+        erased = set(erasures)
+        survivors: list[int] = []
+        r = 0
+        for _ in range(k):
+            while r in erased:
+                r += 1
+            if r >= km:
+                raise EcError(EIO, f"not enough survivors for erasures {erasures}")
+            survivors.append(r)
+            r += 1
+        sig = "".join(f"+{r}" for r in survivors) + "".join(
+            f"-{e}" for e in erasures
+        )
+        return (dist_matrix.shape, dist_matrix.tobytes(), sig)
+
+    def decode_coder(
+        self, dist_matrix: np.ndarray, erasures: list[int], k: int
+    ) -> tuple[_DeviceCoder, list[int]]:
+        """Cached coding operator + survivor index for an erasure pattern."""
+        key = self._decode_key(dist_matrix, erasures, k)
+        bitmat, decode_index, c = self._decode_entry(dist_matrix, erasures, k, key)
+        with self._lock:
+            coder = self._decode_coders.get(key)
+            if coder is not None:
+                self._decode_coders.move_to_end(key)
+                return coder, decode_index
+        coder = self._make_coder(c, bitmat)  # built outside the lock
+        with self._lock:
+            self._lru_put_coder(key, coder)
+        return coder, decode_index
 
 
 PLAN_CACHE = _GlobalPlanCache()
@@ -190,17 +300,20 @@ class MatrixCodecMixin:
     # -- device-native bulk paths ------------------------------------------
 
     def encode_array(self, data) -> jnp.ndarray:
-        """(..., k, L) uint8 -> (..., m, L) parity, stays on device."""
+        """(..., k, L) uint8 -> (..., m, L) parity, stays on device.
+
+        Dispatches through the cached _DeviceCoder, so on a TPU backend this
+        IS the fused Pallas kernel — the production analog of the reference
+        plugin's `ec_encode_data` hot call (isa/ErasureCodeIsa.cc:83-91)."""
         mat = self.distribution_matrix()
         if self.m == 1 and self._xor_row_available():
             return xor_reduce(jnp.asarray(data))[..., None, :]
-        bm = PLAN_CACHE.encode_bit_matrix(mat[self.k :])
-        return xor_matmul(bm, jnp.asarray(data))
+        return PLAN_CACHE.encode_coder(mat[self.k :])(jnp.asarray(data))
 
     def decode_array(self, erasures: list[int], survivors) -> jnp.ndarray:
         """survivors (..., k, L) in decode_index order -> (..., nerrs, L)."""
-        bm, _ = PLAN_CACHE.decode_plan(self.distribution_matrix(), erasures, self.k)
-        return xor_matmul(bm, jnp.asarray(survivors))
+        coder, _ = PLAN_CACHE.decode_coder(self.distribution_matrix(), erasures, self.k)
+        return coder(jnp.asarray(survivors))
 
     def decode_index(self, erasures: list[int]) -> list[int]:
         _, idx = PLAN_CACHE.decode_plan(self.distribution_matrix(), erasures, self.k)

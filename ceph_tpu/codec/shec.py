@@ -29,7 +29,6 @@ from typing import Mapping
 import numpy as np
 
 from ceph_tpu.gf import gf_invert_matrix, gf_matmul, jerasure_vandermonde_matrix
-from ceph_tpu.ops.xor_mm import xor_matmul
 
 from .base import EINVAL, EIO, ErasureCode
 from .interface import EcError, Profile
@@ -281,8 +280,7 @@ class ErasureCodeShec(MatrixCodecMixin, ErasureCode):
             # inverse is an operand, so any erasure pattern shares the
             # compiled kernel (matrix-as-data design).  Decode-time matrices
             # go through the bounded LRU, not the per-geometry encode cache.
-            bm = PLAN_CACHE.lru_bit_matrix(inv)
-            solved = np.asarray(xor_matmul(bm, sources))
+            solved = np.asarray(PLAN_CACHE.lru_coder(inv)(sources))
             for i, col in enumerate(cols):
                 if not avails[col]:
                     np.copyto(decoded[col], solved[i])

@@ -5,27 +5,34 @@ jnp reference (ceph_tpu.ops.xor_mm) materializes the 8x bit-plane expansion
 and the int32 parity accumulators in HBM, capping throughput at ~1/10 of HBM
 bandwidth.  This kernel keeps the whole pipeline in VMEM per tile:
 
-    HBM -> VMEM:  (k, T) uint8 chunk tile           (the only data read)
-    VPU:          8 bit-planes per chunk, f32       (shifts/masks, unrolled)
-    MXU:          (8*MP, 8k) @ (8k, T) f32 matmul
+    HBM -> VMEM:  (k, T) uint8 chunk tile            (the only data read)
+    VPU:          8 bit-planes per chunk              (shifts/masks, unrolled)
+    MXU:          (8m, 8k) @ (8k, T) bf16 matmul, f32 accumulation
     VPU:          mod-2 + fold bits -> (m, T)
-    VMEM -> HBM:  (m, T) uint8 parity tile          (the only data write)
+    VMEM -> HBM:  (m, T) uint8 parity tile            (the only data write)
 
 so HBM traffic is the information-theoretic minimum: k bytes in, m bytes out
 per stripe byte.
 
-Layout choices are driven by Mosaic's tiling:
-- planes are f32 (native (8, 128) tiles) and stacked *b-major* — piece b is
-  ((data >> b) & 1) with k rows, so for k = 8 every concat piece is exactly
-  one sublane tile: no relayouts.
-- output rows are padded to MP = 8 per bit-block: the coding matrix is
-  arranged on host as B'[r*MP + i, b*k + j] = bit r of (C[i,j] * 2^b), so the
-  fold reads tile-aligned (MP, T) slices per output bit r.
-- f32 accumulation is exact: operands are 0/1, sums bounded by 8k << 2^24.
+Layout choices are driven by Mosaic's tiling and the MXU's native modes:
+- planes are computed as int32 (native (8, 128) tiles) and stacked *b-major*:
+  piece b is ((data >> b) & 1) with k rows, so the 8 concat pieces are
+  sublane-tile multiples for k % 8 == 0 — no relayouts; the single cast of
+  the full (8k, T) block to the compute dtype is one aligned relayout.
+- the coding matrix is DENSE: exactly 8m rows (byte-major, row i*8 + r holds
+  bit r of output byte i) by 8k columns (b-major to match the planes).  8m is
+  always a sublane-tile multiple, so the mod-2 fold is a tile-aligned
+  (m, 8, T) reshape + weighted sublane reduction — no padded output rows.
+  (Earlier revisions padded every output bit-block to 8 rows, computing
+  8*8=64 matmul rows for RS(8,3)'s 24: 2.7x wasted MXU work.)
+- the matmul runs in bf16 with f32 accumulation — the MXU's native full-rate
+  mode.  Operands are 0/1 and sums are bounded by 8k, so bf16/f32 is exact
+  for any k <= 2^20.  (f32 operands cost 3-6 MXU passes each; int8 is not
+  faster than bf16 for this shape on v5e and needs (32, 128) relayouts.)
 
-One compiled kernel per (rows, k, shape) serves every coding matrix — encode,
-any-erasure decode, LRC locality groups — because the bit-matrix is an
-operand, not a constant (the device analog of the reference's LRU
+One compiled kernel per (rows, k, dtype, shape) serves every coding matrix —
+encode, any-erasure decode, LRC locality groups — because the bit-matrix is
+an operand, not a constant (the device analog of the reference's LRU
 decode-table cache, isa/ErasureCodeIsaTableCache.h:48).
 """
 
@@ -39,61 +46,53 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ceph_tpu.gf.bitslice import coeff_bitmatrix
-
-# Rows per bit-block in the arranged matrix (f32 sublane tile height).
-MP = 8
+from ceph_tpu.gf.bitslice import expand_matrix
 
 # Tile of the chunk-length (lane) axis each program processes.  VMEM per
-# program ~= T*(k + 4k + 32k + 32*MP + m) bytes; T=4096 with k=8 is ~1.3 MB.
+# program is dominated by the int32 planes block: T*(k + 4*8k + 2*8k + 4*8m
+# + m) bytes; T=4096 with k=8 is ~1.7 MB, comfortably inside VMEM with
+# double-buffered pipelining.
 DEFAULT_TILE = 4096
 
 
-def arrange_bit_matrix(gf_matrix: np.ndarray) -> np.ndarray:
-    """(m, k) GF matrix -> (8*MP, 8k) f32 0/1 matrix in MXU-friendly layout.
+def arrange_dense_matrix(gf_matrix: np.ndarray) -> np.ndarray:
+    """(m, k) GF matrix -> dense (8m, 8k) 0/1 matrix in kernel layout.
 
-    B'[r*MP + i, b*k + j] = bit r of (gf_matrix[i, j] * 2^b); rows i >= m are
-    zero padding.  Requires m <= MP (callers split larger codes into row
-    groups of MP).
+    Rows are byte-major (row i*8 + r = bit r of output byte i, the natural
+    `expand_matrix` order); columns are b-major (col b*k + j = plane b of
+    chunk j) to match the kernel's concat-based plane stacking.
     """
     gf_matrix = np.asarray(gf_matrix, dtype=np.uint8)
     m, k = gf_matrix.shape
-    assert m <= MP, f"m={m} > {MP}; split the matrix into row groups"
-    out = np.zeros((8 * MP, 8 * k), dtype=np.float32)
-    for i in range(m):
-        for j in range(k):
-            c = int(gf_matrix[i, j])
-            if c:
-                mc = coeff_bitmatrix(c)  # mc[r, b] = bit r of c*2^b
-                for r in range(8):
-                    for b in range(8):
-                        out[r * MP + i, b * k + j] = mc[r, b]
-    return out
+    plain = expand_matrix(gf_matrix)  # rows 8i+r, cols 8j+b
+    perm = np.array([j * 8 + b for b in range(8) for j in range(k)])
+    return plain[:, perm].astype(np.float32)
 
 
 def _coding_kernel(bm_ref, data_ref, out_ref, *, k: int, m: int):
     """One (stripe, lane-tile) program: parity tile from a chunk tile."""
     d32 = data_ref[0].astype(jnp.int32)  # (k, T)
-    # Bit-plane expansion, b-major stacking: (8k, T) f32, tile-aligned pieces.
-    planes = jnp.concatenate(
-        [((d32 >> b) & 1).astype(jnp.float32) for b in range(8)], axis=0
-    )
+    # Bit-plane expansion, b-major stacking: (8k, T) int32, aligned pieces.
+    planes = jnp.concatenate([(d32 >> b) & 1 for b in range(8)], axis=0)
+    cd = bm_ref.dtype
     acc = jax.lax.dot_general(
         bm_ref[:],
-        planes,
+        planes.astype(cd),
         dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.int32)  # (8*MP, T)
-    # Fold: out byte bit r lives in tile-aligned row block [r*MP, r*MP+MP).
-    folded = acc[0:MP] & 1
-    for r in range(1, 8):
-        folded |= (acc[r * MP : (r + 1) * MP] & 1) << r
-    out_ref[0] = folded[:m].astype(jnp.uint8)
+        preferred_element_type=jnp.int32 if cd == jnp.int8 else jnp.float32,
+    )  # (8m, T)
+    bits = acc.astype(jnp.int32) & 1
+    # Fold: output byte i is sum_r bits[i*8 + r] << r — a tile-aligned
+    # (m, 8, T) regroup + weighted reduction over the sublane axis.
+    t = bits.shape[-1]
+    grouped = bits.reshape(m, 8, t)
+    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32)).reshape(1, 8, 1)
+    out_ref[0] = (grouped * weights).sum(axis=1).astype(jnp.uint8)
 
 
 @functools.partial(jax.jit, static_argnames=("m", "tile", "interpret"))
 def _gf_code_stripes(
-    arranged_bm: jax.Array,
+    dense_bm: jax.Array,
     data: jax.Array,
     *,
     m: int,
@@ -101,7 +100,7 @@ def _gf_code_stripes(
     interpret: bool = False,
 ) -> jax.Array:
     s, k, L = data.shape
-    assert arranged_bm.shape == (8 * MP, 8 * k), (arranged_bm.shape, k)
+    assert dense_bm.shape == (8 * m, 8 * k), (dense_bm.shape, m, k)
     assert L % tile == 0, (L, tile)
     grid = (s, L // tile)
     return pl.pallas_call(
@@ -110,7 +109,7 @@ def _gf_code_stripes(
         interpret=interpret,
         in_specs=[
             pl.BlockSpec(
-                (8 * MP, 8 * k), lambda i, j: (0, 0), memory_space=pltpu.VMEM
+                (8 * m, 8 * k), lambda i, j: (0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec((1, k, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
         ],
@@ -118,7 +117,7 @@ def _gf_code_stripes(
             (1, m, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((s, m, L), jnp.uint8),
-    )(arranged_bm, data)
+    )(dense_bm, data)
 
 
 def pick_tile(L: int, cap: int = DEFAULT_TILE) -> int:
@@ -132,19 +131,24 @@ def pick_tile(L: int, cap: int = DEFAULT_TILE) -> int:
 class CodingPlan:
     """Host-built plan: GF matrix arranged for the kernel + dispatch info.
 
-    The device-side analog of ISA-L's `ec_init_tables` product: built once
+    The device-side analog of ISA-L's `ec_init_tables` product
+    (/root/reference/src/erasure-code/isa/ErasureCodeIsa.cc:83-91): built once
     per (matrix, geometry), then applied to any number of stripe batches.
-    Matrices with m > MP rows are split into row groups applied back-to-back.
     """
 
-    def __init__(self, gf_matrix: np.ndarray, *, interpret: bool = False):
+    def __init__(
+        self,
+        gf_matrix: np.ndarray,
+        *,
+        interpret: bool = False,
+        compute_dtype=jnp.bfloat16,
+        tile: int = DEFAULT_TILE,
+    ):
         gf_matrix = np.asarray(gf_matrix, dtype=np.uint8)
         self.m, self.k = gf_matrix.shape
         self.interpret = interpret
-        self.groups = [
-            jnp.asarray(arrange_bit_matrix(gf_matrix[i : i + MP]))
-            for i in range(0, self.m, MP)
-        ]
+        self.tile_cap = tile
+        self.bm = jnp.asarray(arrange_dense_matrix(gf_matrix), dtype=compute_dtype)
 
     def __call__(self, data: jax.Array) -> jax.Array:
         """(..., k, L) uint8 -> (..., m, L) uint8 coded output."""
@@ -152,29 +156,28 @@ class CodingPlan:
         assert k == self.k, (k, self.k)
         stripes = int(np.prod(lead)) if lead else 1
         flat = data.reshape(stripes, k, L)
-        tile = pick_tile(L)
-        outs = []
-        for g, bm in enumerate(self.groups):
-            rows = min(MP, self.m - g * MP)
-            outs.append(
-                _gf_code_stripes(
-                    bm, flat, m=rows, tile=tile, interpret=self.interpret
-                )
-            )
-        out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+        out = _gf_code_stripes(
+            self.bm,
+            flat,
+            m=self.m,
+            tile=pick_tile(L, self.tile_cap),
+            interpret=self.interpret,
+        )
         return out.reshape(*lead, self.m, L)
 
 
 def gf_code(bit_matrix_or_plan, data: jax.Array) -> jax.Array:
     """Shape-flexible coding entry.
 
-    Accepts a CodingPlan (preferred, TPU path) or a raw (8m, 8k) bit-matrix
-    (jnp fallback — also used off-TPU where Pallas TPU kernels can't run).
+    Accepts a CodingPlan (preferred, TPU path; also runs anywhere with
+    interpret=True) or a raw (8m, 8k) bit-matrix (jnp fallback — used
+    off-TPU where Pallas TPU kernels can't run).
     """
-    if isinstance(bit_matrix_or_plan, CodingPlan) and jax.devices()[0].platform == "tpu":
-        return bit_matrix_or_plan(data)
+    if isinstance(bit_matrix_or_plan, CodingPlan):
+        plan = bit_matrix_or_plan
+        if plan.interpret or jax.devices()[0].platform == "tpu":
+            return plan(data)
+        raise TypeError("CodingPlan requires a TPU backend; pass a bit-matrix")
     from .xor_mm import xor_matmul
 
-    if isinstance(bit_matrix_or_plan, CodingPlan):
-        raise TypeError("CodingPlan requires a TPU backend; pass a bit-matrix")
     return xor_matmul(bit_matrix_or_plan, data)

@@ -10,7 +10,7 @@ from ceph_tpu.gf import (
     isa_decode_matrix,
     isa_rs_vandermonde_matrix,
 )
-from ceph_tpu.ops.pallas_gf import MP, CodingPlan, arrange_bit_matrix, pick_tile
+from ceph_tpu.ops.pallas_gf import CodingPlan, arrange_dense_matrix, pick_tile
 from ceph_tpu.ops.xor_mm import xor_matmul, xor_reduce
 
 
@@ -81,28 +81,37 @@ class TestPallasInterpret:
         rebuilt = np.asarray(dec_plan(full[:, idx, :]))
         assert np.array_equal(rebuilt, full[:, erasures, :])
 
-    def test_multi_group_rows(self):
-        # m > MP forces row-group splitting.
+    def test_many_rows(self):
+        # m > 8 runs as one dense matmul (no row-group splitting needed).
         rng = np.random.default_rng(5)
         k, m = 4, 10
         mat = rng.integers(0, 256, (m, k)).astype(np.uint8)
         plan = CodingPlan(mat, interpret=True)
-        assert len(plan.groups) == 2
+        assert plan.bm.shape == (8 * m, 8 * k)
         data = rng.integers(0, 256, (1, k, 128)).astype(np.uint8)
         out = np.asarray(plan(data))
         assert np.array_equal(out[0], gf_matmul(mat, data[0]))
 
+    def test_odd_k(self):
+        # k not a multiple of 8: concat pieces are partial sublane tiles.
+        rng = np.random.default_rng(6)
+        k, m = 5, 3
+        mat = rng.integers(0, 256, (m, k)).astype(np.uint8)
+        plan = CodingPlan(mat, interpret=True)
+        data = rng.integers(0, 256, (2, k, 256)).astype(np.uint8)
+        out = np.asarray(plan(data))
+        for s in range(2):
+            assert np.array_equal(out[s], gf_matmul(mat, data[s]))
 
-def test_arrange_bit_matrix_layout():
+
+def test_arrange_dense_matrix_layout():
     mat = isa_cauchy_matrix(4, 2)[4:]
-    arranged = arrange_bit_matrix(mat)
+    arranged = arrange_dense_matrix(mat)
     plain = expand_matrix(mat)
     m, k = mat.shape
-    for r in range(8):
-        for i in range(m):
+    assert arranged.shape == (8 * m, 8 * k)  # dense: no padded rows
+    for i in range(m):
+        for r in range(8):
             for b in range(8):
                 for j in range(k):
-                    assert arranged[r * MP + i, b * k + j] == plain[8 * i + r, 8 * j + b]
-    # Padding rows are zero.
-    for r in range(8):
-        assert (arranged[r * MP + m : (r + 1) * MP] == 0).all()
+                    assert arranged[i * 8 + r, b * k + j] == plain[8 * i + r, 8 * j + b]

@@ -195,7 +195,7 @@ class TestClayMeshRepair:
         `stripe`, sub-chunk bytes over `lane` — the layout the bulk-rebuild
         path uses on a pod.  Bytes must match the originally encoded chunk
         (repair plan per ErasureCodeClay.cc:462-642)."""
-        from ceph_tpu.codec import clay as clay_mod
+        from ceph_tpu.codec import matrix_codec as mc_mod
         from ceph_tpu.codec.registry import instance
 
         mesh = make_mesh(8)
@@ -206,7 +206,14 @@ class TestClayMeshRepair:
             sharded = shard_batch(jnp.asarray(data, dtype=jnp.uint8), mesh)
             return sharded_decode(jnp.asarray(bm, dtype=jnp.uint8), sharded, mesh)
 
-        monkeypatch.setattr(clay_mod, "xor_matmul", mesh_xor_matmul)
+        # Reroute the coder's device launch itself (not just the jnp
+        # fallback): on a TPU backend cached coders would otherwise take the
+        # Pallas plan path and bypass an xor_matmul patch.
+        monkeypatch.setattr(
+            mc_mod._DeviceCoder,
+            "__call__",
+            lambda self, data: mesh_xor_matmul(self.bm, data),
+        )
 
         ec = instance().factory("clay", {"k": "4", "m": "2", "d": "5"})
         k, m = 4, 2
