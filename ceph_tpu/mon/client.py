@@ -14,7 +14,14 @@ import re
 from typing import Callable
 
 from ..common.log import dout
-from ..msg.messages import MMonCommand, MMonCommandAck, MMonSubscribe, MOSDMap
+from ..msg.messages import (
+    MConfig,
+    MLog,
+    MMonCommand,
+    MMonCommandAck,
+    MMonSubscribe,
+    MOSDMap,
+)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from .monmap import MonMap
 from ..common.errs import EAGAIN, ETIMEDOUT
@@ -29,6 +36,8 @@ class MonClient(Dispatcher):
         self._tid = 0
         self._acks: dict[int, asyncio.Future] = {}
         self.on_osdmap: Callable[[MOSDMap], None] | None = None
+        self.on_config: Callable[[MConfig], None] | None = None
+        self.on_log: Callable[[MLog], None] | None = None
         self._cur_rank = 0  # mon we're currently talking to
         self._subs: dict[str, int] = {}
 
@@ -43,6 +52,14 @@ class MonClient(Dispatcher):
         if isinstance(msg, MOSDMap):
             if self.on_osdmap is not None:
                 self.on_osdmap(msg)
+            return True
+        if isinstance(msg, MConfig):
+            if self.on_config is not None:
+                self.on_config(msg)
+            return True
+        if isinstance(msg, MLog):
+            if self.on_log is not None:
+                self.on_log(msg)
             return True
         return False
 
@@ -96,6 +113,20 @@ class MonClient(Dispatcher):
             await self.msgr.send_to(addr, MMonSubscribe(what=dict(self._subs)))
         except ConnectionError:
             dout("monc", 5, f"{self.name}: subscribe to {addr} failed")
+
+    # -- cluster log -----------------------------------------------------------
+
+    async def send_log(self, entries: list[dict]) -> None:
+        """Ship clog entries to the current mon (LogClient::_send_to_mon);
+        a peon forwards them to the leader.  Best-effort: a lost entry is
+        re-reported by the next scrub, so no retry queue."""
+        addr = self.monmap.addr_of_rank(self._cur_rank)
+        try:
+            await self.msgr.send_to(
+                addr, MLog(version=0, entries=json.dumps(entries).encode())
+            )
+        except ConnectionError:
+            dout("monc", 5, f"{self.name}: clog send to {addr} failed")
 
     async def resubscribe(self, rank: int | None = None) -> None:
         """Re-send subscriptions after a mon connection reset."""

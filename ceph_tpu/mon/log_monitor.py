@@ -1,0 +1,142 @@
+"""LogMonitor — the PaxosService owning the cluster log.
+
+Mirror of src/mon/LogMonitor.{h,cc}: daemons' `clog` sinks (LogClient in
+the reference; OSD.clog_error here) send MLog entries to the monitors;
+the leader batches them through Paxos so every quorum member holds the
+same bounded, versioned log; `log last [n]` reads the tail and "log"
+subscribers get committed entries pushed.  This is where the EC data
+path's integrity complaints land — the reference raises
+`clog->error() << "Bad hash for ..."` on chunk CRC mismatch
+(src/osd/ECBackend.cc:1080); here the scrubber's clog_error ends up in
+this service, queryable from any mon.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+
+from ..common.log import dout
+from ..msg.messages import MLog
+from .paxos_service import ProposalQueue
+
+KEEP = 500  # bounded committed tail (mon_log_max summarised)
+
+
+class LogMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.version = 0
+        self.entries: deque[dict] = deque(maxlen=KEEP)
+        self._incoming: list[dict] = []
+        self._props = ProposalQueue(mon, "logm")
+
+    def on_election_changed(self) -> None:
+        self._incoming.clear()
+        self._props.reset()
+
+    # -- daemon -> mon entries -------------------------------------------------
+
+    def prepare_log(self, msg: MLog) -> None:
+        """Leader-only (LogMonitor::prepare_log): queue incoming entries
+        for the next proposal."""
+        try:
+            entries = json.loads(msg.entries.decode())
+        except json.JSONDecodeError:
+            dout("mon", 5, "logm: dropping undecodable MLog")
+            return
+        for e in entries:
+            self._incoming.append(
+                {
+                    "prio": str(e.get("prio", "info")),
+                    "who": str(e.get("who", "?")),
+                    "stamp": float(e.get("stamp", time.time())),
+                    "msg": str(e.get("msg", "")),
+                }
+            )
+        self._props.queue(self._make_blob)
+
+    def log(self, prio: str, who: str, message: str) -> None:
+        """In-process clog entry from the mon itself (LogChannel::do_log).
+        On a peon this routes like a daemon entry — forwarded to the
+        leader — so it is never stranded in a local queue."""
+        entry = {"prio": prio, "who": who, "stamp": time.time(), "msg": message}
+        if self.mon.is_leader():
+            self._incoming.append(entry)
+            self._props.queue(self._make_blob)
+        elif self.mon.leader_rank is not None:
+            self.mon._send_mon(
+                self.mon.leader_rank,
+                MLog(version=0, entries=json.dumps([entry]).encode()),
+            )
+
+    # -- commands --------------------------------------------------------------
+
+    def command_handler(self, prefix: str):
+        if prefix != "log last":
+            return None
+        fn = self._cmd_log_last
+        fn.__func__.mutating = False
+        return fn
+
+    def _cmd_log_last(self, cmd, reply) -> None:
+        n = int(cmd.get("num", 20))
+        level = cmd.get("level")
+        tail = [
+            e
+            for e in self.entries
+            if level is None or e["prio"] == level
+        ]
+        # tail[-0:] would be the whole tail; n <= 0 means "no entries"
+        # (version probe).
+        tail = tail[-n:] if n > 0 else []
+        reply(
+            0,
+            "",
+            json.dumps({"version": self.version, "entries": tail}).encode(),
+        )
+
+    # -- paxos -----------------------------------------------------------------
+
+    def _make_blob(self) -> bytes | None:
+        """Drain everything accumulated since the last proposal; queued
+        kicks whose entries were already taken become no-ops."""
+        if not self._incoming:
+            return None
+        batch, self._incoming = self._incoming, []
+        return json.dumps({"version": self.version + 1, "append": batch}).encode()
+
+    def apply_commit(self, blob: bytes) -> None:
+        info = json.loads(blob.decode())
+        self.version = info["version"]
+        appended = info["append"]
+        self.entries.extend(appended)
+        for e in appended:
+            dout("mon", 10, f"clog {e['prio']} {e['who']}: {e['msg']}")
+        self.mon.publish_log(appended)
+
+    # -- subscriptions ---------------------------------------------------------
+
+    def check_sub(self, conn, subs: dict[str, int]) -> None:
+        """Initial push on subscribe: the committed tail."""
+        if self.version == 0 or subs.get("log", 0) > self.version:
+            return
+        subs["log"] = self.version + 1
+        self.mon.send_to_conn(
+            conn,
+            MLog(
+                version=self.version,
+                entries=json.dumps(list(self.entries)).encode(),
+            ),
+        )
+
+    def push_new(self, conn, subs: dict[str, int], appended: list[dict]) -> None:
+        """Incremental push of freshly committed entries."""
+        if subs.get("log", 0) > self.version:
+            return
+        subs["log"] = self.version + 1
+        self.mon.send_to_conn(
+            conn,
+            MLog(version=self.version, entries=json.dumps(appended).encode()),
+        )

@@ -16,6 +16,7 @@ import time
 
 from ..common.log import dout
 from ..msg.messages import MMgrBeacon, MMgrMap
+from .paxos_service import ProposalQueue
 
 BEACON_GRACE = 6.0  # mon_mgr_beacon_grace (scaled down)
 
@@ -42,15 +43,13 @@ class MgrMonitor:
         self.map = MgrMap()
         self._last_beacon: dict[str, float] = {}
         # One proposal in flight at a time, each mutation computed against
-        # the committed map at propose time (the OSDMonitor _queue /
-        # pending_inc pattern) — concurrent beacons must not race to the
-        # same epoch and drop each other's updates.
-        self._pending: list = []  # mutate(MgrMap) -> (name, addr, standbys)|None
-        self._proposing = False
+        # the committed map at propose time (PaxosService::propose_pending)
+        # — concurrent beacons must not race to the same epoch and drop
+        # each other's updates.
+        self._props = ProposalQueue(mon, "mgr")
 
     def on_election_changed(self) -> None:
-        self._proposing = False
-        self._pending.clear()
+        self._props.reset()
         # Re-baseline beacon timestamps: a newly elected leader has an empty
         # _last_beacon map, and tick() comparing against 0.0 would instantly
         # fail over a healthy active mgr.  Give every known daemon one full
@@ -112,35 +111,23 @@ class MgrMonitor:
     # -- paxos -----------------------------------------------------------------
 
     def _queue(self, mutate) -> None:
-        self._pending.append(mutate)
-        self._try_propose()
-
-    def _try_propose(self) -> None:
         import json
 
-        if self._proposing or not self._pending or not self.mon.is_leader():
-            return
-        mutate = self._pending.pop(0)
-        result = mutate(self.map)
-        if result is None:
-            self._try_propose()
-            return
-        active_name, active_addr, standbys = result
-        blob = json.dumps(
-            {
-                "epoch": self.map.epoch + 1,
-                "active_name": active_name,
-                "active_addr": active_addr,
-                "standbys": standbys,
-            }
-        ).encode()
-        self._proposing = True
+        def make_blob():
+            result = mutate(self.map)
+            if result is None:
+                return None
+            active_name, active_addr, standbys = result
+            return json.dumps(
+                {
+                    "epoch": self.map.epoch + 1,
+                    "active_name": active_name,
+                    "active_addr": active_addr,
+                    "standbys": standbys,
+                }
+            ).encode()
 
-        def on_done(_version: int) -> None:
-            self._proposing = False
-            self._try_propose()
-
-        self.mon.propose("mgr", blob, on_done)
+        self._props.queue(make_blob)
 
     def apply_commit(self, blob: bytes) -> None:
         import json

@@ -26,6 +26,7 @@ import json
 
 from ..common.log import dout
 from ..msg.messages import (
+    MLog,
     MMgrBeacon,
     MMonCommand,
     MMonCommandAck,
@@ -37,7 +38,10 @@ from ..msg.messages import (
     MOSDMap,
 )
 from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
+from .auth_monitor import AuthMonitor
+from .config_monitor import ConfigMonitor
 from .elector import Elector
+from .log_monitor import LogMonitor
 from .monmap import MonMap
 from .mgr_monitor import MgrMonitor
 from .osd_monitor import OSDMonitor
@@ -65,6 +69,9 @@ class Monitor(Dispatcher):
         self.leader_rank: int | None = None
         self.osdmon = OSDMonitor(self)
         self.mgrmon = MgrMonitor(self)
+        self.configmon = ConfigMonitor(self)
+        self.logmon = LogMonitor(self)
+        self.authmon = AuthMonitor(self)
         # conn -> {what -> next epoch}
         self.subs: dict[Connection, dict[str, int]] = {}
         self._started = asyncio.Event()
@@ -132,14 +139,16 @@ class Monitor(Dispatcher):
         self.leader_rank = self.rank
         self.paxos.leader_init(quorum)
         self.osdmon.on_active()
-        self.mgrmon.on_election_changed()
+        for svc in (self.mgrmon, self.configmon, self.logmon, self.authmon):
+            svc.on_election_changed()
 
     def _lose_election(self, epoch: int, leader: int) -> None:
         self.quorum = []
         self.leader_rank = leader
         self.paxos.peon_init(leader)
         self.osdmon.on_election_lost()
-        self.mgrmon.on_election_changed()
+        for svc in (self.mgrmon, self.configmon, self.logmon, self.authmon):
+            svc.on_election_changed()
 
     # -- commit application ----------------------------------------------------
 
@@ -151,6 +160,12 @@ class Monitor(Dispatcher):
             self.osdmon.apply_commit(blob)
         elif service == b"mgr":
             self.mgrmon.apply_commit(blob)
+        elif service == b"config":
+            self.configmon.apply_commit(blob)
+        elif service == b"logm":
+            self.logmon.apply_commit(blob)
+        elif service == b"auth":
+            self.authmon.apply_commit(blob)
 
     def propose(self, service: str, blob: bytes, on_done=None) -> None:
         self.paxos.propose(service.encode() + b"\x00" + blob, on_done)
@@ -175,6 +190,13 @@ class Monitor(Dispatcher):
         elif isinstance(msg, MMgrBeacon):
             if self.is_leader():
                 self.mgrmon.prepare_beacon(msg)
+        elif isinstance(msg, MLog):
+            # Daemon clog entries: the leader proposes them; a peon forwards
+            # to the leader (Monitor::forward_request_leader).
+            if self.is_leader():
+                self.logmon.prepare_log(msg)
+            elif self.leader_rank is not None:
+                self._send_mon(self.leader_rank, msg)
         else:
             return False
         return True
@@ -196,6 +218,10 @@ class Monitor(Dispatcher):
                 self.osdmon.check_sub(conn, subs)
             elif what == "mgrmap":
                 self.mgrmon.check_sub(conn, subs)
+            elif what == "config":
+                self.configmon.check_sub(conn, subs)
+            elif what == "log":
+                self.logmon.check_sub(conn, subs)
 
     def publish_osdmap(self) -> None:
         """Push new epochs to every osdmap subscriber (on commit)."""
@@ -207,6 +233,16 @@ class Monitor(Dispatcher):
         for conn, subs in list(self.subs.items()):
             if "mgrmap" in subs:
                 self.mgrmon.check_sub(conn, subs)
+
+    def publish_config(self) -> None:
+        for conn, subs in list(self.subs.items()):
+            if "config" in subs:
+                self.configmon.check_sub(conn, subs)
+
+    def publish_log(self, appended: list[dict]) -> None:
+        for conn, subs in list(self.subs.items()):
+            if "log" in subs:
+                self.logmon.push_new(conn, subs, appended)
 
     def send_to_conn(self, conn: Connection, msg) -> None:
         async def _send():
@@ -228,9 +264,12 @@ class Monitor(Dispatcher):
             )
             return
         prefix = cmd.get("prefix", "")
-        handler = self.osdmon.command_handler(prefix) or self._mon_command_handler(
-            prefix
-        )
+        handler = None
+        for svc in (self.osdmon, self.configmon, self.logmon, self.authmon):
+            handler = svc.command_handler(prefix)
+            if handler is not None:
+                break
+        handler = handler or self._mon_command_handler(prefix)
         if handler is None:
             self.send_to_conn(
                 conn,

@@ -478,3 +478,27 @@ class MMgrReport(Message):
     consumed by DaemonServer).  perf/status are JSON blobs."""
 
     FIELDS = [("daemon", "str"), ("perf", "bytes"), ("status", "bytes")]
+
+
+# --- config / log / auth services --------------------------------------------
+
+
+@message_type(32)
+class MConfig(Message):
+    """Mon -> subscriber: centrally-managed config relevant to that entity
+    (src/messages/MConfig.h; built by ConfigMonitor::check_sub from the
+    global < type-section < entity layering).  `changes` is a JSON object
+    {option: raw value}."""
+
+    FIELDS = [("version", "u32"), ("changes", "bytes")]
+
+
+@message_type(33)
+class MLog(Message):
+    """Cluster-log entries (src/messages/MLog.h), both directions: daemons
+    send new entries to the mons (LogClient -> LogMonitor), the mons push
+    committed entries to "log" subscribers.  `entries` is a JSON list of
+    {"prio", "who", "stamp", "msg"}; `version` is the committed log version
+    (0 on the daemon->mon leg)."""
+
+    FIELDS = [("version", "u64"), ("entries", "bytes")]

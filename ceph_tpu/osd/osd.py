@@ -111,6 +111,7 @@ class OSD(Dispatcher):
             b.add_u64_counter(c)
         self.perf = b.create_perf_counters()
         self.clog: list[str] = []
+        self._pushed_config: set[str] = set()  # mon-managed option names
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
@@ -142,10 +143,12 @@ class OSD(Dispatcher):
         await self.msgr.bind(self._bind_addr)
         self.msgr.add_dispatcher_head(self)
         self.monc.on_osdmap = self._on_osdmap_msg
+        self.monc.on_config = self._on_config_msg
         self._running = True
         self.monc.msgr.add_dispatcher_tail(self)  # mgrmap rides the mon conn
         await self.monc.subscribe("osdmap")
         await self.monc.subscribe("mgrmap")
+        await self.monc.subscribe("config")
         await self._send_boot()
         self._tasks.append(asyncio.create_task(self._op_worker()))
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
@@ -472,10 +475,52 @@ class OSD(Dispatcher):
 
     # -- misc ------------------------------------------------------------------
 
+    def _on_config_msg(self, msg) -> None:
+        """Apply centrally-pushed config (MConfig from the ConfigMonitor) to
+        the runtime Config, hitting the same observer path a local `set`
+        takes — so e.g. QoS/debug knobs change live (md_config_t::
+        apply_changes; ConfigMonitor push in the reference).  Options that
+        were mon-managed in a previous push but absent now (`config rm`)
+        revert to their defaults; unchanged values are skipped so
+        observers fire only on real changes."""
+        import json as _json
+
+        changes = _json.loads(msg.changes.decode())
+        dropped = set(self._pushed_config) - set(changes)
+        for name in dropped:
+            try:
+                default = self.conf.get_option(name).default
+                if self.conf.get(name) != default:
+                    self.conf.set(name, default)
+                    dout("osd", 10, f"osd.{self.whoami} config revert: {name}")
+            except KeyError:
+                pass
+        self._pushed_config = set(changes)
+        for name, value in changes.items():
+            try:
+                if self.conf.get(name) == self.conf.get_option(name).parse(value):
+                    continue
+                self.conf.set(name, value)
+                dout("osd", 10, f"osd.{self.whoami} config push: {name}={value}")
+            except (KeyError, ValueError) as e:
+                dout("osd", 5, f"osd.{self.whoami} config push skipped {name}: {e}")
+
     def clog_error(self, msg: str) -> None:
-        """Cluster-log error (clog → mon LogMonitor in the reference)."""
+        """Cluster-log error: recorded locally and shipped to the mons'
+        LogMonitor (clog → LogClient → LogMonitor; the EC CRC-mismatch
+        sink, src/osd/ECBackend.cc:1080)."""
         self.clog.append(msg)
         dout("osd", 0, f"osd.{self.whoami} clog: {msg}")
+        if self._running:
+            import time as _time
+
+            entry = {
+                "prio": "error",
+                "who": f"osd.{self.whoami}",
+                "stamp": _time.time(),
+                "msg": msg,
+            }
+            asyncio.get_event_loop().create_task(self.monc.send_log([entry]))
 
     def num_pgs(self) -> int:
         return len(self.pgs)

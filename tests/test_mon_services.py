@@ -1,0 +1,271 @@
+"""ConfigMonitor / LogMonitor / AuthMonitor paxos-service tests.
+
+Models the reference's mon service coverage (src/test/mon/,
+qa/workunits/mon): propose → commit → every quorum member converges;
+subscribers receive pushes; daemons consume them at runtime.
+"""
+
+import asyncio
+import base64
+import json
+
+from ceph_tpu.client import Rados
+from ceph_tpu.mon import MonMap, Monitor
+
+from test_cluster import fast_conf, start_cluster, stop_cluster, wait_until
+from test_mon import free_port_addrs
+
+
+async def start_mons(n: int):
+    monmap = MonMap(addrs=free_port_addrs(n))
+    mons = [Monitor(name, monmap, election_timeout=0.3) for name in monmap.addrs]
+    for m in mons:
+        await m.start()
+    for m in mons:
+        await m.wait_for_quorum()
+    return monmap, mons
+
+
+class TestConfigMonitor:
+    def test_set_get_dump_rm_quorum_converges(self):
+        async def run():
+            monmap, mons = await start_mons(3)
+            client = Rados(monmap)
+            await client.connect()
+
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "config set", "who": "osd", "name": "osd_max_backfills", "value": "7"}
+            )
+            assert rv == 0, rs
+            rv, _, out = await client.mon_command(
+                {"prefix": "config get", "who": "osd.1"}
+            )
+            assert rv == 0
+            assert json.loads(out)["osd_max_backfills"] == "7"
+
+            # Named-daemon layer wins over the type layer.
+            rv, _, _ = await client.mon_command(
+                {"prefix": "config set", "who": "osd.1", "name": "osd_max_backfills", "value": "2"}
+            )
+            assert rv == 0
+            _, _, out = await client.mon_command({"prefix": "config get", "who": "osd.1"})
+            assert json.loads(out)["osd_max_backfills"] == "2"
+            _, _, out = await client.mon_command({"prefix": "config get", "who": "osd.2"})
+            assert json.loads(out)["osd_max_backfills"] == "7"
+
+            # Every quorum member holds the same committed store.
+            await wait_until(
+                lambda: all(m.configmon.version == mons[0].configmon.version for m in mons),
+                3.0,
+                "config versions converge",
+            )
+            assert all(m.configmon.sections == mons[0].configmon.sections for m in mons)
+
+            rv, _, _ = await client.mon_command(
+                {"prefix": "config rm", "who": "osd.1", "name": "osd_max_backfills"}
+            )
+            assert rv == 0
+            _, _, out = await client.mon_command({"prefix": "config get", "who": "osd.1"})
+            assert json.loads(out)["osd_max_backfills"] == "7"
+
+            _, _, out = await client.mon_command({"prefix": "config dump"})
+            dump = json.loads(out)
+            assert dump["sections"]["osd"]["osd_max_backfills"] == "7"
+
+            # Unknown options and type-invalid values are rejected at the
+            # command, never committed (ConfigMonitor::prepare_command).
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "config set", "who": "osd", "name": "osd_max_backfils", "value": "3"}
+            )
+            assert rv < 0 and "unrecognized" in rs
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "config set", "who": "osd", "name": "osd_max_backfills", "value": "nope"}
+            )
+            assert rv < 0 and "invalid value" in rs
+
+            await client.shutdown()
+            await stop_cluster(mons, [])
+
+        asyncio.run(run())
+
+    def test_osd_consumes_pushed_config_at_runtime(self):
+        """`config set osd ...` reaches a live OSD's runtime Config and
+        fires its observers — the ConfigMonitor→MConfig→md_config_t path."""
+
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 2)
+            client = Rados(monmap)
+            await client.connect()
+
+            observed: list[tuple[str, object]] = []
+            osds[0].conf.add_observer(
+                ["osd_recovery_max_active"], lambda n, v: observed.append((n, v))
+            )
+
+            rv, rs, _ = await client.mon_command(
+                {
+                    "prefix": "config set",
+                    "who": "osd",
+                    "name": "osd_recovery_max_active",
+                    "value": "11",
+                }
+            )
+            assert rv == 0, rs
+            await wait_until(
+                lambda: osds[0].conf.get("osd_recovery_max_active") == 11
+                and osds[1].conf.get("osd_recovery_max_active") == 11,
+                3.0,
+                "config push to OSDs",
+            )
+            assert ("osd_recovery_max_active", 11) in observed
+
+            # A named-daemon override targets exactly one OSD.
+            rv, _, _ = await client.mon_command(
+                {
+                    "prefix": "config set",
+                    "who": "osd.1",
+                    "name": "osd_recovery_max_active",
+                    "value": "3",
+                }
+            )
+            assert rv == 0
+            await wait_until(
+                lambda: osds[1].conf.get("osd_recovery_max_active") == 3,
+                3.0,
+                "named config push",
+            )
+            assert osds[0].conf.get("osd_recovery_max_active") == 11
+
+            # `config rm` of the last defining layer reverts live daemons to
+            # the option default (md_config_t resets removed options).
+            for who in ("osd.1", "osd"):
+                rv, _, _ = await client.mon_command(
+                    {"prefix": "config rm", "who": who, "name": "osd_recovery_max_active"}
+                )
+                assert rv == 0
+            default = osds[0].conf.get_option("osd_recovery_max_active").default
+            await wait_until(
+                lambda: all(
+                    o.conf.get("osd_recovery_max_active") == default for o in osds
+                ),
+                3.0,
+                "config revert to default",
+            )
+
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestLogMonitor:
+    def test_clog_error_reaches_log_last(self):
+        """An OSD clog_error lands in the committed cluster log, queryable
+        via `log last` from any mon (the ECBackend CRC-mismatch sink)."""
+
+        async def run():
+            monmap, mons, osds = await start_cluster(3, 1)
+            client = Rados(monmap)
+            await client.connect()
+
+            osds[0].clog_error("pg 1.0 scrub: oid inconsistent on shard 2")
+            await wait_until(
+                lambda: any("inconsistent" in e["msg"] for m in mons for e in m.logmon.entries),
+                3.0,
+                "clog entry committed",
+            )
+            # All quorum members converge on the same log version.
+            await wait_until(
+                lambda: all(m.logmon.version == mons[0].logmon.version for m in mons),
+                3.0,
+                "log versions converge",
+            )
+
+            rv, _, out = await client.mon_command({"prefix": "log last", "num": 10})
+            assert rv == 0
+            got = json.loads(out)
+            assert any("inconsistent" in e["msg"] for e in got["entries"])
+            entry = next(e for e in got["entries"] if "inconsistent" in e["msg"])
+            assert entry["prio"] == "error"
+            assert entry["who"] == "osd.0"
+
+            # Level filter.
+            rv, _, out = await client.mon_command(
+                {"prefix": "log last", "num": 10, "level": "info"}
+            )
+            assert not any(
+                "inconsistent" in e["msg"] for e in json.loads(out)["entries"]
+            )
+
+            # num=0 is a version probe, not "everything".
+            rv, _, out = await client.mon_command({"prefix": "log last", "num": 0})
+            probe = json.loads(out)
+            assert probe["entries"] == [] and probe["version"] >= 1
+
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestAuthMonitor:
+    def test_key_crud_replicates(self):
+        async def run():
+            monmap, mons = await start_mons(3)
+            client = Rados(monmap)
+            await client.connect()
+
+            rv, _, out = await client.mon_command(
+                {"prefix": "auth get-or-create", "entity": "client.admin"}
+            )
+            assert rv == 0
+            created = json.loads(out)
+            key = base64.b64decode(created["key"])
+            assert len(key) == 16
+
+            # get-or-create is idempotent; get returns the same key.
+            rv, _, out = await client.mon_command(
+                {"prefix": "auth get-or-create", "entity": "client.admin"}
+            )
+            assert json.loads(out)["key"] == created["key"]
+            rv, _, out = await client.mon_command(
+                {"prefix": "auth get", "entity": "client.admin"}
+            )
+            assert json.loads(out)["key"] == created["key"]
+
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "auth add", "entity": "osd.0"}
+            )
+            assert rv == 0, rs
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "auth add", "entity": "osd.0"}
+            )
+            assert rv == -17  # EEXIST
+
+            rv, _, out = await client.mon_command({"prefix": "auth ls"})
+            assert set(json.loads(out)) == {"client.admin", "osd.0"}
+
+            # Quorum members share the authoritative keyring byte-for-byte.
+            await wait_until(
+                lambda: all(
+                    m.authmon.keyring.dumps() == mons[0].authmon.keyring.dumps()
+                    and len(m.authmon.keyring) == 2
+                    for m in mons
+                ),
+                3.0,
+                "keyrings converge",
+            )
+
+            rv, _, _ = await client.mon_command(
+                {"prefix": "auth del", "entity": "osd.0"}
+            )
+            assert rv == 0
+            rv, _, _ = await client.mon_command(
+                {"prefix": "auth get", "entity": "osd.0"}
+            )
+            assert rv == -2  # ENOENT
+
+            await client.shutdown()
+            await stop_cluster(mons, [])
+
+        asyncio.run(run())
