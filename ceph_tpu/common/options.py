@@ -145,7 +145,9 @@ OPTIONS: dict[str, Option] = _opts(
     Option("ms_dispatch_throttle_bytes", int, 100 << 20, A, ""),
     # --- objectstore --------------------------------------------------------
     Option("osd_objectstore", str, "memstore", A,
-           "objectstore backend: memstore | tpustore"),
+           "objectstore backend: memstore | filestore | bluestore"),
+    Option("osd_data", str, "", A,
+           "data directory for persistent stores (empty = in-memory)"),
     Option("memstore_device_bytes", int, 1 << 30, A, ""),
     # --- logging (src/log) --------------------------------------------------
     Option("log_file", str, "", B, "empty = stderr"),

@@ -6,11 +6,13 @@ PG shards (coll_t(spg_t(pgid, shard))), and stores implement the
 `ObjectStore` contract (queue_transactions / read / getattr / omap).
 
 Backends: `MemStore` (the in-RAM store the reference's unit tests run
-against, src/os/memstore/) and `FileStore` (a minimal persistent store —
-object data in flat files + a log-structured KV for metadata, standing in
-for BlueStore's block+RocksDB split).
+against, src/os/memstore/), `FileStore` (object data in flat files + a
+log-structured KV for metadata — the FileStore-era design), and
+`BlueStore` (the production engine: raw block space + bitmap extent
+allocator + deferred-write WAL + per-block crc32c, src/os/bluestore/).
 """
 
+from .bluestore import BlueStore, make_store
 from .kv import FileKV, KeyValueDB, MemKV
 from .memstore import MemStore
 from .filestore import FileStore
@@ -18,6 +20,7 @@ from .objectstore import ObjectStore, StoreError
 from .transaction import Transaction
 
 __all__ = [
+    "BlueStore",
     "FileKV",
     "FileStore",
     "KeyValueDB",
@@ -26,4 +29,5 @@ __all__ = [
     "ObjectStore",
     "StoreError",
     "Transaction",
+    "make_store",
 ]

@@ -38,6 +38,17 @@ class KeyValueDB:
         for k, v in kv.items():
             self.set(prefix, k, v)
 
+    def apply_batch(self, ops: list[tuple[int, str, str, bytes]]) -> None:
+        """Apply a batch of (op, prefix, key, value) with op 1=set, 2=rm.
+        Durable backends make the whole batch atomic (a torn batch applies
+        none of it) — the KeyValueDB::Transaction commit contract BlueStore
+        relies on for its metadata commit point."""
+        for op, prefix, key, value in ops:
+            if op == 1:
+                self.set(prefix, key, value)
+            else:
+                self.rm(prefix, key)
+
     def close(self) -> None:
         pass
 
@@ -66,6 +77,9 @@ class MemKV(_DictKV):
 
 
 # FileKV record: u8 op (1=set, 2=rm) | u32 klen | u32 vlen | key | value | crc32c
+# op 3 = atomic batch: payload (in `value`) is a sequence of embedded
+# records (same head layout, no per-record crc); one crc guards the whole
+# batch, so a torn batch is discarded in full — never applied partially.
 _HEAD = struct.Struct("<BII")
 
 
@@ -97,18 +111,26 @@ class FileKV(_DictKV):
         while off + _HEAD.size <= len(buf):
             op, klen, vlen = _HEAD.unpack_from(buf, off)
             end = off + _HEAD.size + klen + vlen + 4
-            if op not in (1, 2) or end > len(buf):
+            if op not in (1, 2, 3) or end > len(buf):
                 break
             rec = buf[off : end - 4]
             (crc,) = struct.unpack_from("<I", buf, end - 4)
             if crc32c(rec) != crc:
                 break  # torn tail
-            key = buf[off + _HEAD.size : off + _HEAD.size + klen].decode()
-            prefix, _, k = key.partition("\x00")
-            if op == 1:
-                self._data[(prefix, k)] = buf[off + _HEAD.size + klen : end - 4]
+            if op == 3:
+                payload = buf[off + _HEAD.size + klen : end - 4]
+                for sop, sprefix, sk, sval in self._iter_batch(payload):
+                    if sop == 1:
+                        self._data[(sprefix, sk)] = sval
+                    else:
+                        self._data.pop((sprefix, sk), None)
             else:
-                self._data.pop((prefix, k), None)
+                key = buf[off + _HEAD.size : off + _HEAD.size + klen].decode()
+                prefix, _, k = key.partition("\x00")
+                if op == 1:
+                    self._data[(prefix, k)] = buf[off + _HEAD.size + klen : end - 4]
+                else:
+                    self._data.pop((prefix, k), None)
             self._records += 1
             good_end = end
             off = end
@@ -148,6 +170,35 @@ class FileKV(_DictKV):
         if (prefix, key) in self._data:
             del self._data[(prefix, key)]
             self._append(2, prefix, key, b"")
+
+    @staticmethod
+    def _iter_batch(payload: bytes):
+        off = 0
+        while off + _HEAD.size <= len(payload):
+            op, klen, vlen = _HEAD.unpack_from(payload, off)
+            end = off + _HEAD.size + klen + vlen
+            if op not in (1, 2) or end > len(payload):
+                break  # malformed embed; crc already vouched, be defensive
+            key = payload[off + _HEAD.size : off + _HEAD.size + klen].decode()
+            prefix, _, k = key.partition("\x00")
+            yield op, prefix, k, payload[off + _HEAD.size + klen : end]
+            off = end
+
+    def apply_batch(self, ops: list[tuple[int, str, str, bytes]]) -> None:
+        """Atomic multi-op commit: one op-3 record, one crc — a crash mid-
+        append discards the entire batch on replay (the commit point for
+        BlueStore metadata transactions)."""
+        if not ops:
+            return
+        parts = []
+        for op, prefix, key, value in ops:
+            kb = f"{prefix}\x00{key}".encode()
+            parts.append(_HEAD.pack(op, len(kb), len(value)) + kb + value)
+            if op == 1:
+                self._data[(prefix, key)] = bytes(value)
+            else:
+                self._data.pop((prefix, key), None)
+        self._append(3, "", "", b"".join(parts))
 
     def close(self) -> None:
         self._f.close()

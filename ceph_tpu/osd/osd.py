@@ -59,7 +59,6 @@ from ..msg.messages import (
     MMgrReport,
 )
 from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
-from ..os.memstore import MemStore
 from .osdmap import PG_NONE, OSDMap, advance_map
 from .pg import PG
 from .scheduler import SchedClass, WorkItem, make_scheduler
@@ -92,7 +91,12 @@ class OSD(Dispatcher):
         self.whoami = whoami
         self.monmap = monmap
         self.conf = conf or Config({"name": f"osd.{whoami}"})
-        self.store = store if store is not None else MemStore()
+        if store is not None:
+            self.store = store
+        else:
+            from ..os.bluestore import make_store
+
+            self.store = make_store(self.conf)
         self._bind_addr = addr
         self.msgr = Messenger(
             f"osd.{whoami}",

@@ -10,16 +10,28 @@ import struct
 
 import pytest
 
-from ceph_tpu.os import FileKV, FileStore, MemKV, MemStore, StoreError, Transaction
+from ceph_tpu.os import (
+    BlueStore,
+    FileKV,
+    FileStore,
+    MemKV,
+    MemStore,
+    StoreError,
+    Transaction,
+)
 
 
-STORES = ["mem", "file"]
+STORES = ["mem", "file", "bluestore", "bluestore-mem"]
 
 
 @pytest.fixture(params=STORES)
 def store(request, tmp_path):
     if request.param == "mem":
         s = MemStore()
+    elif request.param == "bluestore":
+        s = BlueStore(str(tmp_path / "bstore"))
+    elif request.param == "bluestore-mem":
+        s = BlueStore()  # in-memory dev variant
     else:
         s = FileStore(str(tmp_path / "store"))
     s.mount()
