@@ -98,7 +98,14 @@ OPTIONS: dict[str, Option] = _opts(
            "max recovery push size; rounded to stripe (ECBackend.h:206)"),
     Option("osd_recovery_max_active", int, 3, A,
            "max concurrent recovery ops per OSD"),
-    Option("osd_max_backfills", int, 1, A, "max concurrent backfills"),
+    Option("osd_max_backfills", int, 1, A, "max concurrent backfills",
+           runtime=True),
+    Option("osd_min_pg_log_entries", int, 250, A,
+           "entries kept after a trim (PGLog floor)"),
+    Option("osd_max_pg_log_entries", int, 500, A,
+           "trim threshold (PGLog ceiling)"),
+    Option("osd_backfill_scan_max", int, 64, A,
+           "objects per backfill scan chunk", runtime=True),
     Option("osd_op_num_shards", int, 4, A,
            "op queue shards (OSD.h sharded op queue)"),
     Option("osd_op_num_threads_per_shard", int, 2, A, ""),

@@ -502,3 +502,20 @@ class MLog(Message):
     (0 on the daemon->mon leg)."""
 
     FIELDS = [("version", "u64"), ("entries", "bytes")]
+
+
+@message_type(34)
+class MBackfillReserve(Message):
+    """Backfill reservation protocol (src/messages/MBackfillReserve.h):
+    the primary reserves a remote slot on each backfill target before
+    scanning (AsyncReserver handshake), releasing it on completion or
+    interval change."""
+
+    REQUEST, GRANT, REJECT, RELEASE = 0, 1, 2, 3
+
+    FIELDS = [
+        ("pgid", PgId),
+        ("op", "u8"),
+        ("epoch", "u32"),
+        ("from_osd", "u32"),
+    ]

@@ -35,6 +35,7 @@ from ..mon.client import MonClient
 from ..mon.monmap import MonMap
 from ..msg.message import Message
 from ..msg.messages import (
+    MBackfillReserve,
     MOSDBoot,
     MOSDECSubOpRead,
     MOSDECSubOpReadReply,
@@ -61,6 +62,7 @@ from ..msg.messages import (
 from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
 from .osdmap import PG_NONE, OSDMap, advance_map
 from .pg import PG
+from .reserver import Reserver
 from .scheduler import SchedClass, WorkItem, make_scheduler
 
 # Messages owned by a PG's backend (fast-dispatched, OSD.cc:7244).
@@ -111,11 +113,21 @@ class OSD(Dispatcher):
         self._sched_kick = asyncio.Event()
         b = PerfCountersBuilder(f"osd.{whoami}")
         for c in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
-                  "recovery_ops", "heartbeat_failures"):
+                  "recovery_ops", "heartbeat_failures", "backfill_pushes"):
             b.add_u64_counter(c)
         self.perf = b.create_perf_counters()
         self.clog: list[str] = []
         self._pushed_config: set[str] = set()  # mon-managed option names
+        # backfill reservation slots (AsyncReserver pair, OSDService):
+        # local = backfills this OSD primaries, remote = slots granted to
+        # other primaries targeting this OSD; both bound by
+        # osd_max_backfills (runtime-mutable via the config push path).
+        self.local_reserver = Reserver(
+            lambda: self.conf.get("osd_max_backfills")
+        )
+        self.remote_reserver = Reserver(
+            lambda: self.conf.get("osd_max_backfills")
+        )
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
@@ -271,7 +283,11 @@ class OSD(Dispatcher):
 
     def ms_can_fast_dispatch(self, msg: Message) -> bool:
         return isinstance(
-            msg, BACKEND_MSGS + PEERING_MSGS + SCRUB_MSGS + (MOSDPing, MOSDOp)
+            msg,
+            BACKEND_MSGS
+            + PEERING_MSGS
+            + SCRUB_MSGS
+            + (MOSDPing, MOSDOp, MBackfillReserve),
         )
 
     def ms_fast_dispatch(self, conn: Connection, msg: Message) -> None:
@@ -280,6 +296,9 @@ class OSD(Dispatcher):
             return
         if isinstance(msg, MOSDOp):
             self._enqueue_op(conn, msg)
+            return
+        if isinstance(msg, MBackfillReserve):
+            self._handle_backfill_reserve(msg)
             return
         pg = self._get_pg(msg.pgid)
         if pg is None:
@@ -291,6 +310,30 @@ class OSD(Dispatcher):
             pg.handle_scrub_message(msg)
         else:
             pg.backend.handle_message(msg)
+
+    def _handle_backfill_reserve(self, msg: MBackfillReserve) -> None:
+        """Target side grants/releases remote slots; primary side routes
+        replies to the PG (OSD::handle_pg_backfill_reserve)."""
+        key = msg.pgid.key()
+        if msg.op == MBackfillReserve.REQUEST:
+            granted = self.remote_reserver.try_reserve(key)
+            self.send_cluster(
+                msg.from_osd,
+                MBackfillReserve(
+                    pgid=msg.pgid,
+                    op=MBackfillReserve.GRANT
+                    if granted
+                    else MBackfillReserve.REJECT,
+                    epoch=msg.epoch,
+                    from_osd=self.whoami,
+                ),
+            )
+        elif msg.op == MBackfillReserve.RELEASE:
+            self.remote_reserver.release(key)
+        else:  # GRANT / REJECT -> the requesting primary's PG
+            pg = self._get_pg(msg.pgid)
+            if pg is not None:
+                pg.on_backfill_reserve(msg)
 
     # -- client ops through the scheduler --------------------------------------
 

@@ -19,9 +19,15 @@ same information flow, as plain explicit-state code:
   locally from the log delta past that peer's `last_update`
   (PGLog::proc_replica_log), and the delta is pushed to the peer in
   MOSDPGLog so it reaches the same conclusion (activate_map path).
-- Shards whose logs fell behind the tail cannot log-recover and are
-  **backfilled**: every object the primary has is marked missing on them
-  (PeeringState's backfill_targets).
+- Shards whose logs fell behind the tail cannot log-recover and become
+  **backfill targets** (PeeringState's backfill machinery): instead of
+  enumerating every object into a missing set up front, the primary
+  walks its object namespace in sorted chunks with a `last_backfill`
+  cursor per target (osd_types.h BackfillInterval), pushing each chunk
+  and advancing the cursor — writes keep flowing while backfill runs,
+  since repops reach the target regardless and the eventual full-object
+  push includes any bytes written meanwhile.  The PG drives the scan
+  (PG._kick_backfill) under local+remote reservations.
 - **Active**: `missing` + `peer_missing` feed the recovery machinery
   (PGBackend::recover_object, §3.2) and degraded-object write blocking.
 
@@ -85,6 +91,9 @@ class PeeringState:
         self.missing = Missing()  # our own missing objects
         self.peer_missing: dict[int, Missing] = {}  # primary-only
         self.backfill_targets: set[int] = set()
+        # per-target sorted-namespace cursor: objects <= cursor are
+        # backfilled ("" = none yet; advanced by PG._kick_backfill)
+        self.last_backfill: dict[int, str] = {}
 
     # -- interval handling ----------------------------------------------------
 
@@ -97,6 +106,7 @@ class PeeringState:
         self.peer_info = {}
         self.peer_missing = {}
         self.backfill_targets = set()
+        self.last_backfill = {}
         if self.primary != self.whoami:
             self.state = PeerState.STRAY
             return
@@ -326,12 +336,13 @@ class PeeringState:
                 delta = self.log.entries_after(peer_head)
                 delta_since = peer_head
             else:
-                # Log trimmed past the peer: backfill (everything we have)
+                # Log trimmed past the peer: chunked backfill, not an
+                # up-front mark-all-missing.  peer_missing stays empty so
+                # client writes are not blocked as degraded; the PG's
+                # backfill driver copies the namespace behind a cursor.
                 self.backfill_targets.add(osd)
-                m = Missing()
-                for oid in self.list_local_objects():
-                    m.add(oid, head)
-                self.peer_missing[osd] = m
+                self.last_backfill[osd] = ""
+                self.peer_missing[osd] = Missing()
                 delta = list(self.log.entries)
                 delta_since = self.log.tail
             blob = _pack_entries(delta)
@@ -369,6 +380,18 @@ class PeeringState:
         if oid in self.missing:
             out.add(self.whoami)
         return out
+
+    def backfill_pending_osds(self, oid: str) -> set[int]:
+        """Backfill targets whose cursor has not passed `oid`: their copy
+        (if any) is STALE and must never serve reads — the availability
+        gate mark-all-missing used to provide, without the write blocking
+        (is_backfill_target + last_backfill comparison in the reference's
+        missing_loc)."""
+        return {
+            o
+            for o in self.backfill_targets
+            if oid > self.last_backfill.get(o, "")
+        }
 
     def mark_recovered(self, oid: str, osd: int) -> None:
         if osd == self.whoami:

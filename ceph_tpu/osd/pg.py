@@ -28,6 +28,7 @@ from typing import TYPE_CHECKING, Callable
 from ..common.errs import EAGAIN, EINVAL, ENODATA, ENOENT
 from ..common.log import dout
 from ..msg.messages import (
+    MBackfillReserve,
     MOSDOp,
     MOSDOpReply,
     MOSDPGLog,
@@ -83,6 +84,13 @@ class PG(PGListener):
         self.scrubber = PgScrubber(self)
         self.recovering: set[str] = set()
         self.waiting_for_degraded: dict[str, list[Callable[[], None]]] = {}
+        # backfill driver state (PeeringState Backfilling/WaitRemote states)
+        self._bf_granted: set[int] = set()  # targets that granted a slot
+        self._bf_inflight: set[str] = set()  # oids being pushed this chunk
+        self._bf_failed: set[str] = set()  # pushes that errored this chunk
+        self._bf_chunk_targets: dict[int, list[str]] = {}
+        self._bf_local_reserved = False
+        self._bf_gen = 0  # bumped on interval change; stales out callbacks
         self._colls_made: set[str] = set()
         # Completed write results by reqid (PrimaryLogPG's dup-op check
         # against the pg log's reqid index): a client resend after a lost
@@ -105,6 +113,7 @@ class PG(PGListener):
         self._acting = list(acting)
         self._ensure_local_coll()
         self.scrubber.reset()  # an interval change aborts in-flight scrubs
+        self._reset_backfill()  # reservations do not survive an interval
         self.peering.start_peering_interval(epoch, acting)
 
     def tick(self) -> None:
@@ -114,6 +123,7 @@ class PG(PGListener):
         self.scrubber.tick(time.monotonic())
         if self.peering.is_active():
             self._kick_recovery()
+            self._kick_backfill()
 
     def _ensure_local_coll(self) -> None:
         coll = shard_coll(self.pgid, self.whoami_shard())
@@ -232,9 +242,22 @@ class PG(PGListener):
         # A sub-write for an object voids any stale missing record: the
         # write pipeline only runs on recovered objects.
         self.peering.missing.rm(entry.oid)
+        # Bounded log (PGLog::trim, osd_min/max_pg_log_entries): every
+        # shard trims identically since all apply the same entries.  A
+        # down OSD whose head falls behind the trimmed tail can no longer
+        # log-recover — that is what makes it a backfill target.
+        max_entries = self.osd.conf.get("osd_max_pg_log_entries")
+        if len(self.pg_log.entries) > max_entries:
+            keep = self.osd.conf.get("osd_min_pg_log_entries")
+            self.pg_log.trim(self.pg_log.entries[-keep - 1].version)
 
     def get_shard_missing(self, oid: str) -> set[int]:
-        osds = self.peering.osds_missing(oid)
+        # Backfill targets behind the cursor count as missing for READ
+        # availability (their shard is stale or absent), even though they
+        # do not block writes as degraded.
+        osds = self.peering.osds_missing(oid) | self.peering.backfill_pending_osds(
+            oid
+        )
         if self.pool.type != POOL_TYPE_ERASURE:
             return osds
         return {
@@ -501,6 +524,203 @@ class PG(PGListener):
 
         self.backend.recover_object(oid, missing_on, on_complete)
 
+    # -- backfill driver -------------------------------------------------------
+    #
+    # PeeringState's WaitLocalBackfillReserved → WaitRemoteBackfillReserved
+    # → Backfilling chain (PeeringState.cc), tick-driven: the primary takes
+    # a local slot, reserves a remote slot on every target, then walks its
+    # sorted object namespace in osd_backfill_scan_max chunks, pushing each
+    # object and advancing the per-target last_backfill cursor.
+
+    def _backfill_key(self) -> tuple:
+        return ("bf", self.pool.id, self.ps)
+
+    def _kick_backfill(self) -> None:
+        p = self.peering
+        if (
+            not p.is_primary()
+            or not p.is_active()
+            or not p.backfill_targets
+            or self._bf_inflight
+        ):
+            return
+        if not self._bf_local_reserved:
+            if not self.osd.local_reserver.try_reserve(self._backfill_key()):
+                return  # all local slots busy; retry next tick
+            self._bf_local_reserved = True
+        missing_grants = p.backfill_targets - self._bf_granted
+        if missing_grants:
+            # Reservation messages carry the INTERVAL epoch (peering.epoch,
+            # set only when the acting set changes) so unrelated map bumps
+            # cannot invalidate an in-flight grant.
+            for osd in sorted(missing_grants):
+                self.osd.send_cluster(
+                    osd,
+                    MBackfillReserve(
+                        pgid=self.pgid,
+                        op=MBackfillReserve.REQUEST,
+                        epoch=self.peering.epoch,
+                        from_osd=self.osd.whoami,
+                    ),
+                )
+            return  # chunk starts when the grants arrive
+        self._backfill_chunk()
+
+    def on_backfill_reserve(self, msg: MBackfillReserve) -> None:
+        """GRANT/REJECT from a target (primary side)."""
+        stale = (
+            msg.epoch != self.peering.epoch
+            or msg.from_osd not in self.peering.backfill_targets
+        )
+        if stale:
+            if msg.op == MBackfillReserve.GRANT:
+                # The grantor holds a remote slot for a session we no
+                # longer run: hand it back or it leaks forever.
+                self.osd.send_cluster(
+                    msg.from_osd,
+                    MBackfillReserve(
+                        pgid=self.pgid,
+                        op=MBackfillReserve.RELEASE,
+                        epoch=msg.epoch,
+                        from_osd=self.osd.whoami,
+                    ),
+                )
+            return
+        if msg.op == MBackfillReserve.GRANT:
+            self._bf_granted.add(msg.from_osd)
+            if self.peering.backfill_targets <= self._bf_granted:
+                self._backfill_chunk()
+        elif msg.op == MBackfillReserve.REJECT:
+            # Target full (RemoteReservationRejectedTooFull): give up every
+            # reservation we hold so other PGs on this OSD can run, and
+            # retry the whole handshake on a later tick.
+            self._surrender_reservations()
+
+    def _backfill_chunk(self) -> None:
+        import bisect
+
+        p = self.peering
+        if not p.backfill_targets or self._bf_inflight:
+            return
+        scan_max = self.osd.conf.get("osd_backfill_scan_max")
+        objects = self._list_local()  # store returns them sorted
+        self._bf_chunk_targets = {}
+        self._bf_failed = set()
+        chunk: dict[str, set[int]] = {}
+        for osd in sorted(p.backfill_targets):
+            lo = bisect.bisect_right(objects, p.last_backfill[osd])
+            pending = objects[lo : lo + scan_max]
+            self._bf_chunk_targets[osd] = pending
+            for oid in pending:
+                chunk.setdefault(oid, set()).add(osd)
+        if not chunk:
+            self._backfill_complete(list(p.backfill_targets))
+            return
+        self._bf_inflight = set(chunk)
+        self.osd.perf.inc("backfill_pushes", len(chunk))
+        gen = self._bf_gen
+        for oid, osds in chunk.items():
+            if self.pool.type == POOL_TYPE_ERASURE:
+                missing_on = {
+                    self._acting.index(o) for o in osds if o in self._acting
+                }
+            else:
+                missing_on = osds
+
+            def on_done(err: int, oid=oid) -> None:
+                if gen != self._bf_gen:
+                    return  # interval changed mid-push; session is dead
+                self._bf_inflight.discard(oid)
+                if err:
+                    self._bf_failed.add(oid)
+                    self.clog_error(
+                        f"pg {self.pgid} backfill push of {oid} failed: {err}"
+                    )
+                if not self._bf_inflight:
+                    self._backfill_chunk_done()
+
+            self.backend.recover_object(oid, missing_on, on_done)
+
+    def _backfill_chunk_done(self) -> None:
+        p = self.peering
+        scan_max = self.osd.conf.get("osd_backfill_scan_max")
+        # A failed push caps cursor advance below the failed object, so it
+        # is re-scanned (and re-pushed) by a later chunk — the cursor must
+        # never skip an untransferred object.
+        barrier = min(self._bf_failed) if self._bf_failed else None
+        had_failures = bool(self._bf_failed)
+        finished: list[int] = []
+        for osd, pending in self._bf_chunk_targets.items():
+            if osd not in p.backfill_targets:
+                continue
+            done = (
+                pending
+                if barrier is None
+                else [o for o in pending if o < barrier]
+            )
+            if done:
+                p.last_backfill[osd] = max(p.last_backfill[osd], done[-1])
+            if not had_failures and len(pending) < scan_max:
+                finished.append(osd)  # scan exhausted: target is complete
+        self._bf_chunk_targets = {}
+        self._bf_failed = set()
+        if finished:
+            self._backfill_complete(finished)
+        if p.backfill_targets:
+            if had_failures:
+                return  # retry from the barrier on the next tick, not hot
+            self._backfill_chunk()  # keep walking; chunk size throttles
+
+    def _backfill_complete(self, targets: list[int]) -> None:
+        p = self.peering
+        for osd in targets:
+            dout("osd", 5, f"pg {self.pgid} backfill to osd.{osd} complete")
+            p.backfill_targets.discard(osd)
+            p.last_backfill.pop(osd, None)
+            self._bf_granted.discard(osd)
+            self.osd.send_cluster(
+                osd,
+                MBackfillReserve(
+                    pgid=self.pgid,
+                    op=MBackfillReserve.RELEASE,
+                    epoch=self.peering.epoch,
+                    from_osd=self.osd.whoami,
+                ),
+            )
+        if not p.backfill_targets:
+            self._release_local_backfill()
+
+    def _release_local_backfill(self) -> None:
+        if self._bf_local_reserved:
+            self.osd.local_reserver.release(self._backfill_key())
+            self._bf_local_reserved = False
+
+    def _surrender_reservations(self) -> None:
+        """Give back every slot (local + granted remotes) without touching
+        cursors — used on REJECT so one full target cannot starve other
+        PGs; the next tick restarts the handshake from scratch."""
+        for osd in self._bf_granted:
+            self.osd.send_cluster(
+                osd,
+                MBackfillReserve(
+                    pgid=self.pgid,
+                    op=MBackfillReserve.RELEASE,
+                    epoch=self.peering.epoch,
+                    from_osd=self.osd.whoami,
+                ),
+            )
+        self._bf_granted = set()
+        self._release_local_backfill()
+
+    def _reset_backfill(self) -> None:
+        """Interval change: reservations and cursors die with the interval
+        (PeeringState::clear_backfill_state)."""
+        self._bf_gen += 1  # stale out in-flight push callbacks
+        self._surrender_reservations()
+        self._bf_inflight = set()
+        self._bf_failed = set()
+        self._bf_chunk_targets = {}
+
     # -- scrub -----------------------------------------------------------------
 
     def scrub(self, deep: bool = False, repair: bool = False, on_done=None) -> bool:
@@ -560,4 +780,5 @@ class PG(PGListener):
             self.peering.is_active()
             and not self.peering.missing.items
             and all(not m.items for m in self.peering.peer_missing.values())
+            and not self.peering.backfill_targets
         )

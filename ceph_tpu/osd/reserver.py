@@ -1,0 +1,35 @@
+"""Bounded reservation slots — src/common/AsyncReserver.h scaled down.
+
+The reference queues prioritized reservation requests and grants them
+asynchronously; OSDs hold a `local_reserver` (their own backfill slots)
+and a `remote_reserver` (slots they grant to other primaries), both
+bounded by `osd_max_backfills`.  Here grants are immediate-or-denied and
+denied callers retry from their periodic tick — same bound, no queue
+(the tick loop is this framework's requeue mechanism, see
+PeeringState.tick).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Hashable
+
+
+class Reserver:
+    def __init__(self, slots: Callable[[], int]):
+        self._slots = slots
+        self._held: set[Hashable] = set()
+
+    def try_reserve(self, key: Hashable) -> bool:
+        """Grant a slot (idempotent per key); False when full."""
+        if key in self._held:
+            return True
+        if len(self._held) >= max(1, int(self._slots())):
+            return False
+        self._held.add(key)
+        return True
+
+    def release(self, key: Hashable) -> None:
+        self._held.discard(key)
+
+    def held(self) -> int:
+        return len(self._held)

@@ -1,0 +1,333 @@
+"""Backfill machinery tests: log trimming, reservations, chunked scan.
+
+Models the reference's backfill coverage (PeeringState backfill states,
+qa/standalone osd-backfill tests): an OSD that rejoins after the PG log
+trimmed past its head converges via the cursor-driven chunked scan — not
+by enumerating every object into a missing set — while writes keep
+flowing, under local+remote reservation slots.
+"""
+
+import asyncio
+
+from ceph_tpu.client import Rados
+from ceph_tpu.common.config import Config
+from ceph_tpu.mon import MonMap, Monitor
+from ceph_tpu.osd.osd import OSD
+from ceph_tpu.osd.pg_log import Eversion, LogEntry, PGLog
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
+from ceph_tpu.osd.reserver import Reserver
+
+from test_cluster import stop_cluster, wait_until
+from test_mon import free_port_addrs
+
+
+class TestReserver:
+    def test_slots_bound_and_idempotent(self):
+        r = Reserver(lambda: 2)
+        assert r.try_reserve("a")
+        assert r.try_reserve("a")  # idempotent
+        assert r.try_reserve("b")
+        assert not r.try_reserve("c")  # full
+        r.release("a")
+        assert r.try_reserve("c")
+        r.release("missing")  # no-op
+
+    def test_runtime_slot_growth(self):
+        slots = {"n": 1}
+        r = Reserver(lambda: slots["n"])
+        assert r.try_reserve("a") and not r.try_reserve("b")
+        slots["n"] = 2  # config push raised osd_max_backfills
+        assert r.try_reserve("b")
+
+
+class TestLogTrim:
+    def test_trim_advances_tail_and_bounds_entries(self):
+        log = PGLog()
+        for i in range(1, 21):
+            log.append(
+                LogEntry(
+                    oid=f"o{i}", op=1, version=Eversion(1, i),
+                    prior_version=Eversion(),
+                )
+            )
+        log.trim(Eversion(1, 15))
+        assert log.tail == Eversion(1, 15)
+        assert len(log.entries) == 5
+        assert not log.can_catch_up(Eversion(1, 10))
+        assert log.can_catch_up(Eversion(1, 15))
+
+
+class _FakeOsd:
+    def __init__(self):
+        from ceph_tpu.common.perf_counters import PerfCountersBuilder
+        from ceph_tpu.os.memstore import MemStore
+
+        self.whoami = 0
+        self.store = MemStore()
+        self.store.mount()
+        self.conf = Config({"osd_backfill_scan_max": 4}, env=False)
+        self.local_reserver = Reserver(lambda: self.conf.get("osd_max_backfills"))
+        self.remote_reserver = Reserver(lambda: self.conf.get("osd_max_backfills"))
+        b = PerfCountersBuilder("osd.0")
+        b.add_u64_counter("backfill_pushes")
+        self.perf = b.create_perf_counters()
+        self.sent = []  # (osd, msg)
+
+    def send_cluster(self, osd, msg):
+        self.sent.append((osd, msg))
+
+    def clog_error(self, msg):
+        pass
+
+
+def _backfilling_pg(n_objects=10):
+    from ceph_tpu.os import Transaction
+    from ceph_tpu.osd.osdmap import PgPool
+    from ceph_tpu.osd.peering import PeerState
+    from ceph_tpu.osd.pg import PG
+    from ceph_tpu.osd.pg_backend import shard_coll
+
+    osd = _FakeOsd()
+    pool = PgPool(id=1, name="p", size=2, min_size=1)
+    pg = PG(osd, pool, 0, profiles={})
+    coll = shard_coll(pg.pgid, -1)
+    t = Transaction().create_collection(coll)
+    for i in range(n_objects):
+        t.write(coll, f"o{i:03d}", 0, b"x")
+    osd.store.queue_transaction(t)
+    pg._acting = [0, 1]
+    pg._epoch = 5
+    p = pg.peering
+    p.epoch = 5
+    p.acting = [0, 1]
+    p.primary = 0
+    p.state = PeerState.ACTIVE
+    p.backfill_targets = {1}
+    p.last_backfill = {1: ""}
+    # capture pushes; complete them manually
+    pg._pending_pushes = []
+    pg.backend.recover_object = lambda oid, missing_on, cb: (
+        pg._pending_pushes.append((oid, cb))
+    )
+    return pg, osd
+
+
+class TestBackfillDriver:
+    def test_reject_surrenders_local_slot(self):
+        from ceph_tpu.msg.messages import MBackfillReserve
+
+        pg, osd = _backfilling_pg()
+        pg._kick_backfill()  # takes local slot, sends REQUEST
+        assert pg._bf_local_reserved
+        assert any(
+            m.op == MBackfillReserve.REQUEST for _, m in osd.sent
+        )
+        pg.on_backfill_reserve(
+            MBackfillReserve(
+                pgid=pg.pgid, op=MBackfillReserve.REJECT, epoch=5, from_osd=1
+            )
+        )
+        # local slot released so OTHER PGs can backfill meanwhile
+        assert not pg._bf_local_reserved
+        assert osd.local_reserver.held() == 0
+        # next tick restarts the handshake
+        pg._kick_backfill()
+        assert pg._bf_local_reserved
+
+    def test_failed_push_caps_cursor_and_retries(self):
+        from ceph_tpu.msg.messages import MBackfillReserve
+
+        pg, osd = _backfilling_pg(n_objects=6)  # scan_max=4 -> 2 chunks
+        pg._kick_backfill()
+        pg.on_backfill_reserve(
+            MBackfillReserve(
+                pgid=pg.pgid, op=MBackfillReserve.GRANT, epoch=5, from_osd=1
+            )
+        )
+        assert len(pg._pending_pushes) == 4
+        for oid, cb in pg._pending_pushes:
+            cb(5 if oid == "o001" else 0)  # o001 fails with EIO
+        # cursor stops BELOW the failed object; target not complete
+        assert pg.peering.last_backfill[1] == "o000"
+        assert 1 in pg.peering.backfill_targets
+        # next tick re-scans from the barrier and re-pushes o001
+        pg._pending_pushes.clear()
+        pg._kick_backfill()
+        assert [oid for oid, _ in pg._pending_pushes][0] == "o001"
+        # drain to completion (completions may spawn the next chunk's
+        # pushes, so swap the list out each round instead of clearing)
+        guard = 0
+        while 1 in pg.peering.backfill_targets:
+            guard += 1
+            assert guard < 100, "backfill never completed"
+            if not pg._pending_pushes:
+                pg._kick_backfill()
+            pending, pg._pending_pushes = pg._pending_pushes, []
+            for oid, cb in pending:
+                cb(0)
+        assert osd.local_reserver.held() == 0
+
+    def test_stale_grant_sends_release_back(self):
+        from ceph_tpu.msg.messages import MBackfillReserve
+
+        pg, osd = _backfilling_pg()
+        # GRANT from an interval that no longer exists
+        pg.on_backfill_reserve(
+            MBackfillReserve(
+                pgid=pg.pgid, op=MBackfillReserve.GRANT, epoch=3, from_osd=1
+            )
+        )
+        rel = [m for tgt, m in osd.sent if tgt == 1]
+        assert rel and rel[-1].op == MBackfillReserve.RELEASE
+
+    def test_straggler_callback_after_interval_change_is_inert(self):
+        from ceph_tpu.msg.messages import MBackfillReserve
+
+        pg, osd = _backfilling_pg()
+        pg._kick_backfill()
+        pg.on_backfill_reserve(
+            MBackfillReserve(
+                pgid=pg.pgid, op=MBackfillReserve.GRANT, epoch=5, from_osd=1
+            )
+        )
+        stragglers = list(pg._pending_pushes)
+        assert stragglers
+        pg._reset_backfill()  # interval change mid-chunk
+        pg._pending_pushes.clear()
+        for _, cb in stragglers:
+            cb(0)  # late completions must not restart an unreserved chunk
+        assert not pg._pending_pushes
+        assert not pg._bf_local_reserved
+
+    def test_reads_exclude_stale_backfill_shard(self):
+        pg, osd = _backfilling_pg()
+        pg.peering.last_backfill[1] = "o003"
+        # objects at/below the cursor are safe on the target
+        assert pg.get_shard_missing("o002") == set()
+        assert pg.get_shard_missing("o003") == set()
+        # beyond the cursor the target's copy is stale: unavailable for reads
+        assert pg.get_shard_missing("o007") == {1}
+        # but writes are NOT blocked as degraded
+        assert not pg.peering.object_missing_anywhere("o007")
+
+
+def bf_conf(whoami: int) -> Config:
+    return Config(
+        {
+            "name": f"osd.{whoami}",
+            "osd_heartbeat_interval": 0.1,
+            "osd_heartbeat_grace": 0.6,
+            # tiny log so a rejoining OSD falls behind the tail fast
+            "osd_min_pg_log_entries": 5,
+            "osd_max_pg_log_entries": 10,
+            "osd_backfill_scan_max": 8,
+        },
+        env=False,
+    )
+
+
+class TestBackfillCluster:
+    def test_rejoining_osd_backfills_and_converges_under_write_load(self):
+        async def run():
+            monmap = MonMap(addrs=free_port_addrs(1))
+            mons = [Monitor(n, monmap, election_timeout=0.3) for n in monmap.addrs]
+            for m in mons:
+                await m.start()
+                await m.wait_for_quorum()
+            osds = [OSD(i, monmap, conf=bf_conf(i)) for i in range(3)]
+            for o in osds:
+                await o.start()
+            for o in osds:
+                await o.wait_for_up()
+
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("bf", "replicated", size=3, pg_num=1)
+            ioctx = await client.open_ioctx("bf")
+
+            objs = {}
+            for i in range(30):
+                oid = f"pre-{i:03d}"
+                objs[oid] = (b"%03d" % i) * 700
+                await ioctx.write_full(oid, objs[oid])
+
+            # Kill osd.2; keep writing so the log trims far past its head.
+            victim_store = osds[2].store
+            await osds[2].stop()
+            await wait_until(
+                lambda: not mons[0].osdmon.osdmap.is_up(2), 8.0, "osd.2 down"
+            )
+            for i in range(30):
+                oid = f"during-{i:03d}"
+                objs[oid] = (b"D%02d" % i) * 700
+                await ioctx.write_full(oid, objs[oid])
+
+            primary = next(
+                o
+                for o in osds[:2]
+                for pg in [*o.pgs.values()]
+                if pg.peering.is_primary()
+            )
+            pg = next(iter(primary.pgs.values()))
+            assert len(pg.pg_log.entries) <= 10  # the trim actually ran
+            assert pg.pg_log.tail.version > 0
+
+            # Revive osd.2 on its old store: its in-memory log is empty and
+            # the primary's tail has moved -> it must become a backfill
+            # target, with NO synthetic everything-missing set.
+            revived = OSD(2, monmap, conf=bf_conf(2), store=victim_store)
+            await revived.start()
+            await revived.wait_for_up()
+            osds[2] = revived
+
+            saw_backfill = {"flag": False, "mark_all": False}
+
+            def observe():
+                if 2 in pg.peering.backfill_targets:
+                    saw_backfill["flag"] = True
+                    pm = pg.peering.peer_missing.get(2)
+                    if pm is not None and len(pm) > 10:
+                        saw_backfill["mark_all"] = True
+                return False
+
+            # Mid-backfill write load: these objects land while the scan
+            # runs; convergence must include them regardless of cursor
+            # position at the time of the write.
+            for i in range(10):
+                observe()
+                oid = f"mid-{i:03d}"
+                objs[oid] = (b"M%02d" % i) * 700
+                await ioctx.write_full(oid, objs[oid])
+
+            def clean():
+                observe()
+                return all(
+                    p.is_clean
+                    for o in osds
+                    if o._running
+                    for p in o.pgs.values()
+                    if p.peering.is_primary()
+                )
+
+            await wait_until(clean, 15.0, "backfill to clean")
+            assert saw_backfill["flag"], "osd.2 never became a backfill target"
+            assert not saw_backfill["mark_all"], (
+                "backfill fell back to mark-all-missing"
+            )
+            assert primary.perf.get("backfill_pushes") > 0
+
+            # Every object readable, and osd.2's own store holds them all.
+            for oid, data in objs.items():
+                assert await ioctx.read(oid) == data
+            coll = next(iter(revived.store.list_collections()))
+            have = set(revived.store.list_objects(coll))
+            assert set(objs) <= have
+
+            # Reservations fully released on completion.
+            assert primary.local_reserver.held() == 0
+            assert revived.remote_reserver.held() == 0
+
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
