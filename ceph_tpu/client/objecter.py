@@ -28,7 +28,15 @@ from ..common.errs import EAGAIN, ENOENT, ETIMEDOUT
 from ..common.log import dout
 from ..mon.client import MonClient
 from ..mon.monmap import MonMap
-from ..msg.messages import MOSDMap, MOSDOp, MOSDOpReply, OSDOp, PgId, ReqId
+from ..msg.messages import (
+    MOSDMap,
+    MOSDOp,
+    MOSDOpReply,
+    MWatchNotify,
+    OSDOp,
+    PgId,
+    ReqId,
+)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..osd.osdmap import PG_NONE, OSDMap, advance_map
 
@@ -43,6 +51,12 @@ class Objecter(Dispatcher):
         self._tid = 0
         self._replies: dict[int, asyncio.Future] = {}
         self._map_changed = asyncio.Event()
+        # (pool, oid, cookie) -> callback(notify_id, payload) -> optional
+        # reply bytes; pushes arrive on the session the WATCH op registered
+        # on (Objecter::handle_watch_notify).  Cookies are allocated
+        # process-wide so handles can never collide.
+        self._watches: dict[tuple[int, str, int], object] = {}
+        self._next_cookie = 0
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -75,6 +89,32 @@ class Objecter(Dispatcher):
             if fut is not None and not fut.done():
                 fut.set_result(msg)
             return True
+        if isinstance(msg, MWatchNotify) and not msg.is_ack:
+            cb = self._watches.get((msg.pgid.pool, msg.oid, msg.cookie))
+            ack_payload = b""
+            if cb is not None:
+                try:
+                    ack_payload = cb(msg.notify_id, msg.payload) or b""
+                except Exception as e:  # a watcher bug must not kill dispatch
+                    dout("objecter", 1, f"{self.name}: watch cb raised {e!r}")
+            ack = MWatchNotify(
+                oid=msg.oid,
+                pgid=msg.pgid,
+                notify_id=msg.notify_id,
+                cookie=msg.cookie,
+                payload=bytes(ack_payload),
+                is_ack=1,
+                watcher=self.name,
+            )
+
+            async def _send_ack() -> None:
+                try:
+                    await conn.send_message(ack)
+                except ConnectionError:
+                    pass
+
+            asyncio.get_event_loop().create_task(_send_ack())
+            return True
         return False
 
     # -- targeting -------------------------------------------------------------
@@ -94,6 +134,9 @@ class Objecter(Dispatcher):
         ops: list[OSDOp],
         timeout: float = 10.0,
         ps: int | None = None,
+        snap_seq: int = 0,
+        snaps: list[int] | None = None,
+        snap_id: int = 0,
     ) -> MOSDOpReply:
         """op_submit (Objecter.cc:2268): send + resend until a final
         reply.  Raises TimeoutError past `timeout`.  `ps` targets a
@@ -126,6 +169,9 @@ class Objecter(Dispatcher):
                 oid=oid,
                 ops=ops,
                 epoch=self.osdmap.epoch,
+                snap_seq=snap_seq,
+                snaps=list(snaps or []),
+                snap_id=snap_id,
             )
             fut: asyncio.Future = asyncio.get_event_loop().create_future()
             self._replies[reqid.tid] = fut

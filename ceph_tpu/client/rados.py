@@ -63,6 +63,15 @@ class Rados:
         _check(retval, rs)
         return json.loads(outbl.decode() or "[]")
 
+    async def selfmanaged_snap_create(self, pool_name: str) -> int:
+        """Allocate a self-managed snapshot id (rados_ioctx_
+        selfmanaged_snap_create): durable via paxos before first use."""
+        retval, rs, outbl = await self.mon_command(
+            {"prefix": "osd pool selfmanaged-snap-create", "pool": pool_name}
+        )
+        _check(retval, rs)
+        return int(json.loads(outbl.decode())["snap_id"])
+
     async def open_ioctx(self, pool_name: str, timeout: float = 5.0) -> "IoCtx":
         """Pool handle (rados_ioctx_create); waits for the pool to appear
         in our map (pool creation is a paxos round away)."""
@@ -81,37 +90,74 @@ class Rados:
 
 
 class IoCtx:
-    """Pool-scoped I/O context (librados::IoCtx)."""
+    """Pool-scoped I/O context (librados::IoCtx).
+
+    Snapshots follow librados' self-managed model: the caller sets a
+    SnapContext (`set_snap_context`) that rides every write so the OSD
+    clones on first-write-after-snap; reads address a snapshot with the
+    `snap=` parameter (rados_ioctx_snap_set_read)."""
 
     def __init__(self, rados: Rados, pool_id: int):
         self.rados = rados
         self.pool_id = pool_id
+        self.snap_seq = 0
+        self.snaps: list[int] = []  # descending, newest first
 
-    async def _op(self, oid: str, ops: list[OSDOp], timeout: float = 10.0):
+    def set_snap_context(self, snap_seq: int, snaps: list[int]) -> None:
+        """rados_ioctx_selfmanaged_snap_set_write_ctx."""
+        self.snap_seq = snap_seq
+        self.snaps = sorted(snaps, reverse=True)
+
+    async def _op(
+        self,
+        oid: str,
+        ops: list[OSDOp],
+        timeout: float = 10.0,
+        snap: int = 0,
+        snapc: tuple[int, list[int]] | None = None,
+    ):
+        # A per-call snapc (librados' write_op snapc) overrides the handle's
+        # ambient context — concurrent writers on one shared IoCtx must not
+        # race each other's SnapContext.
+        seq, snaps = snapc if snapc is not None else (self.snap_seq, self.snaps)
         return await self.rados.objecter.op_submit(
-            self.pool_id, oid, ops, timeout=timeout
+            self.pool_id,
+            oid,
+            ops,
+            timeout=timeout,
+            snap_seq=seq,
+            snaps=snaps,
+            snap_id=snap,
         )
 
     # -- writes ---------------------------------------------------------------
 
-    async def write(self, oid: str, data: bytes, off: int = 0) -> None:
-        rep = await self._op(oid, [OSDOp(op=OSDOp.WRITE, off=off, data=bytes(data))])
+    async def write(self, oid: str, data: bytes, off: int = 0, snapc=None) -> None:
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.WRITE, off=off, data=bytes(data))], snapc=snapc
+        )
         _check(rep.result, f"write {oid}")
 
-    async def write_full(self, oid: str, data: bytes) -> None:
-        rep = await self._op(oid, [OSDOp(op=OSDOp.WRITEFULL, data=bytes(data))])
+    async def write_full(self, oid: str, data: bytes, snapc=None) -> None:
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.WRITEFULL, data=bytes(data))], snapc=snapc
+        )
         _check(rep.result, f"write_full {oid}")
 
-    async def append(self, oid: str, data: bytes) -> None:
-        rep = await self._op(oid, [OSDOp(op=OSDOp.APPEND, data=bytes(data))])
+    async def append(self, oid: str, data: bytes, snapc=None) -> None:
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.APPEND, data=bytes(data))], snapc=snapc
+        )
         _check(rep.result, f"append {oid}")
 
-    async def truncate(self, oid: str, size: int) -> None:
-        rep = await self._op(oid, [OSDOp(op=OSDOp.TRUNCATE, off=size)])
+    async def truncate(self, oid: str, size: int, snapc=None) -> None:
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.TRUNCATE, off=size)], snapc=snapc
+        )
         _check(rep.result, f"truncate {oid}")
 
-    async def remove(self, oid: str) -> None:
-        rep = await self._op(oid, [OSDOp(op=OSDOp.DELETE)])
+    async def remove(self, oid: str, snapc=None) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.DELETE)], snapc=snapc)
         _check(rep.result, f"remove {oid}")
 
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
@@ -120,16 +166,87 @@ class IoCtx:
         )
         _check(rep.result, f"setxattr {oid}:{name}")
 
+    # -- snapshots -------------------------------------------------------------
+
+    async def rollback(self, oid: str, snap_id: int, snapc=None) -> None:
+        """rados_ioctx_selfmanaged_snap_rollback: head := state at snap.
+        Rollback is a write: the snapc clones the pre-rollback head for
+        any newer snapshot first."""
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.ROLLBACK, off=snap_id)], snapc=snapc
+        )
+        _check(rep.result, f"rollback {oid}@{snap_id}")
+
+    async def list_snaps(self, oid: str) -> dict:
+        """The object's SnapSet ({'seq', 'clones'}; rados listsnaps)."""
+        rep = await self._op(oid, [OSDOp(op=OSDOp.LIST_SNAPS)])
+        _check(rep.result, f"list_snaps {oid}")
+        return json.loads(rep.outdata[0].decode())
+
+    async def snap_trim(self, oid: str, snap_id: int) -> None:
+        """Remove one snap from the object, deleting its clone when no
+        snap references it (the snap-trimmer's per-object step)."""
+        rep = await self._op(oid, [OSDOp(op=OSDOp.DELETE)], snap=snap_id)
+        _check(rep.result, f"snap_trim {oid}@{snap_id}")
+
+    # -- copy-from -------------------------------------------------------------
+
+    async def copy_from(self, oid: str, src_oid: str, src_snap: int = 0) -> None:
+        """Server-side object copy (rados_copy_from / CEPH_OSD_OP_COPY_FROM):
+        bytes move OSD->OSD, never through this client."""
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.COPY_FROM, name=src_oid, off=src_snap)]
+        )
+        _check(rep.result, f"copy_from {src_oid} -> {oid}")
+
+    # -- watch / notify --------------------------------------------------------
+
+    async def watch(self, oid: str, callback) -> int:
+        """Register a watch (rados_watch2): `callback(notify_id, payload)`
+        runs on every notify; its return bytes (if any) ride the ack back
+        to the notifier.  Returns the watch cookie."""
+        obj = self.rados.objecter
+        obj._next_cookie += 1  # process-wide: no collisions across handles
+        cookie = obj._next_cookie
+        obj._watches[(self.pool_id, oid, cookie)] = callback
+        rep = await self._op(oid, [OSDOp(op=OSDOp.WATCH, off=cookie, len=1)])
+        if rep.result < 0:
+            obj._watches.pop((self.pool_id, oid, cookie), None)
+        _check(rep.result, f"watch {oid}")
+        return cookie
+
+    async def unwatch(self, oid: str, cookie: int) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.WATCH, off=cookie, len=0)])
+        self.rados.objecter._watches.pop((self.pool_id, oid, cookie), None)
+        _check(rep.result, f"unwatch {oid}")
+
+    async def notify(
+        self, oid: str, payload: bytes = b"", timeout_ms: int = 3000
+    ) -> dict:
+        """rados_notify2: returns {'acks': {cookie: reply-bytes-hex},
+        'timeouts': [cookies that never acked]}."""
+        rep = await self._op(
+            oid,
+            [OSDOp(op=OSDOp.NOTIFY, off=timeout_ms, data=bytes(payload))],
+            timeout=max(10.0, timeout_ms / 1000 + 5),
+        )
+        _check(rep.result, f"notify {oid}")
+        return json.loads(rep.outdata[0].decode())
+
     # -- reads ----------------------------------------------------------------
 
-    async def read(self, oid: str, length: int = 0, off: int = 0) -> bytes:
-        rep = await self._op(oid, [OSDOp(op=OSDOp.READ, off=off, len=length)])
+    async def read(
+        self, oid: str, length: int = 0, off: int = 0, snap: int = 0
+    ) -> bytes:
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.READ, off=off, len=length)], snap=snap
+        )
         _check(rep.result, f"read {oid}")
         return rep.outdata[0] if rep.outdata else b""
 
-    async def stat(self, oid: str) -> int:
+    async def stat(self, oid: str, snap: int = 0) -> int:
         """Object size (rados_stat)."""
-        rep = await self._op(oid, [OSDOp(op=OSDOp.STAT)])
+        rep = await self._op(oid, [OSDOp(op=OSDOp.STAT)], snap=snap)
         _check(rep.result, f"stat {oid}")
         return int.from_bytes(rep.outdata[0], "little")
 

@@ -214,6 +214,7 @@ class OSDMonitor:
             "osd in": (self._cmd_in, True),
             "osd reweight": (self._cmd_reweight, True),
             "osd pool set": (self._cmd_pool_set, True),
+            "osd pool selfmanaged-snap-create": (self._cmd_snap_create, True),
         }
         entry = handlers.get(prefix)
         if entry is None:
@@ -428,6 +429,30 @@ class OSDMonitor:
             return f"osd.{osd} reweighted to {weight}"
 
         self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_snap_create(self, cmd, reply) -> None:
+        """Allocate a self-managed snapshot id from the pool's snap_seq
+        (OSDMonitor prepare_pool_op SELFMANAGED_SNAP_CREATE): the id is
+        durable via paxos before any client uses it in a SnapContext."""
+        import json as _json
+
+        name = cmd["pool"]
+        out: dict = {}
+
+        def mutate(m: OSDMap) -> str:
+            if name not in m.pool_name_to_id:
+                raise KeyError(f"no such pool {name}")
+            pool = m.pools[m.pool_name_to_id[name]]
+            pool.snap_seq += 1
+            out["snap_id"] = pool.snap_seq
+            return f"created snap {pool.snap_seq} in {name}"
+
+        self._queue(
+            mutate,
+            lambda rv, rs: reply(
+                rv, rs, _json.dumps(out).encode() if rv == 0 else b""
+            ),
+        )
 
     def _cmd_pool_set(self, cmd, reply) -> None:
         """`osd pool set <pool> <var> <val>` (OSDMonitor prepare_command

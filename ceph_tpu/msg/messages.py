@@ -63,6 +63,11 @@ class OSDOp(Struct):
     GETXATTR = 8
     SETXATTR = 9
     PGLS = 10  # list objects in the PG (rados ls; PrimaryLogPG do_pgnls)
+    ROLLBACK = 11     # roll head back to a snap's clone (off = snap id)
+    LIST_SNAPS = 12   # dump the object's SnapSet
+    WATCH = 13        # register/unregister a watch (off = cookie, len = 1/0)
+    NOTIFY = 14       # notify watchers (data = payload, off = timeout ms)
+    COPY_FROM = 15    # copy another object's content (name = src oid)
 
     FIELDS = [
         ("op", "u8"),
@@ -127,7 +132,13 @@ class MOSDPing(Message):
 
 @message_type(4)
 class MOSDOp(Message):
-    """Client op to the primary (src/messages/MOSDOp.h)."""
+    """Client op to the primary (src/messages/MOSDOp.h).
+
+    Snapshot plumbing rides the op like the reference's: writes carry the
+    client's SnapContext (`snap_seq` + descending `snaps`, the
+    self-managed-snap model) so the PG can clone-on-first-write; reads
+    carry `snap_id` (0 = head, CEPH_NOSNAP analog inverted for
+    compactness) to address a snapshot's clone."""
 
     FIELDS = [
         ("reqid", ReqId),
@@ -135,7 +146,32 @@ class MOSDOp(Message):
         ("oid", "str"),
         ("ops", ("list", OSDOp)),
         ("epoch", "u32"),
+        ("snap_seq", "u64"),
+        ("snaps", ("list", "u64")),
+        ("snap_id", "u64"),
     ]
+
+    def __init__(
+        self,
+        reqid=None,
+        pgid=None,
+        oid="",
+        ops=None,
+        epoch=0,
+        snap_seq=0,
+        snaps=None,
+        snap_id=0,
+    ):
+        super().__init__(
+            reqid=reqid,
+            pgid=pgid,
+            oid=oid,
+            ops=ops or [],
+            epoch=epoch,
+            snap_seq=snap_seq,
+            snaps=snaps or [],
+            snap_id=snap_id,
+        )
 
 
 @message_type(5)
@@ -518,4 +554,21 @@ class MBackfillReserve(Message):
         ("op", "u8"),
         ("epoch", "u32"),
         ("from_osd", "u32"),
+    ]
+
+
+@message_type(35)
+class MWatchNotify(Message):
+    """Watch/notify push + ack (src/messages/MWatchNotify.h): the primary
+    pushes a notify to every watcher's session; watchers ack with the same
+    type (`is_ack`=1) carrying their optional reply payload."""
+
+    FIELDS = [
+        ("oid", "str"),
+        ("pgid", PgId),
+        ("notify_id", "u64"),
+        ("cookie", "u64"),
+        ("payload", "bytes"),
+        ("is_ack", "u8"),
+        ("watcher", "str"),  # acking entity name
     ]

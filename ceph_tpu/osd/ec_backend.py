@@ -389,7 +389,12 @@ class ECBackend(PGBackend):
             reqid=op.reqid.key(),
         )
         acting = self.listener.acting()
-        log_bytes = [entry.tobytes()]
+        from .pg_backend import side_effect_log_entries
+
+        log_bytes = [entry.tobytes()] + [
+            e.tobytes()
+            for e in side_effect_log_entries(self.listener, op.pgt)
+        ]
         # Register EVERY pending shard before dispatching ANY sub-write:
         # the self-send applies synchronously, and its reply must not see a
         # half-filled pending set (it would commit after the local apply

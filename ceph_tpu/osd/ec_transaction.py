@@ -68,6 +68,12 @@ class PGTransaction:
     truncate: int | None = None
     delete: bool = False
     attrs: dict[str, bytes | None] = field(default_factory=dict)  # None = rm
+    # Snapshot clone-on-write (PrimaryLogPG::make_writeable): before the
+    # mutation applies, the current head is cloned to this oid — per shard
+    # for EC, whole-object for replicated — atomically with the write.
+    pre_clone: str | None = None
+    # Extra whole-object deletions riding this txn (snap-trimmed clones).
+    also_delete: list[str] = field(default_factory=list)
 
     def write(self, off: int, data: bytes) -> "PGTransaction":
         self.writes.append((off, bytes(data)))
@@ -124,7 +130,10 @@ def get_write_plan(
                     f"append at {padded_size}, got offset {off}",
                 )
             if off == 0 and obj_size > 0 and end_aligned < padded_size:
-                raise EcError(EINVAL, "full rewrite must cover the object")
+                # A shrinking WRITEFULL is still a full replacement when the
+                # accompanying truncate discards the old tail.
+                if not (pgt.truncate is not None and pgt.truncate <= end):
+                    raise EcError(EINVAL, "full rewrite must cover the object")
         else:
             plan.invalidates_hinfo = True
             # Partial head/tail stripes that already exist must be read.
@@ -135,8 +144,10 @@ def get_write_plan(
                     read_ranges.append((stripe_off, sw))
         write_ranges.append((start_aligned, end_aligned - start_aligned))
     if pgt.truncate is not None:
+        # The PG pre-resolves truncate to the sequential final size
+        # (write-then-truncate caps; WRITEFULL replaces exactly).
         t = pgt.truncate
-        plan.new_size = t if not pgt.writes else max(t, plan.new_size)
+        plan.new_size = t
         if t < obj_size and t % sw != 0:
             # Unaligned truncate: the surviving partial stripe is re-encoded
             # with a zeroed tail (ECTransaction's truncate handling).
@@ -172,6 +183,16 @@ def generate_transactions(
     n = ec.get_chunk_count()
     txns = {s: Transaction() for s in range(n)}
     sw = sinfo.stripe_width
+
+    if pgt.pre_clone is not None:
+        # Clone each shard's pre-write state (data + attrs incl. hinfo)
+        # in the same transaction as the write — the EC shape of
+        # make_writeable's clone (per-shard objects clone per-shard).
+        for s, txn in txns.items():
+            txn.clone(shard_colls[s], pgt.oid, pgt.pre_clone)
+    for extra in pgt.also_delete:
+        for s, txn in txns.items():
+            txn.remove(shard_colls[s], extra)
 
     if pgt.delete:
         for s, txn in txns.items():

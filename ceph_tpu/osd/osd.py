@@ -56,8 +56,12 @@ from ..msg.messages import (
     MOSDRepOpReply,
     MOSDRepScrub,
     MOSDRepScrubMap,
+    MWatchNotify,
     MMgrMap,
     MMgrReport,
+    OSDOp,
+    PgId,
+    ReqId,
 )
 from ..msg.messenger import Connection, Dispatcher, Messenger, Policy
 from .osdmap import PG_NONE, OSDMap, advance_map
@@ -128,6 +132,9 @@ class OSD(Dispatcher):
         self.remote_reserver = Reserver(
             lambda: self.conf.get("osd_max_backfills")
         )
+        # internal (OSD-as-client) reads for COPY_FROM source fetches
+        self._internal_tid = 0
+        self._internal_reads: dict[int, object] = {}
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
@@ -263,6 +270,12 @@ class OSD(Dispatcher):
             return True
         return False
 
+    def ms_handle_reset(self, conn: Connection) -> None:
+        """A client session died: its watches evaporate and pending
+        notifies stop waiting on it (Watch::remove on session reset)."""
+        for pg in self.pgs.values():
+            pg.on_client_reset(conn)
+
     def _send_mgr_report(self) -> None:
         """Periodic perf/status report to the active mgr
         (MgrClient::send_report)."""
@@ -287,7 +300,7 @@ class OSD(Dispatcher):
             BACKEND_MSGS
             + PEERING_MSGS
             + SCRUB_MSGS
-            + (MOSDPing, MOSDOp, MBackfillReserve),
+            + (MOSDPing, MOSDOp, MBackfillReserve, MWatchNotify, MOSDOpReply),
         )
 
     def ms_fast_dispatch(self, conn: Connection, msg: Message) -> None:
@@ -299,6 +312,18 @@ class OSD(Dispatcher):
             return
         if isinstance(msg, MBackfillReserve):
             self._handle_backfill_reserve(msg)
+            return
+        if isinstance(msg, MWatchNotify):
+            pg = self._get_pg(msg.pgid)
+            if pg is not None and msg.is_ack:
+                pg.handle_watch_ack(msg)
+            return
+        if isinstance(msg, MOSDOpReply):
+            # reply to an internal op (COPY_FROM source fetch)
+            cb = self._internal_reads.pop(msg.reqid.tid, None)
+            if cb is not None:
+                data = msg.outdata[0] if msg.outdata else b""
+                cb(msg.result, data)
             return
         pg = self._get_pg(msg.pgid)
         if pg is None:
@@ -379,7 +404,7 @@ class OSD(Dispatcher):
         for op in msg.ops:
             if op.data:
                 self.perf.inc("op_in_bytes", len(op.data))
-        pg.do_op(msg, reply)
+        pg.do_op(msg, reply, conn)
 
     async def _op_worker(self) -> None:
         """The op worker (the reference's ShardedThreadPool shards,
@@ -397,6 +422,42 @@ class OSD(Dispatcher):
             await asyncio.sleep(0)
 
     # -- ordered cluster sends -------------------------------------------------
+
+    def internal_read(
+        self, pool_id: int, oid: str, snap_id: int, cb, timeout: float = 5.0
+    ) -> None:
+        """Whole-object fetch with this OSD acting as a RADOS client toward
+        the object's primary — the objecter leg of COPY_FROM
+        (PrimaryLogPG::do_copy_from → Objecter).  cb(err, data); -EAGAIN
+        on timeout or unplaceable source so the client op retries."""
+        from ..common.errs import EAGAIN
+
+        _pool, ps = self.osdmap.object_to_pg(pool_id, oid)
+        _u, _up, _a, primary = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
+        if primary == PG_NONE:
+            cb(-EAGAIN, b"")
+            return
+        self._internal_tid += 1
+        tid = self._internal_tid
+        self._internal_reads[tid] = cb
+
+        def expire() -> None:
+            stale = self._internal_reads.pop(tid, None)
+            if stale is not None:
+                stale(-EAGAIN, b"")
+
+        asyncio.get_event_loop().call_later(timeout, expire)
+        self.send_cluster(
+            primary,
+            MOSDOp(
+                reqid=ReqId(client=f"osd.{self.whoami}", tid=tid),
+                pgid=PgId(pool_id, ps, -1),
+                oid=oid,
+                ops=[OSDOp(op=OSDOp.READ)],
+                epoch=self.osdmap.epoch,
+                snap_id=snap_id,
+            ),
+        )
 
     def send_cluster(self, osd: int, msg: Message) -> None:
         """Ordered send to a peer OSD by id (cluster messenger)."""

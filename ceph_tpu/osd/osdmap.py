@@ -76,6 +76,7 @@ class PgPool:
     stripe_width: int = 0  # k * stripe_unit for EC (OSDMonitor.cc:7715)
     flags: int = 0
     fast_read: bool = False
+    snap_seq: int = 0  # self-managed snap id allocator (pg_pool_t::snap_seq)
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE
@@ -238,6 +239,7 @@ class OSDMap(Encodable):
                 e.u32(p.stripe_width),
                 e.u32(p.flags),
                 e.boolean(p.fast_read),
+                e.u64(p.snap_seq),
             ),
         )
         enc.map_(
@@ -279,6 +281,7 @@ class OSDMap(Encodable):
                 stripe_width=d.u32(),
                 flags=d.u32(),
                 fast_read=d.boolean(),
+                snap_seq=d.u64(),
             ),
         )
         for pid, kw in pools.items():

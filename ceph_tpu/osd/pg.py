@@ -44,6 +44,7 @@ from .osdmap import PG_NONE, POOL_TYPE_ERASURE, PgPool
 from .peering import PeeringState
 from .pg_backend import PGListener, build_pg_backend, shard_coll
 from .pg_log import Eversion, LogEntry, Missing, PGLog, PgInfo
+from .snaps import SS_ATTR, WHITEOUT_ATTR, SnapSet, clone_oid
 
 WRITE_OPS = {
     OSDOp.WRITE,
@@ -52,6 +53,8 @@ WRITE_OPS = {
     OSDOp.TRUNCATE,
     OSDOp.APPEND,
     OSDOp.SETXATTR,
+    OSDOp.ROLLBACK,
+    OSDOp.COPY_FROM,
 }
 
 
@@ -97,6 +100,18 @@ class PG(PGListener):
         # reply must get the original result, not a second execution.
         self._reqid_results: dict[tuple[str, int], MOSDOpReply] = {}
         self._inflight_reqids: dict[tuple[str, int], list] = {}
+        # watch/notify (PrimaryLogPG watchers / Notify in Watch.cc):
+        # oid -> (entity, cookie) -> connection; cookies are only unique
+        # per watcher entity, exactly like the reference's watch key
+        # (pair<uint64_t, entity_name_t>, PrimaryLogPG.h).
+        # Simplification vs the reference: watches are primary-memory only
+        # (the reference persists them in object_info and clients re-watch
+        # after ENOTCONN) — a primary failover drops them, so watchers
+        # must re-register after cluster topology changes.
+        self.watchers: dict[str, dict[tuple[str, int], object]] = {}
+        self._notify_id = 0
+        # notify_id -> {"pending": set[(entity, cookie)], "acks", "finish"}
+        self._notifies: dict[int, dict] = {}
 
     # -- interval / peering ----------------------------------------------------
 
@@ -283,8 +298,11 @@ class PG(PGListener):
 
     # -- client op execution ---------------------------------------------------
 
-    def do_op(self, msg: MOSDOp, reply: Callable[[MOSDOpReply], None]) -> None:
-        """PrimaryLogPG::do_op.  `reply` delivers the MOSDOpReply."""
+    def do_op(
+        self, msg: MOSDOp, reply: Callable[[MOSDOpReply], None], conn=None
+    ) -> None:
+        """PrimaryLogPG::do_op.  `reply` delivers the MOSDOpReply; `conn`
+        is the client session (needed to push watch notifies)."""
         if not self.peering.is_primary() or not self.peering.is_active():
             # Misdirected or not-yet-peered: tell the client to refresh its
             # map and resend (the reference drops + relies on the map sub;
@@ -303,14 +321,33 @@ class PG(PGListener):
         if self.peering.object_missing_anywhere(oid):
             # wait_for_degraded_object: queue + prioritize its recovery
             self.waiting_for_degraded.setdefault(oid, []).append(
-                lambda: self.do_op(msg, reply)
+                lambda: self.do_op(msg, reply, conn)
             )
             self._recover_one(oid)
+            return
+        if "@" in oid and msg.reqid.client and not msg.reqid.client.startswith(
+            "osd."
+        ):
+            # "@" separates snap clones in the flat store namespace
+            # (snaps.clone_oid); a client object named like a clone could
+            # be shadowed or destroyed by the snap machinery.  The
+            # reference carries snap ids in hobject_t instead of the name;
+            # here the character is reserved.
+            reply(self._errored(msg, -EINVAL))
+            return
+        first = msg.ops[0].op if msg.ops else 0
+        if first == OSDOp.WATCH:
+            self._do_watch(conn, msg, reply)
+            return
+        if first == OSDOp.NOTIFY:
+            self._do_notify(msg, reply)
             return
         if any(op.op in WRITE_OPS for op in msg.ops):
             if self.scrubber.write_blocked(oid):
                 # write_blocked_by_scrub: hold until the chunk completes
-                self.scrubber.waiting_writes.append(lambda: self.do_op(msg, reply))
+                self.scrubber.waiting_writes.append(
+                    lambda: self.do_op(msg, reply, conn)
+                )
                 return
             key = msg.reqid.key()
             done = self._reqid_results.get(key)
@@ -330,39 +367,95 @@ class PG(PGListener):
         pgt = PGTransaction(oid=msg.oid)
         outdata: list[bytes] = [b""] * len(msg.ops)
         size = self._object_size(msg.oid)
+        exists = self._object_exists(msg.oid)
         for op in msg.ops:
             if op.op == OSDOp.WRITE:
                 pgt.write(op.off, op.data)
                 size = max(size, op.off + len(op.data))
+                pgt.attrs.setdefault(WHITEOUT_ATTR, None)  # resurrect
             elif op.op == OSDOp.WRITEFULL:
                 pgt.write(0, op.data)
                 pgt.truncate = len(op.data)
                 size = len(op.data)
+                pgt.attrs.setdefault(WHITEOUT_ATTR, None)
             elif op.op == OSDOp.APPEND:
                 pgt.write(size, op.data)
                 size += len(op.data)
+                pgt.attrs.setdefault(WHITEOUT_ATTR, None)
             elif op.op == OSDOp.TRUNCATE:
                 pgt.truncate = op.off
                 size = op.off
             elif op.op == OSDOp.DELETE:
-                pgt.delete = True
-                size = 0
+                if msg.snap_id:
+                    # snap trim, not a head delete (PrimaryLogPG::trim_object)
+                    if not exists:
+                        # nothing to trim; a txn would materialize a
+                        # phantom head via touch+setattr
+                        self._finish_write(
+                            msg,
+                            reply,
+                            MOSDOpReply(
+                                reqid=msg.reqid,
+                                result=0,
+                                outdata=[b""] * len(msg.ops),
+                                version=self._version,
+                                epoch=self._epoch,
+                            ),
+                            remember=True,
+                        )
+                        return
+                    self._apply_snap_trim(msg, pgt)
+                elif self._get_snapset(msg.oid).clones or (
+                    exists and msg.snaps
+                ):
+                    # Snapshots reference (or are about to clone) this head:
+                    # deletion becomes a WHITEOUT — zero bytes + marker,
+                    # SnapSet preserved so clones stay reachable
+                    # (object_info_t FLAG_WHITEOUT; PrimaryLogPG _delete_oid)
+                    pgt.truncate = 0
+                    pgt.attrs[WHITEOUT_ATTR] = b"1"
+                    size = 0
+                else:
+                    pgt.delete = True
+                    size = 0
             elif op.op == OSDOp.SETXATTR:
                 pgt.attrs[f"_{op.name}"] = op.data
+                pgt.attrs.setdefault(WHITEOUT_ATTR, None)
+            elif op.op == OSDOp.ROLLBACK:
+                self._start_rollback(msg, reply, int(op.off))
+                return
+            elif op.op == OSDOp.COPY_FROM:
+                self._start_copy_from(msg, reply, op)
+                return
             else:
                 self._inflight_reqids.pop(msg.reqid.key(), None)
                 reply(self._errored(msg, -EINVAL))
                 return
-        key = msg.reqid.key()
-
+        # `size` tracked the ops SEQUENTIALLY (write-then-truncate caps,
+        # truncate-then-write extends); make it authoritative for the
+        # backends, which cannot recover op order from the PGTransaction.
+        if pgt.truncate is not None:
+            pgt.truncate = size
+        # make_writeable (PrimaryLogPG): first mutation after a new snap
+        # clones the current head — atomically with this transaction.
+        if msg.snaps and not msg.snap_id:
+            ss = self._get_snapset(msg.oid)
+            if exists:
+                new_snaps = ss.needs_clone(msg.snap_seq, list(msg.snaps))
+                if new_snaps:
+                    cid = ss.add_clone(new_snaps, self._object_size(msg.oid))
+                    pgt.pre_clone = clone_oid(msg.oid, cid)
+                    pgt.attrs[SS_ATTR] = ss.encode()
+            elif not pgt.delete:
+                # Created after those snaps existed: they must not cover
+                # it, and reads at them must answer ENOENT.
+                newest = max(msg.snaps)
+                if newest > ss.seq:
+                    ss.seq = newest
+                    ss.born = newest
+                    pgt.attrs[SS_ATTR] = ss.encode()
         def finish(rep: MOSDOpReply, remember: bool) -> None:
-            if remember:
-                self._reqid_results[key] = rep
-                if len(self._reqid_results) > 1000:  # bounded dup window
-                    self._reqid_results.pop(next(iter(self._reqid_results)))
-            reply(rep)
-            for dup_reply in self._inflight_reqids.pop(key, []):
-                dup_reply(rep)
+            self._finish_write(msg, reply, rep, remember)
 
         def on_commit() -> None:
             finish(
@@ -391,8 +484,21 @@ class PG(PGListener):
     def _do_read(self, msg: MOSDOp, reply) -> None:
         outdata: list[bytes] = [b""] * len(msg.ops)
         read_extents: list[tuple[int, tuple[int, int]]] = []  # (op idx, extent)
-        size = self._object_size(msg.oid)
-        exists = self._object_exists(msg.oid)
+        # Snapshot reads resolve to the covering clone (find_object_context):
+        # the head serves when no clone is newer than the requested snap.
+        target = msg.oid
+        if msg.snap_id:
+            ss = self._get_snapset(msg.oid)
+            if msg.snap_id <= ss.born:
+                reply(self._errored(msg, -ENOENT))  # created after the snap
+                return
+            cid = ss.resolve(msg.snap_id)
+            if cid is not None:
+                target = clone_oid(msg.oid, cid)
+        size = self._object_size(target)
+        exists = self._object_exists(target)
+        if exists and self._getxattr(target, WHITEOUT_ATTR):
+            exists, size = False, 0  # deleted head kept only for its clones
         result = 0
         for i, op in enumerate(msg.ops):
             if op.op == OSDOp.READ:
@@ -403,22 +509,33 @@ class PG(PGListener):
                 ln = min(ln, max(size - op.off, 0))
                 if ln > 0:
                     read_extents.append((i, (op.off, ln)))
+            elif op.op == OSDOp.LIST_SNAPS:
+                outdata[i] = self._get_snapset(msg.oid).encode()
             elif op.op == OSDOp.STAT:
                 if not exists:
                     result = -ENOENT
                     break
                 outdata[i] = size.to_bytes(8, "little")
             elif op.op == OSDOp.GETXATTR:
-                val = self._getxattr(msg.oid, f"_{op.name}")
+                val = self._getxattr(target, f"_{op.name}")
                 if val is None:
                     result = -ENODATA
                     break
                 outdata[i] = val
             elif op.op == OSDOp.PGLS:
-                # PrimaryLogPG::do_pgnls — enumerate this PG's objects
+                # PrimaryLogPG::do_pgnls — enumerate this PG's heads
+                # (snap clones are internal, filtered like the reference
+                # filters non-head snapids from nls listings)
                 import json as _json
 
-                outdata[i] = _json.dumps(sorted(self._list_local())).encode()
+                outdata[i] = _json.dumps(
+                    sorted(
+                        o
+                        for o in self._list_local()
+                        if "@" not in o
+                        and not self._getxattr(o, WHITEOUT_ATTR)
+                    )
+                ).encode()
             else:
                 result = -EINVAL
                 break
@@ -435,7 +552,7 @@ class PG(PGListener):
             return
 
         def on_read(results: dict) -> None:
-            err, bufs = results[msg.oid]
+            err, bufs = results[target]
             if err:
                 reply(self._errored(msg, err))
                 return
@@ -452,8 +569,238 @@ class PG(PGListener):
             )
 
         self.backend.objects_read_and_reconstruct(
-            {msg.oid: [ext for _i, ext in read_extents]}, on_read
+            {target: [ext for _i, ext in read_extents]}, on_read
         )
+
+    def _finish_write(
+        self, msg: MOSDOp, reply, rep: MOSDOpReply, remember: bool
+    ) -> None:
+        """Complete a write-class op: record in the dup window and release
+        queued duplicate repliers."""
+        key = msg.reqid.key()
+        if remember:
+            self._reqid_results[key] = rep
+            if len(self._reqid_results) > 1000:  # bounded dup window
+                self._reqid_results.pop(next(iter(self._reqid_results)))
+        reply(rep)
+        for dup_reply in self._inflight_reqids.pop(key, []):
+            dup_reply(rep)
+
+    # -- snapshots (PrimaryLogPG snap machinery) -------------------------------
+
+    def _get_snapset(self, oid: str) -> SnapSet:
+        return SnapSet.decode(self._getxattr(oid, SS_ATTR))
+
+    def _apply_snap_trim(self, msg: MOSDOp, pgt: PGTransaction) -> None:
+        """DELETE with a snap id = trim that snap from the object
+        (PrimaryLogPG::trim_object): drop it from its clone's coverage and
+        delete the clone once nothing references it."""
+        ss = self._get_snapset(msg.oid)
+        gone = ss.drop_snap(msg.snap_id)
+        pgt.attrs[SS_ATTR] = ss.encode()
+        if gone is not None:
+            pgt.also_delete.append(clone_oid(msg.oid, gone))
+        if not ss.clones and self._getxattr(msg.oid, WHITEOUT_ATTR):
+            # last clone gone and the head was only a whiteout: reclaim it
+            # (the snap-trimmer's whiteout garbage collection)
+            pgt.delete = True
+            pgt.attrs.clear()
+
+    def _start_rollback(self, msg: MOSDOp, reply, snap_id: int) -> None:
+        """ROLLBACK: make the head identical to the object's state at
+        `snap_id` (PrimaryLogPG::_rollback_to).  Resolved clone content is
+        read back and applied through the normal write pipeline, so EC
+        hinfo/extent-cache stay coherent and replicas converge via the
+        same repop path as any write."""
+        oid = msg.oid
+        ss = self._get_snapset(oid)
+        if snap_id <= ss.born:
+            # The object did not exist at that snap: rollback = delete
+            # (the reference's _rollback_to ENOENT → _delete_oid path).
+            msg.ops[:] = [OSDOp(op=OSDOp.DELETE)]
+            self._do_write(msg, reply)
+            return
+        cid = ss.resolve(snap_id)
+        if cid is None:
+            # no clone newer than the snap: the head IS that state
+            self._finish_write(
+                msg,
+                reply,
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=0,
+                    outdata=[b""] * len(msg.ops),
+                    version=self._version,
+                    epoch=self._epoch,
+                ),
+                remember=True,
+            )
+            return
+        src = clone_oid(oid, cid)
+        src_size = self._object_size(src)
+
+        def proceed(data: bytes) -> None:
+            msg.ops[:] = [OSDOp(op=OSDOp.WRITEFULL, data=data)]
+            self._do_write(msg, reply)
+
+        if src_size == 0:
+            proceed(b"")
+            return
+
+        def on_read(results: dict) -> None:
+            err, bufs = results[src]
+            if err:
+                self._finish_write(
+                    msg, reply, self._errored(msg, err), remember=False
+                )
+                return
+            proceed(bufs[0] if bufs else b"")
+
+        self.backend.objects_read_and_reconstruct(
+            {src: [(0, src_size)]}, on_read
+        )
+
+    def _start_copy_from(self, msg: MOSDOp, reply, op: OSDOp) -> None:
+        """COPY_FROM: fetch the source object's bytes (this OSD acting as a
+        client toward the source's primary — the objecter leg of
+        PrimaryLogPG::do_copy_from) and apply them through the write
+        pipeline as a full write."""
+        src, src_snap = op.name, int(op.off)
+
+        def on_fetched(err: int, data: bytes) -> None:
+            if err:
+                self._finish_write(
+                    msg, reply, self._errored(msg, -abs(err)), remember=False
+                )
+                return
+            msg.ops[:] = [OSDOp(op=OSDOp.WRITEFULL, data=data)]
+            self._do_write(msg, reply)
+
+        self.osd.internal_read(self.pool.id, src, src_snap, on_fetched)
+
+    # -- watch / notify (PrimaryLogPG watchers, Watch.cc) ----------------------
+
+    def _do_watch(self, conn, msg: MOSDOp, reply) -> None:
+        op = msg.ops[0]
+        cookie = int(op.off)
+        if not self._object_exists(msg.oid):
+            reply(self._errored(msg, -ENOENT))
+            return
+        table = self.watchers.setdefault(msg.oid, {})
+        wkey = (msg.reqid.client, cookie)
+        if op.len:
+            table[wkey] = conn
+        else:
+            table.pop(wkey, None)
+            if not table:
+                self.watchers.pop(msg.oid, None)
+        reply(
+            MOSDOpReply(
+                reqid=msg.reqid,
+                result=0,
+                outdata=[b""],
+                version=self._version,
+                epoch=self._epoch,
+            )
+        )
+
+    def _do_notify(self, msg: MOSDOp, reply) -> None:
+        import json as _json
+
+        from ..msg.messages import MWatchNotify
+
+        op = msg.ops[0]
+        timeout_s = (int(op.off) or 3000) / 1000.0
+        watchers = dict(self.watchers.get(msg.oid, {}))
+        self._notify_id += 1
+        nid = self._notify_id
+        state = {
+            "pending": set(watchers),
+            "acks": {},
+            "conns": dict(watchers),
+            "done": False,
+        }
+
+        def finish() -> None:
+            if state["done"]:
+                return
+            state["done"] = True
+            self._notifies.pop(nid, None)
+            out = _json.dumps(
+                {
+                    "acks": {
+                        f"{ent}/{ck}": p.hex()
+                        for (ent, ck), p in state["acks"].items()
+                    },
+                    "timeouts": sorted(
+                        f"{ent}/{ck}" for ent, ck in state["pending"]
+                    ),
+                }
+            ).encode()
+            reply(
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=0,
+                    outdata=[out],
+                    version=self._version,
+                    epoch=self._epoch,
+                )
+            )
+
+        state["finish"] = finish
+        if not watchers:
+            finish()
+            return
+        self._notifies[nid] = state
+        for (entity, cookie), conn in watchers.items():
+            push = MWatchNotify(
+                oid=msg.oid,
+                pgid=self.pgid,
+                notify_id=nid,
+                cookie=cookie,
+                payload=op.data,
+                is_ack=0,
+                watcher=entity,
+            )
+
+            async def _send(conn=conn, push=push, wkey=(entity, cookie)) -> None:
+                try:
+                    await conn.send_message(push)
+                except ConnectionError:
+                    state["pending"].discard(wkey)
+                    if not state["pending"]:
+                        finish()
+
+            asyncio.get_event_loop().create_task(_send())
+        asyncio.get_event_loop().call_later(timeout_s, finish)
+
+    def handle_watch_ack(self, msg) -> None:
+        state = self._notifies.get(msg.notify_id)
+        wkey = (msg.watcher, msg.cookie)
+        if state is None or wkey not in state["pending"]:
+            return
+        state["pending"].discard(wkey)
+        state["acks"][wkey] = msg.payload
+        if not state["pending"]:
+            state["finish"]()
+
+    def on_client_reset(self, conn) -> None:
+        """A client session died: its watches evaporate (watch timeout via
+        connection teardown) and pending notifies stop waiting for it."""
+        for oid in list(self.watchers):
+            table = self.watchers[oid]
+            for wkey in [k for k, wc in table.items() if wc is conn]:
+                del table[wkey]
+            if not table:
+                del self.watchers[oid]
+        for state in list(self._notifies.values()):
+            stale = {
+                k for k, wc in state["conns"].items() if wc is conn
+            } & state["pending"]
+            if stale:
+                state["pending"] -= stale
+                if not state["pending"]:
+                    state["finish"]()
 
     def _errored(self, msg: MOSDOp, err: int) -> MOSDOpReply:
         return MOSDOpReply(

@@ -90,6 +90,33 @@ class PGListener(abc.ABC):
         pass
 
 
+def side_effect_log_entries(listener: PGListener, pgt) -> list:
+    """PG-log entries for a transaction's side-effect objects: the snap
+    clone it creates and the trimmed clones it deletes.  Without these a
+    replica that missed the write would recover the head but never the
+    clone (the reference logs clones from make_writeable the same way)."""
+    out = []
+    if getattr(pgt, "pre_clone", None):
+        out.append(
+            LogEntry(
+                op=LOG_MODIFY,
+                oid=pgt.pre_clone,
+                version=listener.next_version(),
+                reqid=("", 0),
+            )
+        )
+    for extra in getattr(pgt, "also_delete", ()):
+        out.append(
+            LogEntry(
+                op=LOG_DELETE,
+                oid=extra,
+                version=listener.next_version(),
+                reqid=("", 0),
+            )
+        )
+    return out
+
+
 class PGBackend(abc.ABC):
     def __init__(self, listener: PGListener, store: ObjectStore):
         self.listener = listener
@@ -179,6 +206,12 @@ class ReplicatedBackend(PGBackend):
         except StoreError:
             pass
         version = self.listener.next_version()
+        if getattr(pgt, "pre_clone", None) is not None:
+            # make_writeable: preserve the pre-write head as the snap clone,
+            # atomically with the mutation (PrimaryLogPG::make_writeable).
+            txn.clone(coll, pgt.oid, pgt.pre_clone)
+        for extra in getattr(pgt, "also_delete", ()):
+            txn.remove(coll, extra)  # trimmed snap clones
         if pgt.delete:
             txn.remove(coll, pgt.oid)
         else:
@@ -188,7 +221,7 @@ class ReplicatedBackend(PGBackend):
                 size = max(size, off + len(data))
             if pgt.truncate is not None:
                 txn.truncate(coll, pgt.oid, pgt.truncate)
-                size = pgt.truncate if not pgt.writes else max(size, pgt.truncate)
+                size = pgt.truncate  # PG pre-resolved the sequential size
             txn.setattr(
                 coll, pgt.oid, OI_ATTR,
                 ObjectInfo(size=size, version=version.version).encode(),
@@ -205,6 +238,9 @@ class ReplicatedBackend(PGBackend):
             version=version,
             reqid=reqid.key(),
         )
+        log_bytes = [entry.tobytes()] + [
+            e.tobytes() for e in side_effect_log_entries(self.listener, pgt)
+        ]
         targets = {o for o in self.listener.acting() if o != PG_NONE}
         self.in_flight[tid] = (set(targets), on_commit)
         for osd in targets:
@@ -216,7 +252,7 @@ class ReplicatedBackend(PGBackend):
                     tid=tid,
                     reqid=reqid,
                     txn=blob,
-                    log_entries=[entry.tobytes()],
+                    log_entries=log_bytes,
                 ),
             )
         return tid

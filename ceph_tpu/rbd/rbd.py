@@ -10,12 +10,13 @@ data-path slice — SURVEY.md §2.7 "Access layers"):
   4 MiB).
 - I/O maps logical extents onto data objects (io/ImageRequest.cc →
   Striper math with stripe_count=1, the rbd default layout).
-- **Snapshots** are copy-on-write: the first write to an object after a
-  snapshot preserves the pre-write content under
-  `rbd_data.<id>.<objno>@<snap_id>` before the head is modified —
-  client-driven COW standing in for the reference's OSD-side SnapSet
-  clones (PrimaryLogPG make_writeable); reads from a snapshot pick the
-  oldest preserved copy at-or-after it, falling back to head.
+- **Snapshots are SERVER-SIDE**, exactly like librbd's: snap ids come
+  from the pool's self-managed snap counter (rados
+  selfmanaged_snap_create → OSDMonitor), every data write carries the
+  image's SnapContext, and the OSD clones on first-write-after-snap
+  (PrimaryLogPG::make_writeable → SnapSet clones).  Snapshot reads pass
+  the snap id; rollback/trim use the OSD's ROLLBACK and snap-trim ops.
+  Nothing is copied client-side.
 - The image directory object `rbd_directory` maps names → ids
   (librbd's rbd_directory omap).
 
@@ -69,8 +70,7 @@ class RBD:
             "size": size,
             "max_size": size,  # high-water mark for cleanup after shrinks
             "order": order,
-            "snaps": [],  # [{"id": int, "name": str}]
-            "snap_seq": 0,
+            "snaps": [],  # [{"id": int, "name": str, "size": int}]
         }
         await self.ioctx.write_full(
             f"rbd_header.{image_id}", json.dumps(header).encode()
@@ -87,18 +87,21 @@ class RBD:
         if image_id is None:
             raise RbdError(ENOENT, f"image {name!r} not found")
         img = await self.open(name)
-        # iterate the LARGEST size the image ever had: a shrunk image's
-        # snap objects live past the current end
         span = max(img.size, img.header.get("max_size", img.size))
         objects = (span + img.object_bytes - 1) // img.object_bytes
         for objno in range(objects):
-            for oid in [img._data_oid(objno)] + [
-                img._snap_oid(objno, s["id"]) for s in img.header["snaps"]
-            ]:
+            oid = img._data_oid(objno)
+            # trim every snapshot's clone, then the head (the last trim
+            # garbage-collects a whiteout head automatically)
+            for s in img.header["snaps"]:
                 try:
-                    await self.ioctx.remove(oid)
+                    await self.ioctx.snap_trim(oid, s["id"])
                 except Exception:
                     pass
+            try:
+                await self.ioctx.remove(oid)
+            except Exception:
+                pass
         await self.ioctx.remove(f"rbd_header.{image_id}")
         del directory[name]
         await self._write_directory(directory)
@@ -150,9 +153,6 @@ class Image:
     def _data_oid(self, objno: int) -> str:
         return f"rbd_data.{self.id}.{objno:016x}"
 
-    def _snap_oid(self, objno: int, snap_id: int) -> str:
-        return f"rbd_data.{self.id}.{objno:016x}@{snap_id}"
-
     def _extents(self, off: int, length: int):
         """Logical range -> [(objno, obj_off, len)] (stripe_count=1)."""
         out = []
@@ -166,55 +166,34 @@ class Image:
             length -= take
         return out
 
+    def _snapc(self) -> tuple[int, list[int]]:
+        """This image's SnapContext, passed PER CALL (never armed on the
+        shared IoCtx: concurrent ops must not race each other's context —
+        ImageCtx::snapc rides every individual write in the reference)."""
+        ids = sorted((s["id"] for s in self.header["snaps"]), reverse=True)
+        return (ids[0] if ids else 0, ids)
+
     # -- I/O -------------------------------------------------------------------
 
     async def write(self, off: int, data: bytes) -> None:
         if off + len(data) > self.size:
             raise RbdError(EINVAL, "write past end of image")
+        snapc = self._snapc()
         cursor = 0
         for objno, obj_off, ln in self._extents(off, len(data)):
-            await self._cow_preserve(objno)
             await self.ioctx.write(
-                self._data_oid(objno), data[cursor : cursor + ln], obj_off
+                self._data_oid(objno),
+                data[cursor : cursor + ln],
+                obj_off,
+                snapc=snapc,
             )
             cursor += ln
-
-    async def _cow_preserve(self, objno: int) -> None:
-        """Before the first write to an object after the latest snapshot,
-        copy its current content to the snap object (the client-side
-        stand-in for PrimaryLogPG::make_writeable's clone)."""
-        snaps = self.header["snaps"]
-        if not snaps:
-            return
-        latest = snaps[-1]["id"]
-        snap_oid = self._snap_oid(objno, latest)
-        try:
-            await self.ioctx.stat(snap_oid)
-            return  # already preserved for this snap
-        except Exception:
-            pass
-        from ..client.rados import RadosError
-        from ..common.errs import ENOENT
-
-        try:
-            current = await self.ioctx.read(self._data_oid(objno))
-        except RadosError as e:
-            # ONLY a genuinely absent object preserves as empty; any
-            # transport error must propagate, or a zero copy would be
-            # permanently recorded as the snapshot's content.
-            if e.errno != -ENOENT:
-                raise
-            current = b""
-        # A never-written object preserves as one zero byte: block reads
-        # zero-fill past object ends, so it reads identically, and the
-        # copy reliably exists for the preserved-check above.
-        await self.ioctx.write_full(snap_oid, current or b"\x00")
 
     async def read(self, off: int, length: int, snap_name: str | None = None) -> bytes:
         if off >= self.size:
             return b""
         length = min(length, self.size - off)
-        snap_id = None
+        snap_id = 0
         if snap_name is not None:
             snap_id = self._snap_by_name(snap_name)["id"]
         parts = []
@@ -223,49 +202,38 @@ class Image:
             parts.append(data[obj_off : obj_off + ln].ljust(ln, b"\x00"))
         return b"".join(parts)
 
-    async def _read_object(self, objno: int, snap_id: int | None) -> bytes:
-        """Snapshot read resolution: the oldest preserved copy with
-        snap >= snap_id wins, else the head (librbd's snap read maps to
-        the SnapSet clone covering the snap)."""
+    async def _read_object(self, objno: int, snap_id: int) -> bytes:
+        """Block reads zero-fill absent objects/holes (ObjectRequest's
+        read-from-parent/zero semantics, flattened)."""
         from ..client.rados import RadosError
-        from ..common.errs import ENOENT
 
-        if snap_id is not None:
-            for snap in self.header["snaps"]:
-                if snap["id"] >= snap_id:
-                    try:
-                        return await self.ioctx.read(self._snap_oid(objno, snap["id"]))
-                    except RadosError as e:
-                        if e.errno != -ENOENT:
-                            raise
-                        continue  # not preserved under this snap; try newer
         try:
-            return await self.ioctx.read(self._data_oid(objno))
+            return await self.ioctx.read(self._data_oid(objno), snap=snap_id)
         except RadosError as e:
             if e.errno != -ENOENT:
                 raise
             return b""
 
     async def resize(self, new_size: int) -> None:
-        """librbd::resize; shrinking drops whole objects past the end —
-        after COW-preserving them, so existing snapshots survive the
-        shrink (librbd keeps clones across resize)."""
+        """librbd::resize; shrinking drops whole objects past the end.
+        Deletions/truncates carry the SnapContext, so the OSD preserves
+        snapshot clones (whiteout heads) before discarding bytes."""
         old = self.size
         if new_size < old:
+            snapc = self._snapc()
             ob = self.object_bytes
             first_dead = (new_size + ob - 1) // ob
             last = (old - 1) // ob if old else 0
             for objno in range(first_dead, last + 1):
-                await self._cow_preserve(objno)
                 try:
-                    await self.ioctx.remove(self._data_oid(objno))
+                    await self.ioctx.remove(self._data_oid(objno), snapc=snapc)
                 except Exception:
                     pass
             if new_size % ob:
-                boundary = new_size // ob
-                await self._cow_preserve(boundary)
                 try:
-                    await self.ioctx.truncate(self._data_oid(boundary), new_size % ob)
+                    await self.ioctx.truncate(
+                        self._data_oid(new_size // ob), new_size % ob, snapc=snapc
+                    )
                 except Exception:
                     pass
         self.header["size"] = new_size
@@ -281,13 +249,14 @@ class Image:
         raise RbdError(ENOENT, f"snapshot {name!r} not found")
 
     async def snap_create(self, name: str) -> None:
-        """librbd snap_create: allocate a snap id; objects copy-on-write
-        lazily as the head is modified."""
+        """librbd snap_create: allocate a pool snap id (durable via paxos)
+        and record it; the OSDs clone lazily as the head is modified."""
         if any(s["name"] == name for s in self.header["snaps"]):
             raise RbdError(EEXIST, f"snapshot {name!r} exists")
-        self.header["snap_seq"] += 1
+        pool = self.ioctx.rados.objecter.osdmap.pools[self.ioctx.pool_id]
+        snap_id = await self.ioctx.rados.selfmanaged_snap_create(pool.name)
         self.header["snaps"].append(
-            {"id": self.header["snap_seq"], "name": name, "size": self.size}
+            {"id": snap_id, "name": name, "size": self.size}
         )
         await self._save_header()
 
@@ -295,44 +264,51 @@ class Image:
         return [s["name"] for s in self.header["snaps"]]
 
     async def snap_rollback(self, name: str) -> None:
-        """librbd snap_rollback: head objects revert to the snapshot's
-        content.  Rollback writes are writes: they COW-preserve first, so
-        snapshots newer than the target keep their content."""
+        """librbd snap_rollback: every data object reverts server-side to
+        its state at the snap (OSD ROLLBACK op); objects born after the
+        snap are deleted (they did not exist then).  Deletions carry the
+        SnapContext so newer snapshots keep their content."""
+        from ..client.rados import RadosError
+
         snap = self._snap_by_name(name)
         span = max(self.size, self.header.get("max_size", self.size))
         objects = (span + self.object_bytes - 1) // self.object_bytes
+        snapc = self._snapc()
         for objno in range(objects):
-            data = await self._read_object(objno, snap["id"])
-            await self._cow_preserve(objno)
-            await self.ioctx.write_full(self._data_oid(objno), data or b"\x00")
+            oid = self._data_oid(objno)
+            try:
+                await self.ioctx.stat(oid, snap=snap["id"])
+            except RadosError as e:
+                if e.errno != -ENOENT:
+                    raise
+                # absent at the snap: must be absent after rollback
+                try:
+                    await self.ioctx.remove(oid, snapc=snapc)
+                except RadosError as e2:
+                    if e2.errno != -ENOENT:
+                        raise
+                continue
+            await self.ioctx.rollback(oid, snap["id"], snapc=snapc)
         self.header["size"] = snap.get("size", self.size)
         await self._save_header()
 
     async def snap_remove(self, name: str) -> None:
-        """librbd snap_remove.  A preserved copy at snap X covers every
-        snapshot back to the previous copy; removing X must hand the copy
-        down to the newest surviving snapshot in that range (the
-        reference's SnapSet clone-overlap merge on snap trim), else older
-        snapshots would silently read newer data."""
+        """librbd snap_remove: per-object server-side snap trim — the OSD
+        drops the snap from each clone's coverage and deletes clones no
+        snapshot references anymore (the snap-trimmer, scoped to this
+        image's objects)."""
+        from ..client.rados import RadosError
+
         snap = self._snap_by_name(name)
-        remaining = [s for s in self.header["snaps"] if s["name"] != name]
-        older = [s for s in remaining if s["id"] < snap["id"]]
-        heir = older[-1] if older else None
         span = max(self.size, self.header.get("max_size", self.size))
         objects = (span + self.object_bytes - 1) // self.object_bytes
         for objno in range(objects):
-            src = self._snap_oid(objno, snap["id"])
             try:
-                data = await self.ioctx.read(src)
-            except Exception:
-                continue  # never preserved under this snap
-            if heir is not None:
-                heir_oid = self._snap_oid(objno, heir["id"])
-                try:
-                    await self.ioctx.stat(heir_oid)
-                except Exception:
-                    # heir has no own copy: it was covered by X's
-                    await self.ioctx.write_full(heir_oid, data)
-            await self.ioctx.remove(src)
-        self.header["snaps"] = remaining
+                await self.ioctx.snap_trim(self._data_oid(objno), snap["id"])
+            except RadosError as e:
+                if e.errno != -ENOENT:
+                    raise
+        self.header["snaps"] = [
+            s for s in self.header["snaps"] if s["name"] != name
+        ]
         await self._save_header()
