@@ -169,6 +169,9 @@ OPTIONS: dict[str, Option] = _opts(
     Option("debug_paxos", str, "1/5", A, ""),
     Option("debug_objectstore", str, "0/5", A, ""),
     # --- admin socket (src/common/admin_socket.h:106) -----------------------
+    Option("osd_tracing", bool, True, A,
+           "record spans through the EC data path (jaeger_tracing analog)",
+           runtime=True),
     Option("admin_socket", str, "", A,
            "unix socket path; empty disables the admin socket"),
     # --- tracing (src/common/tracer.h) --------------------------------------

@@ -67,12 +67,16 @@ class Tracer:
     null jspan)."""
 
     def __init__(self, service: str = "", enabled: bool = True, max_spans: int = 10000):
+        from collections import deque
+
         self.service = service
         self.enabled = enabled
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
-        self._spans: list[Span] = []
-        self._max = max_spans
+        # ring buffer: the NEWEST max_spans survive — an operator dumping
+        # traces to debug a current problem needs recent spans, not the
+        # daemon's boot-time history
+        self._spans: "deque[Span]" = deque(maxlen=max_spans)
 
     def start_span(self, name: str, parent: Span | None = None) -> Span:
         span = Span(
@@ -83,8 +87,7 @@ class Tracer:
         )
         if self.enabled:
             with self._lock:
-                if len(self._spans) < self._max:
-                    self._spans.append(span)
+                self._spans.append(span)
         return span
 
     def export(self) -> list[dict]:

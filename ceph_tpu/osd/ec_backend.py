@@ -35,6 +35,7 @@ import numpy as np
 
 from ..codec.base import EIO
 from ..codec.interface import EcError, ErasureCodeInterface
+from ..common.tracer import null_span
 from ..msg.messages import (
     MOSDECSubOpRead,
     MOSDECSubOpReadReply,
@@ -82,6 +83,8 @@ class Op:
     pending_commits: set[int] = field(default_factory=set)  # shard ids
     pin: object | None = None
     encoded: bool = False
+    # ec:write span (ECBackend::Op::trace); null span unless a tracer is on
+    trace: object = field(default_factory=lambda: null_span())
 
 
 @dataclass
@@ -111,6 +114,7 @@ class ReadOp:
     # recovery consumes the raw gathered shard streams instead of the
     # decoded extents; set by recover_object
     on_complete_raw: Callable[["ReadOp", set[int]], None] | None = None
+    trace: object = field(default_factory=lambda: null_span())  # ec:read span
 
 
 RECOVERY_IDLE = "IDLE"
@@ -130,6 +134,7 @@ class RecoveryOp:
     shard_data: dict[int, bytes] = field(default_factory=dict)
     attrs: dict[str, bytes] = field(default_factory=dict)
     pending_pushes: set[int] = field(default_factory=set)
+    trace: object = field(default_factory=lambda: null_span())  # ec:recover
 
 
 class ECBackend(PGBackend):
@@ -161,6 +166,19 @@ class ECBackend(PGBackend):
         self._projected: dict[str, dict] = {}  # oid -> {size, hinfo, refs}
 
     # -- helpers -------------------------------------------------------------
+
+    def _span(self, name: str, parent=None):
+        """Start a span on the daemon tracer (the ZTracer::Trace threaded
+        through every handle_sub_* in the reference, ECBackend.h:64-87);
+        harnesses without a tracer get no-op spans."""
+        tracer = getattr(self.listener, "tracer", None)
+        if tracer is None:
+            from ..common.tracer import NULL_TRACER
+
+            tracer = NULL_TRACER
+        if parent is not None:
+            return parent.child(name)
+        return tracer.start_span(name)
 
     def _next_tid(self) -> int:
         self._tid += 1
@@ -261,7 +279,11 @@ class ECBackend(PGBackend):
             on_commit=on_commit,
             on_failure=on_failure,
             obj_size=obj_size,
+            trace=self._span("ec:write"),
         )
+        op.trace.keyval("oid", pgt.oid)
+        op.trace.keyval("tid", tid)
+        op.trace.event("start ec write")
         if proj is None:
             proj = self._projected[pgt.oid] = {
                 "size": obj_size,
@@ -302,6 +324,8 @@ class ECBackend(PGBackend):
         )
         self._kick_waiting_reads()
         for o in doomed:
+            o.trace.event(f"aborted: rmw read failed ({err})")
+            o.trace.finish()
             if o.on_failure is not None:
                 o.on_failure(err)
 
@@ -310,6 +334,7 @@ class ECBackend(PGBackend):
         # order — an earlier un-encoded op may still change the bytes (and
         # hinfo chain) this op depends on.
         if self._blocked_by_earlier(op):
+            op.trace.event("waiting on earlier write to same object")
             self.waiting_reads.append(op)
             return
         if not op.plan.to_read:
@@ -332,8 +357,10 @@ class ECBackend(PGBackend):
             else:
                 need.setdefault(op.pgt.oid, []).append((off, ln))
         if not need:
+            op.trace.event("rmw inputs served from extent cache")
             self._encode_and_dispatch(op)
             return
+        op.trace.event("issue rmw reads")
 
         def _on_read(results: dict) -> None:
             err, extents = results[op.pgt.oid]
@@ -348,7 +375,7 @@ class ECBackend(PGBackend):
                 op.read_results[off] = data
             self._encode_and_dispatch(op)
 
-        self.objects_read_and_reconstruct(need, _on_read)
+        self.objects_read_and_reconstruct(need, _on_read, parent_span=op.trace)
 
     def _encode_and_dispatch(self, op: Op) -> None:
         """try_reads_to_commit (ECBackend.cc:1982): encode, pin, fan out."""
@@ -372,6 +399,7 @@ class ECBackend(PGBackend):
             op.version.version,
         )
         op.encoded = True
+        op.trace.event("encoded")
         if proj is not None:
             proj["hinfo"] = new_hinfo
             proj["hinfo_known"] = True
@@ -419,6 +447,7 @@ class ECBackend(PGBackend):
                     ),
                 )
             )
+        op.trace.event(f"sub-writes dispatched to {len(sends)} shards")
         for osd, msg in sends:
             self.listener.send_shard(osd, msg)
         # Unblock readers that were waiting on our pin.
@@ -452,12 +481,15 @@ class ECBackend(PGBackend):
         if op is None:
             return
         op.pending_commits.discard(msg.pgid.shard)
+        op.trace.event(f"commit from shard {msg.pgid.shard}")
         if not op.pending_commits:
             del self.in_flight[op.tid]
             if op.pin is not None:
                 self.extent_cache.release_pin(op.pin)
             self._unref_projected(op.pgt.oid)
             self._kick_waiting_reads()
+            op.trace.event("all shards committed")
+            op.trace.finish()
             op.on_commit()
 
     # -- read path (§3.1 reads / §3.2 gather) --------------------------------
@@ -470,6 +502,7 @@ class ECBackend(PGBackend):
         want_attrs: bool = False,
         on_complete_raw: Callable[[ReadOp, set[int]], None] | None = None,
         want_shards: set[int] | None = None,
+        parent_span=None,
     ) -> None:
         """Client/RMW/recovery reads with reconstruction
         (ECBackend.cc:2389).  on_complete receives
@@ -495,9 +528,14 @@ class ECBackend(PGBackend):
             if want_shards is not None
             else {chunk_index(i) for i in range(self.k)}
         )
+        trace = self._span("ec:read", parent=parent_span)
+        trace.keyval("oids", ",".join(sorted(reads)))
+        trace.keyval("tid", tid)
         try:
             minimum = self.ec.minimum_to_decode(want, avail)
         except EcError:
+            trace.event("not decodable from available shards")
+            trace.finish()
             on_complete({oid: (-EIO, []) for oid in reads})
             return
         sub_count = self.ec.get_sub_chunk_count()
@@ -512,6 +550,7 @@ class ECBackend(PGBackend):
             subchunks={s: list(minimum.get(s, [(0, sub_count)])) for s in sources},
             on_complete=on_complete,
             on_complete_raw=on_complete_raw,
+            trace=trace,
         )
         self.read_ops[tid] = rop
         self._send_reads(rop, sources)
@@ -554,6 +593,7 @@ class ECBackend(PGBackend):
                     ),
                 )
             )
+        rop.trace.event(f"sub-reads to shards {sorted(shards)}")
         for osd, msg in sends:
             self.listener.send_shard(osd, msg)
 
@@ -625,6 +665,10 @@ class ECBackend(PGBackend):
         if rop is None:
             return
         shard = msg.pgid.shard
+        rop.trace.event(
+            f"reply from shard {shard}"
+            + (f" with errors {sorted(msg.errors)}" if msg.errors else "")
+        )
         if msg.errors:
             rop.errors.setdefault(shard, set()).update(msg.errors)
         if msg.buffers:
@@ -662,6 +706,7 @@ class ECBackend(PGBackend):
                 set.intersection(*(self._available_shards(o) for o in rop.requests))
                 - set(rop.errors)
             )
+            rop.trace.event("fragment plan voided; full-chunk fallback")
             rop.replies.clear()
             rop.subchunks = {s: [(0, sub_count)] for s in avail}
             self._send_reads(rop, avail)
@@ -681,11 +726,16 @@ class ECBackend(PGBackend):
             - rop.tried
         )
         if remaining:
+            rop.trace.event(
+                f"redundant-read escalation to shards {sorted(remaining)}"
+            )
             for s in remaining:
                 rop.subchunks[s] = [(0, sub_count)]
             self._send_reads(rop, remaining)
             return
         del self.read_ops[rop.tid]
+        rop.trace.event("read failed: no decodable shard set")
+        rop.trace.finish()
         rop.on_complete({oid: (-EIO, []) for oid in rop.requests})
 
     def _decodable(self, want: set[int], have: set[int]) -> bool:
@@ -697,14 +747,32 @@ class ECBackend(PGBackend):
 
     def _complete_read_op(self, rop: ReadOp, good: set[int]) -> None:
         if rop.on_complete_raw is not None:
+            rop.trace.event("raw shard streams handed to recovery")
+            rop.trace.finish()
             rop.on_complete_raw(rop, good)
             return
         results: dict[str, tuple[int, list[bytes]]] = {}
-        for oid, req in rop.requests.items():
-            try:
-                results[oid] = (0, self._reconstruct_object(rop, oid, req, good))
-            except EcError as e:
-                results[oid] = (e.errno, [])
+
+        def reconstruct_all() -> None:
+            for oid, req in rop.requests.items():
+                try:
+                    results[oid] = (
+                        0,
+                        self._reconstruct_object(rop, oid, req, good),
+                    )
+                except EcError as e:
+                    results[oid] = (e.errno, [])
+
+        if not rop.want <= good:
+            # decode path: spans make the degraded read visible end to end
+            with rop.trace.child("ec:reconstruct") as sp:
+                sp.keyval("have", ",".join(map(str, sorted(good))))
+                sp.keyval("want", ",".join(map(str, sorted(rop.want))))
+                reconstruct_all()
+        else:
+            reconstruct_all()
+        rop.trace.event("read complete")
+        rop.trace.finish()
         rop.on_complete(results)
 
     def _reconstruct_object(
@@ -745,7 +813,14 @@ class ECBackend(PGBackend):
         self, oid: str, missing_on: set[int], on_complete: Callable[[int], None]
     ) -> None:
         """Primary-only: rebuild `missing_on` shards (run_recovery_op)."""
-        rec = RecoveryOp(oid=oid, missing_on=set(missing_on), on_complete=on_complete)
+        rec = RecoveryOp(
+            oid=oid,
+            missing_on=set(missing_on),
+            on_complete=on_complete,
+            trace=self._span("ec:recover"),
+        )
+        rec.trace.keyval("oid", oid)
+        rec.trace.keyval("missing_on", ",".join(map(str, sorted(missing_on))))
         self.recovery_ops[oid] = rec
         self._continue_recovery(rec)
 
@@ -756,9 +831,13 @@ class ECBackend(PGBackend):
             avail = self._available_shards(rec.oid)
             want = set(rec.missing_on)
 
+            rec.trace.event("gather surviving shards")
+
             def _on_fail(results: dict) -> None:
                 err, _ = results[rec.oid]
                 del self.recovery_ops[rec.oid]
+                rec.trace.event(f"recovery read failed ({err})")
+                rec.trace.finish()
                 rec.on_complete(err or -EIO)
 
             self.objects_read_and_reconstruct(
@@ -770,6 +849,7 @@ class ECBackend(PGBackend):
                 ),
                 want_shards=want,
                 fast_read=False,
+                parent_span=rec.trace,
             )
 
     def _recovery_extent(self, oid: str, avail: set[int]) -> int:
@@ -826,10 +906,13 @@ class ECBackend(PGBackend):
                 rebuilt = {s: np.asarray(decoded[s]).tobytes() for s in want}
         except (EcError, KeyError) as e:
             del self.recovery_ops[rec.oid]
+            rec.trace.event(f"decode failed ({e})")
+            rec.trace.finish()
             rec.on_complete(getattr(e, "errno", -EIO))
             return
         rec.shard_data = rebuilt
         rec.state = RECOVERY_WRITING
+        rec.trace.event(f"decoded; pushing to shards {sorted(want)}")
         acting = self.listener.acting()
         version = 0
         if OI_ATTR in rec.attrs:
@@ -897,6 +980,8 @@ class ECBackend(PGBackend):
     def _finish_recovery(self, rec: RecoveryOp) -> None:
         rec.state = RECOVERY_COMPLETE
         del self.recovery_ops[rec.oid]
+        rec.trace.event("all pushes acked; recovered")
+        rec.trace.finish()
         self.listener.on_global_recover(rec.oid)
         rec.on_complete(0)
 

@@ -135,6 +135,20 @@ class OSD(Dispatcher):
         # internal (OSD-as-client) reads for COPY_FROM source fetches
         self._internal_tid = 0
         self._internal_reads: dict[int, object] = {}
+        # span tracer threaded through the EC data path (common/tracer.py;
+        # the reference's ZTracer/jaeger integration, dumped via the admin
+        # socket's `dump_tracer`)
+        from ..common.tracer import Tracer
+
+        self.tracer = Tracer(
+            f"osd.{whoami}", enabled=self.conf.get("osd_tracing")
+        )
+        # the option is runtime-mutable: flips must reach the live tracer
+        self.conf.add_observer(
+            ["osd_tracing"],
+            lambda _n, v: setattr(self.tracer, "enabled", bool(v)),
+        )
+        self.admin_socket = None
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
@@ -173,8 +187,48 @@ class OSD(Dispatcher):
         await self.monc.subscribe("mgrmap")
         await self.monc.subscribe("config")
         await self._send_boot()
+        await self._start_admin_socket()
         self._tasks.append(asyncio.create_task(self._op_worker()))
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+
+    async def _start_admin_socket(self) -> None:
+        """Daemon admin socket (AdminSocket::init): perf/config/trace/ops
+        introspection, enabled by the `admin_socket` path option."""
+        path = self.conf.get("admin_socket")
+        if not path:
+            return
+        from ..common.admin_socket import AdminSocket
+
+        sock = AdminSocket(path)
+        sock.register("perf dump", lambda cmd: self.perf.dump(),
+                      "dump perf counters")
+        sock.register("config show", lambda cmd: self.conf.show(),
+                      "dump current config")
+        sock.register("config diff", lambda cmd: self.conf.diff(),
+                      "config values differing from defaults")
+        sock.register(
+            "dump_tracer",
+            lambda cmd: {"spans": self.tracer.export()},
+            "dump collected trace spans (EC data path)",
+        )
+        sock.register(
+            "dump_ops_in_flight",
+            lambda cmd: {
+                "num_ops": sum(
+                    len(pg._inflight_reqids) for pg in self.pgs.values()
+                ),
+                "pgs": {
+                    repr(pg.pgid): sorted(
+                        f"{c}:{t}" for c, t in pg._inflight_reqids
+                    )
+                    for pg in self.pgs.values()
+                    if pg._inflight_reqids
+                },
+            },
+            "in-flight client writes",
+        )
+        await sock.start()
+        self.admin_socket = sock
 
     async def stop(self) -> None:
         self._running = False
@@ -182,6 +236,9 @@ class OSD(Dispatcher):
             t.cancel()
         self._tasks.clear()
         self._out_tasks.clear()
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         await self.msgr.shutdown()
         await self.monc.msgr.shutdown()
         self.store.umount()

@@ -225,6 +225,17 @@ class PG(PGListener):
     def whoami(self) -> int:
         return self.osd.whoami
 
+    @property
+    def tracer(self):
+        """The daemon tracer the EC backend threads spans through
+        (ECBackend.h:64-87 ZTracer::Trace parameters)."""
+        t = getattr(self.osd, "tracer", None)
+        if t is None:
+            from ..common.tracer import NULL_TRACER
+
+            t = NULL_TRACER
+        return t
+
     def whoami_shard(self) -> int:
         if self.pool.type != POOL_TYPE_ERASURE:
             return -1

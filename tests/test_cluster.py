@@ -220,3 +220,89 @@ class TestErasureCodedCluster:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestAdminSocketIntrospection:
+    def test_daemon_dumps_perf_config_and_traces(self, tmp_path):
+        """The OSD admin socket (AdminSocket::init) serves perf counters,
+        config, in-flight ops, and the EC data-path trace spans."""
+
+        async def run():
+            from ceph_tpu.common.admin_socket import admin_command
+            from ceph_tpu.mon import MonMap, Monitor
+
+            monmap = MonMap(addrs=free_port_addrs(1))
+            mons = [Monitor(n, monmap, election_timeout=0.3) for n in monmap.addrs]
+            for m in mons:
+                await m.start()
+                await m.wait_for_quorum()
+
+            def conf(i):
+                return Config(
+                    {
+                        "name": f"osd.{i}",
+                        "osd_heartbeat_interval": 0.1,
+                        "osd_heartbeat_grace": 0.6,
+                        "admin_socket": str(tmp_path / f"osd.{i}.asok"),
+                    },
+                    env=False,
+                )
+
+            from ceph_tpu.osd.osd import OSD
+
+            osds = [OSD(i, monmap, conf=conf(i)) for i in range(3)]
+            for o in osds:
+                await o.start()
+            for o in osds:
+                await o.wait_for_up()
+
+            client = Rados(monmap)
+            await client.connect()
+            rv, rs, _ = await client.mon_command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "ask21",
+                    "profile": ["k=2", "m=1", "plugin=tpu"],
+                }
+            )
+            assert rv == 0, rs
+            await client.pool_create("asok", "erasure", profile="ask21", pg_num=1)
+            ioctx = await client.open_ioctx("asok")
+            await ioctx.write_full("traced", b"T" * 8192)
+            assert await ioctx.read("traced") == b"T" * 8192
+
+            # find the PG's primary OSD: its tracer holds the write span
+            primary = next(
+                o
+                for o in osds
+                if any(p.peering.is_primary() for p in o.pgs.values())
+            )
+            sock = str(tmp_path / f"osd.{primary.whoami}.asok")
+
+            # run the blocking unix-socket client off the event loop
+            loop = asyncio.get_event_loop()
+            dump = await loop.run_in_executor(
+                None, lambda: admin_command(sock, "dump_tracer")
+            )
+            names = [s["name"] for s in dump["spans"]]
+            assert "ec:write" in names and "ec:read" in names
+
+            perf = await loop.run_in_executor(
+                None, lambda: admin_command(sock, "perf dump")
+            )
+            assert perf["op"] >= 2
+
+            cfg = await loop.run_in_executor(
+                None, lambda: admin_command(sock, "config show")
+            )
+            assert cfg["osd_tracing"] is True
+
+            ops = await loop.run_in_executor(
+                None, lambda: admin_command(sock, "dump_ops_in_flight")
+            )
+            assert ops["num_ops"] == 0  # everything committed
+
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())

@@ -436,3 +436,80 @@ class TestReplicatedBackend:
         c.pump()
         # pull completes the primary, which was also the only target
         assert c.stores[0].read(coll, "obj", 0, 0) == data
+
+
+class TestTracing:
+    """The tracer threaded through the EC data path (ECBackend.h:64-87):
+    every op carries a span; a degraded read must leave a complete tree —
+    read span, shard events, and a reconstruct child."""
+
+    def _traced_cluster(self):
+        from ceph_tpu.common.tracer import Tracer
+
+        pool, profiles = ec_pool(2, 1)
+        cluster = Cluster(pool, profiles)
+        tracer = Tracer("osd.test")
+        cluster.listeners[cluster.acting_primary()].tracer = tracer
+        return cluster, tracer
+
+    def test_degraded_read_span_tree(self):
+        cluster, tracer = self._traced_cluster()
+        data = bytes(range(256)) * 64
+        cluster.write("obj", 0, data)
+        tracer.clear()
+
+        # shard 1 lost: the read must reconstruct
+        cluster.missing["obj"] = {1}
+        out = {}
+        cluster.primary.objects_read_and_reconstruct(
+            {"obj": [(0, len(data))]}, lambda r: out.update(r)
+        )
+        cluster.pump()
+        assert out["obj"][0] == 0 and out["obj"][1][0] == data
+
+        spans = {s["span_id"]: s for s in tracer.export()}
+        reads = [s for s in spans.values() if s["name"] == "ec:read"]
+        assert len(reads) == 1
+        read = reads[0]
+        assert read["end"] is not None  # finished
+        events = [e["name"] for e in read["events"]]
+        assert any(e.startswith("sub-reads to shards") for e in events)
+        assert any(e.startswith("reply from shard") for e in events)
+        assert "read complete" in events
+        # the decode ran under a child span linked to the read
+        recon = [s for s in spans.values() if s["name"] == "ec:reconstruct"]
+        assert len(recon) == 1
+        assert recon[0]["parent_id"] == read["span_id"]
+        assert recon[0]["end"] is not None
+        assert "1" not in recon[0]["tags"]["have"].split(",")
+
+    def test_write_span_commits_per_shard(self):
+        cluster, tracer = self._traced_cluster()
+        cluster.write("w", 0, b"x" * 8192)
+        spans = [s for s in tracer.export() if s["name"] == "ec:write"]
+        assert len(spans) == 1
+        events = [e["name"] for e in spans[0]["events"]]
+        assert "start ec write" in events
+        assert sum(1 for e in events if e.startswith("commit from shard")) == 3
+        assert "all shards committed" in events
+        assert spans[0]["end"] is not None
+
+    def test_recovery_span(self):
+        cluster, tracer = self._traced_cluster()
+        data = b"r" * 16384
+        cluster.write("rec", 0, data)
+        # wipe shard 2's store copy, then recover it
+        coll = shard_coll(cluster.pgid, 2)
+        cluster.stores[2].queue_transaction(Transaction().remove(coll, "rec"))
+        tracer.clear()
+        done = []
+        cluster.primary.recover_object("rec", {2}, done.append)
+        cluster.pump()
+        assert done == [0]
+        spans = [s for s in tracer.export() if s["name"] == "ec:recover"]
+        assert len(spans) == 1
+        events = [e["name"] for e in spans[0]["events"]]
+        assert "gather surviving shards" in events
+        assert any(e.startswith("decoded; pushing") for e in events)
+        assert "all pushes acked; recovered" in events
+        assert spans[0]["end"] is not None
