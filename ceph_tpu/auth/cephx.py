@@ -87,13 +87,22 @@ class CephxAuth:
 
     # -- client side (CephxClientHandler) --------------------------------------
 
-    async def client_auth(self, send_frame, recv_frame, peer: str = "") -> bytes:
-        """Run the client handshake over frame callables; returns the
-        session ticket.  Raises AuthError on rejection.
+    async def client_auth(
+        self, send_frame, recv_frame, peer: str = ""
+    ) -> tuple[bytes, bytes]:
+        """Run the client handshake over frame callables; returns
+        (session ticket, connection secret).  Raises AuthError on
+        rejection.
+
+        The connection secret is derived from the handshake transcript
+        (the reference's CephxConnectionHandler connection_secret) and
+        keys msgr2 secure mode.
 
         A ticket previously issued by `peer` rides in the request; if the
         server accepts it the challenge round-trip is skipped (the
         reference's ticket-based fast path, CephxTicketManager)."""
+        from ..msg.crypto import derive_session_key
+
         cached = self._tickets.get(peer, b"")
         if cached:
             # Ticket + proof-of-secret: possession of a (plaintext-carried)
@@ -112,7 +121,7 @@ class CephxAuth:
             if not hmac.compare_digest(confirm, _hmac(self.secret, cached)):
                 raise AuthError("server failed mutual auth on ticket path")
             self._tickets[peer] = ticket
-            return ticket
+            return ticket, derive_session_key(self.secret, cached, ts)
         if tag != TAG_AUTH_MORE:
             raise AuthError(f"server rejected auth request (tag {tag})")
         server_challenge = segs[0]
@@ -128,13 +137,18 @@ class CephxAuth:
             raise AuthError("server failed mutual auth (wrong service key?)")
         if peer:
             self._tickets[peer] = ticket
-        return ticket
+        return ticket, derive_session_key(
+            self.secret, server_challenge, client_challenge
+        )
 
     # -- server side (CephxServiceHandler) -------------------------------------
 
-    async def server_auth(self, send_frame, recv_frame) -> str:
-        """Run the server handshake; returns the authenticated entity
-        name.  Raises AuthError (after sending AUTH_BAD) on failure."""
+    async def server_auth(self, send_frame, recv_frame) -> tuple[str, bytes]:
+        """Run the server handshake; returns (authenticated entity name,
+        connection secret).  Raises AuthError (after sending AUTH_BAD) on
+        failure."""
+        from ..msg.crypto import derive_session_key
+
         tag, segs = await recv_frame()
         if tag != TAG_AUTH_REQUEST:
             await send_frame(TAG_AUTH_BAD, [b"expected auth request"])
@@ -155,7 +169,7 @@ class CephxAuth:
                 confirm = _hmac(secret, presented)
                 renewed = self.issue_ticket(entity)
                 await send_frame(TAG_AUTH_DONE, [confirm, renewed])
-                return entity
+                return entity, derive_session_key(secret, presented, ts)
         server_challenge = _secrets.token_bytes(CHALLENGE_LEN)
         if secret is None:
             # Don't leak which entities exist: issue a challenge anyway and
@@ -175,7 +189,9 @@ class CephxAuth:
         confirm = _hmac(secret, client_challenge, server_challenge)
         ticket = self.issue_ticket(entity)
         await send_frame(TAG_AUTH_DONE, [confirm, ticket])
-        return entity
+        return entity, derive_session_key(
+            secret, server_challenge, client_challenge
+        )
 
     # -- ticket proof helpers --------------------------------------------------
 

@@ -42,9 +42,16 @@ from ..osd.osdmap import PG_NONE, OSDMap, advance_map
 
 
 class Objecter(Dispatcher):
-    def __init__(self, name: str, monmap: MonMap):
+    def __init__(
+        self,
+        name: str,
+        monmap: MonMap,
+        auth=None,
+        secure: bool = False,
+        compress: bool = False,
+    ):
         self.name = name
-        self.msgr = Messenger(name)
+        self.msgr = Messenger(name, auth=auth, secure=secure, compress=compress)
         self.monc = MonClient(name, monmap, msgr=self.msgr)
         self.msgr.add_dispatcher_head(self)
         self.osdmap = OSDMap()

@@ -31,9 +31,23 @@ def _check(result: int, what: str) -> None:
 class Rados:
     """Cluster handle (librados::Rados)."""
 
-    def __init__(self, monmap: MonMap, name: str = "client.admin"):
+    def __init__(
+        self,
+        monmap: MonMap,
+        name: str = "client.admin",
+        secret: bytes | None = None,  # cephx key (rados_conf key equivalent)
+        secure: bool = False,
+        compress: bool = False,
+    ):
         self.name = name
-        self.objecter = Objecter(name, monmap)
+        auth = None
+        if secret is not None:
+            from ..auth.cephx import CephxAuth
+
+            auth = CephxAuth.for_client(name, secret)
+        self.objecter = Objecter(
+            name, monmap, auth=auth, secure=secure, compress=compress
+        )
         self._connected = False
 
     async def connect(self, timeout: float = 5.0) -> None:

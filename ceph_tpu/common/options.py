@@ -150,6 +150,13 @@ OPTIONS: dict[str, Option] = _opts(
            "injected delay seconds in delivery (global.yaml.in:1271)",
            runtime=True),
     Option("ms_dispatch_throttle_bytes", int, 100 << 20, A, ""),
+    Option("ms_secure", bool, False, A,
+           "require AES-GCM-encrypted sessions (ms_*_mode=secure analog); "
+           "needs a keyring for the cephx-derived session key"),
+    Option("ms_compress", bool, False, A,
+           "compress on-wire frames when the peer supports it"),
+    Option("keyring", str, "", A,
+           "keyring file for cephx (daemon identity + peer verification)"),
     # --- objectstore --------------------------------------------------------
     Option("osd_objectstore", str, "memstore", A,
            "objectstore backend: memstore | filestore | bluestore"),
@@ -169,14 +176,12 @@ OPTIONS: dict[str, Option] = _opts(
     Option("debug_paxos", str, "1/5", A, ""),
     Option("debug_objectstore", str, "0/5", A, ""),
     # --- admin socket (src/common/admin_socket.h:106) -----------------------
-    Option("osd_tracing", bool, True, A,
-           "record spans through the EC data path (jaeger_tracing analog)",
-           runtime=True),
     Option("admin_socket", str, "", A,
            "unix socket path; empty disables the admin socket"),
     # --- tracing (src/common/tracer.h) --------------------------------------
     Option("jaeger_tracing_enable", bool, False, A,
-           "record spans in the in-process tracer"),
+           "record spans through the EC data path in the in-process tracer "
+           "(default off, matching the reference)", runtime=True),
     # --- fault injection ----------------------------------------------------
     Option("heartbeat_inject_failure", float, 0.0, D,
            "seconds to pretend heartbeats fail (global.yaml.in:865)",

@@ -23,19 +23,27 @@ class Span:
     span_id: int
     parent_id: int | None
     name: str
+    # True when this span is in the export buffer.  event()/keyval() key
+    # off THIS, not the tracer's live flag: a runtime enable mid-op must
+    # not grow events on spans the dump will never show, nor attach
+    # exported children to unexported parents.
+    recorded: bool = False
     start: float = field(default_factory=time.monotonic)
     end: float | None = None
     events: list[tuple[float, str]] = field(default_factory=list)
     tags: dict[str, str] = field(default_factory=dict)
 
-    def event(self, name: str) -> None:
-        """blkin Trace::event."""
-        if self.tracer.enabled:
-            self.events.append((time.monotonic(), name))
+    def event(self, name) -> None:
+        """blkin Trace::event.  `name` may be a zero-arg callable so hot
+        paths skip f-string construction when tracing is off."""
+        if self.recorded:
+            self.events.append(
+                (time.monotonic(), name() if callable(name) else name)
+            )
 
     def keyval(self, key: str, val: object) -> None:
-        if self.tracer.enabled:
-            self.tags[key] = str(val)
+        if self.recorded:
+            self.tags[key] = str(val() if callable(val) else val)
 
     def child(self, name: str) -> "Span":
         return self.tracer.start_span(name, parent=self)
@@ -79,13 +87,17 @@ class Tracer:
         self._spans: "deque[Span]" = deque(maxlen=max_spans)
 
     def start_span(self, name: str, parent: Span | None = None) -> Span:
+        # children of unrecorded parents stay unrecorded (no dangling
+        # parent_id in the export after a mid-op enable flip)
+        record = self.enabled and (parent is None or parent.recorded)
         span = Span(
             tracer=self,
             span_id=next(self._ids),
             parent_id=parent.span_id if parent else None,
             name=name,
+            recorded=record,
         )
-        if self.enabled:
+        if record:
             with self._lock:
                 self._spans.append(span)
         return span

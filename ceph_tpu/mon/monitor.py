@@ -50,11 +50,26 @@ from ..common.errs import EAGAIN, EINVAL
 
 
 class Monitor(Dispatcher):
-    def __init__(self, name: str, monmap: MonMap, election_timeout: float = 0.5):
+    def __init__(
+        self,
+        name: str,
+        monmap: MonMap,
+        election_timeout: float = 0.5,
+        keyring=None,  # KeyRing enabling cephx on this mon's sessions
+        secure: bool = False,
+        compress: bool = False,
+    ):
         self.name = name
         self.monmap = monmap
         self.rank = monmap.rank_of(name)
-        self.msgr = Messenger(f"mon.{name}")
+        auth = None
+        if keyring is not None:
+            from ..auth.cephx import CephxAuth
+
+            auth = CephxAuth.for_daemon(f"mon.{name}", keyring)
+        self.msgr = Messenger(
+            f"mon.{name}", auth=auth, secure=secure, compress=compress
+        )
         self.msgr.default_policy = Policy.lossless_peer()
         self.elector = Elector(
             self.rank,

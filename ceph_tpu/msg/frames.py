@@ -93,3 +93,27 @@ async def read_frame(reader) -> Frame:
             if crc32c(seg) != crc:
                 raise FrameError(f"segment {i} crc mismatch")
     return Frame(tag, segments)
+
+
+def frame_from_bytes(buf: bytes) -> Frame:
+    """Parse one complete frame from a byte string (the secure/compressed
+    on-wire path decrypts whole records, then parses here).  Truncated
+    input raises FrameError, never struct.error."""
+    tag, flags, seg_lens = preamble_info(buf[:PREAMBLE_SIZE])
+    need = PREAMBLE_SIZE + sum(seg_lens)
+    if flags & FLAG_CRC_DATA:
+        need += 4 * len(seg_lens)
+    if len(buf) < need:
+        raise FrameError(f"frame body truncated ({len(buf)} < {need})")
+    off = PREAMBLE_SIZE
+    segments = []
+    for n in seg_lens:
+        segments.append(buf[off : off + n])
+        off += n
+    if flags & FLAG_CRC_DATA:
+        for i, seg in enumerate(segments):
+            (crc,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if crc32c(seg) != crc:
+                raise FrameError(f"segment {i} crc mismatch")
+    return Frame(tag, segments)

@@ -25,7 +25,22 @@ from dataclasses import dataclass
 
 from ..common.log import dout
 from ..common.throttle import AsyncThrottle
-from .frames import Frame, TAG_HELLO, TAG_KEEPALIVE, TAG_MESSAGE, read_frame, FrameError
+from .crypto import (
+    FLAG_COMPRESSED,
+    FLAG_SECURE,
+    OnWireError,
+    OnWireSession,
+    read_record,
+)
+from .frames import (
+    Frame,
+    TAG_HELLO,
+    TAG_KEEPALIVE,
+    TAG_MESSAGE,
+    frame_from_bytes,
+    read_frame,
+    FrameError,
+)
 from .message import Message, decode_message, encode_message
 
 
@@ -80,6 +95,9 @@ class Connection:
         self.peer_name = ""  # filled by hello exchange
         self.policy = policy
         self.auth_entity = ""  # authenticated peer (cephx server side)
+        # negotiated secure/compressed on-wire codec (crypto_onwire);
+        # None = legacy raw frames
+        self._onwire: OnWireSession | None = None
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._send_lock = asyncio.Lock()
@@ -102,8 +120,17 @@ class Connection:
 
     async def _connect(self) -> None:
         reader, writer = await asyncio.open_connection(*_split(self.peer_addr))
-        # hello: announce who we are (ProtocolV2 hello/ident phase)
-        hello = Frame(TAG_HELLO, [self.msgr.name.encode(), self.msgr.addr.encode()])
+        # hello: announce who we are + desired on-wire features
+        # (ProtocolV2 hello/ident phase; features negotiate like
+        # ProtocolV2's connection modes)
+        hello = Frame(
+            TAG_HELLO,
+            [
+                self.msgr.name.encode(),
+                self.msgr.addr.encode(),
+                bytes([self.msgr._feature_bits()]),
+            ],
+        )
         writer.write(hello.pack(self.msgr.crc_data))
         await writer.drain()
         try:
@@ -111,18 +138,40 @@ class Connection:
             if frame.tag != TAG_HELLO:
                 raise FrameError(f"expected hello, got tag {frame.tag}")
             self.peer_name = frame.segments[0].decode()
+            chosen = (
+                frame.segments[2][0]
+                if len(frame.segments) > 2 and frame.segments[2]
+                else 0
+            ) & self.msgr._feature_bits()
+            if self.msgr.secure and not chosen & FLAG_SECURE:
+                # we REQUIRE encryption (ms_mode=secure); a peer that
+                # cannot do it must not get a cleartext session
+                raise FrameError("peer does not support required secure mode")
+            session_key = b""
             if self.msgr.auth is not None:
                 # cephx handshake rides auth frames before the session
                 # opens (ProtocolV2 auth phase).  Bounded: an auth-less
                 # peer silently ignores auth frames, and an unbounded wait
                 # here would wedge the connection's send lock forever.
-                await asyncio.wait_for(
+                _ticket, session_key = await asyncio.wait_for(
                     self.msgr.auth.client_auth(
                         *_frame_io(reader, writer, self.msgr.crc_data),
                         peer=self.peer_addr,
                     ),
                     timeout=5.0,
                 )
+            # always (re)assign: a lossless reconnect may renegotiate a
+            # DIFFERENT feature set than the previous session
+            self._onwire = (
+                OnWireSession(
+                    session_key,
+                    secure=bool(chosen & FLAG_SECURE),
+                    compress=bool(chosen & FLAG_COMPRESSED),
+                    initiator=True,
+                )
+                if chosen
+                else None
+            )
         except Exception as e:
             # close the half-open socket and keep send_message's contract:
             # connection failures surface as ConnectionError
@@ -176,7 +225,10 @@ class Connection:
             frame = Frame(TAG_MESSAGE, [env, payload])
             try:
                 self.msgr._maybe_inject_fault()
-                self._writer.write(frame.pack(self.msgr.crc_data))
+                raw = frame.pack(self.msgr.crc_data)
+                if self._onwire is not None:
+                    raw = self._onwire.wrap(raw)
+                self._writer.write(raw)
                 await self._writer.drain()
             except (ConnectionError, OSError):
                 self._fault()
@@ -187,7 +239,11 @@ class Connection:
     async def _read_loop(self) -> None:
         try:
             while not self._closed:
-                frame = await read_frame(self._reader)
+                if self._onwire is not None:
+                    body = await read_record(self._reader)
+                    frame = frame_from_bytes(self._onwire.unwrap(body))
+                else:
+                    frame = await read_frame(self._reader)
                 self.msgr._maybe_inject_fault()
                 if frame.tag == TAG_KEEPALIVE:
                     continue
@@ -200,6 +256,7 @@ class Connection:
             ConnectionError,
             OSError,
             FrameError,
+            OnWireError,
             asyncio.CancelledError,
         ):
             if not self._closed:
@@ -239,10 +296,19 @@ class Messenger:
         inject_socket_failures: int = 0,
         dispatch_throttle_bytes: int = 0,
         auth=None,  # CephxAuth (src/auth/cephx); None = auth_none
+        secure: bool = False,  # AES-GCM sessions (ms_mode=secure)
+        compress: bool = False,  # on-wire frame compression
     ):
         self.name = name  # entity name, e.g. "osd.0"
         self.addr = addr  # host:port once bound (or for identification)
         self.crc_data = crc_data
+        if secure and auth is None:
+            raise ValueError(
+                "ms_secure requires cephx auth (the session key comes from "
+                "the handshake, crypto_onwire.cc)"
+            )
+        self.secure = secure
+        self.compress = compress
         self.inject_socket_failures = inject_socket_failures
         self._rng = random.Random(hash(name) & 0xFFFF)
         self.dispatchers: list[Dispatcher] = []
@@ -305,6 +371,11 @@ class Messenger:
         if existing is conn:
             del self._conns[conn.peer_addr]
 
+    def _feature_bits(self) -> int:
+        return (FLAG_SECURE if self.secure else 0) | (
+            FLAG_COMPRESSED if self.compress else 0
+        )
+
     async def _accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
@@ -315,14 +386,29 @@ class Messenger:
                 return
             conn = Connection(self, frame.segments[1].decode(), Policy.stateless_server())
             conn.peer_name = frame.segments[0].decode()
-            reply = Frame(TAG_HELLO, [self.name.encode(), self.addr.encode()])
+            peer_feat = (
+                frame.segments[2][0]
+                if len(frame.segments) > 2 and frame.segments[2]
+                else 0
+            )
+            chosen = peer_feat & self._feature_bits()
+            if self.secure and not chosen & FLAG_SECURE:
+                # encryption is required on this endpoint; no cleartext
+                # fallback for a non-secure peer
+                writer.close()
+                return
+            reply = Frame(
+                TAG_HELLO,
+                [self.name.encode(), self.addr.encode(), bytes([chosen])],
+            )
             writer.write(reply.pack(self.crc_data))
             await writer.drain()
+            session_key = b""
             if self.auth is not None:
                 try:
                     # Bounded like the client side: a stalled peer must not
                     # pin this accept task (and its socket) forever.
-                    conn.auth_entity = await asyncio.wait_for(
+                    conn.auth_entity, session_key = await asyncio.wait_for(
                         self.auth.server_auth(
                             *_frame_io(reader, writer, self.crc_data)
                         ),
@@ -331,11 +417,27 @@ class Messenger:
                 except Exception:  # AuthError, timeout, protocol noise
                     writer.close()
                     return
+            if chosen:
+                conn._onwire = OnWireSession(
+                    session_key,
+                    secure=bool(chosen & FLAG_SECURE),
+                    compress=bool(chosen & FLAG_COMPRESSED),
+                    initiator=False,
+                )
             await conn._attach(reader, writer)
             self._accepted.append(conn)
             for d in self.dispatchers:
                 d.ms_handle_accept(conn)
-        except (FrameError, OSError, asyncio.IncompleteReadError):
+        except (
+            FrameError,
+            OSError,
+            asyncio.IncompleteReadError,
+            # malformed hellos (missing segments, non-UTF-8 names) must
+            # close the socket, not kill the accept task
+            IndexError,
+            UnicodeDecodeError,
+            ValueError,
+        ):
             writer.close()
 
     # -- delivery ------------------------------------------------------------

@@ -171,14 +171,11 @@ class ECBackend(PGBackend):
         """Start a span on the daemon tracer (the ZTracer::Trace threaded
         through every handle_sub_* in the reference, ECBackend.h:64-87);
         harnesses without a tracer get no-op spans."""
-        tracer = getattr(self.listener, "tracer", None)
-        if tracer is None:
-            from ..common.tracer import NULL_TRACER
+        from ..common.tracer import NULL_TRACER
 
-            tracer = NULL_TRACER
         if parent is not None:
             return parent.child(name)
-        return tracer.start_span(name)
+        return (getattr(self.listener, "tracer", None) or NULL_TRACER).start_span(name)
 
     def _next_tid(self) -> int:
         self._tid += 1
@@ -529,7 +526,7 @@ class ECBackend(PGBackend):
             else {chunk_index(i) for i in range(self.k)}
         )
         trace = self._span("ec:read", parent=parent_span)
-        trace.keyval("oids", ",".join(sorted(reads)))
+        trace.keyval("oids", lambda: ",".join(sorted(reads)))
         trace.keyval("tid", tid)
         try:
             minimum = self.ec.minimum_to_decode(want, avail)
@@ -593,7 +590,7 @@ class ECBackend(PGBackend):
                     ),
                 )
             )
-        rop.trace.event(f"sub-reads to shards {sorted(shards)}")
+        rop.trace.event(lambda: f"sub-reads to shards {sorted(shards)}")
         for osd, msg in sends:
             self.listener.send_shard(osd, msg)
 
@@ -666,7 +663,7 @@ class ECBackend(PGBackend):
             return
         shard = msg.pgid.shard
         rop.trace.event(
-            f"reply from shard {shard}"
+            lambda: f"reply from shard {shard}"
             + (f" with errors {sorted(msg.errors)}" if msg.errors else "")
         )
         if msg.errors:

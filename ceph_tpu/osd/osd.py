@@ -93,6 +93,7 @@ class OSD(Dispatcher):
         conf: Config | None = None,
         store=None,
         addr: str = "127.0.0.1:0",
+        auth=None,  # CephxAuth; built from the `keyring` option when unset
     ):
         self.whoami = whoami
         self.monmap = monmap
@@ -104,13 +105,27 @@ class OSD(Dispatcher):
 
             self.store = make_store(self.conf)
         self._bind_addr = addr
-        self.msgr = Messenger(
-            f"osd.{whoami}",
+        if auth is None and self.conf.get("keyring"):
+            from ..auth.cephx import CephxAuth
+            from ..auth.keyring import KeyRing
+
+            auth = CephxAuth.for_daemon(
+                f"osd.{whoami}", KeyRing.load(self.conf.get("keyring"))
+            )
+        msgr_kw = dict(
             crc_data=self.conf.get("ms_crc_data"),
             inject_socket_failures=self.conf.get("ms_inject_socket_failures"),
+            auth=auth,
+            secure=self.conf.get("ms_secure"),
+            compress=self.conf.get("ms_compress"),
         )
+        self.msgr = Messenger(f"osd.{whoami}", **msgr_kw)
         self.msgr.default_policy = Policy.lossless_peer()
-        self.monc = MonClient(f"osd.{whoami}", monmap)
+        self.monc = MonClient(
+            f"osd.{whoami}",
+            monmap,
+            msgr=Messenger(f"osd.{whoami}", **msgr_kw),
+        )
         self.osdmap = OSDMap()
         self.pgs: dict[tuple[int, int], PG] = {}
         self.sched = make_scheduler(self.conf.get("osd_op_queue"))
@@ -141,11 +156,11 @@ class OSD(Dispatcher):
         from ..common.tracer import Tracer
 
         self.tracer = Tracer(
-            f"osd.{whoami}", enabled=self.conf.get("osd_tracing")
+            f"osd.{whoami}", enabled=self.conf.get("jaeger_tracing_enable")
         )
         # the option is runtime-mutable: flips must reach the live tracer
         self.conf.add_observer(
-            ["osd_tracing"],
+            ["jaeger_tracing_enable"],
             lambda _n, v: setattr(self.tracer, "enabled", bool(v)),
         )
         self.admin_socket = None

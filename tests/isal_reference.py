@@ -1,0 +1,147 @@
+"""Independent scalar re-derivation of ISA-L's erasure-code math.
+
+This module is the "foreign" oracle for byte-parity tests
+(tests/test_isal_golden.py): it implements GF(2^8) arithmetic and the
+ISA-L matrix/encode algorithms from the PUBLISHED spec (isa-l ec_base.c:
+gf_mul/gf_inv/gf_gen_rs_matrix/gf_gen_cauchy1_matrix/gf_invert_matrix/
+ec_encode_data) using a deliberately different mechanism from
+ceph_tpu.gf — carry-less "Russian peasant" polynomial multiplication and
+pure-Python scalar loops, no log/exp tables, no numpy — so a systematic
+error in the production tables cannot hide by matching itself.
+
+No code is shared with ceph_tpu; importing it here would defeat the
+point.  The reference plugin's contract is that its chunks equal
+ISA-L's (/root/reference/src/erasure-code/isa/ErasureCodeIsa.cc:129
+ec_encode_data); these vectors stand in for an ISA-L build, which this
+image does not have.
+"""
+
+POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1, isa-l ec_base's field
+
+
+def gf_mul(a: int, b: int) -> int:
+    """Carry-less multiply + reduction (peasant algorithm)."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        a <<= 1
+        if a & 0x100:
+            a ^= POLY
+        b >>= 1
+    return r
+
+
+def gf_pow(a: int, n: int) -> int:
+    r = 1
+    for _ in range(n):
+        r = gf_mul(r, a)
+    return r
+
+
+def gf_inv(a: int) -> int:
+    """Exhaustive inverse — O(256) but unarguable."""
+    if a == 0:
+        raise ZeroDivisionError("gf_inv(0)")
+    for b in range(1, 256):
+        if gf_mul(a, b) == 1:
+            return b
+    raise AssertionError("field element without inverse")
+
+
+def gen_rs_matrix(k: int, m: int) -> list[list[int]]:
+    """isa-l gf_gen_rs_matrix(a, k+m, k): identity over geometric rows of
+    gen = 2^i (row 0 of the parity block is all ones)."""
+    a = [[1 if i == j else 0 for j in range(k)] for i in range(k)]
+    gen = 1
+    for _ in range(m):
+        p, row = 1, []
+        for _ in range(k):
+            row.append(p)
+            p = gf_mul(p, gen)
+        a.append(row)
+        gen = gf_mul(gen, 2)
+    return a
+
+
+def gen_cauchy1_matrix(k: int, m: int) -> list[list[int]]:
+    """isa-l gf_gen_cauchy1_matrix: parity[i][j] = 1 / ((k+i) ^ j)."""
+    a = [[1 if i == j else 0 for j in range(k)] for i in range(k)]
+    for i in range(k, k + m):
+        a.append([gf_inv(i ^ j) for j in range(k)])
+    return a
+
+
+def invert_matrix(mat: list[list[int]]) -> list[list[int]] | None:
+    """isa-l gf_invert_matrix: Gauss-Jordan with partial pivot."""
+    n = len(mat)
+    aug = [list(row) + [1 if i == j else 0 for j in range(n)]
+           for i, row in enumerate(mat)]
+    for col in range(n):
+        pivot = next((r for r in range(col, n) if aug[r][col]), None)
+        if pivot is None:
+            return None
+        aug[col], aug[pivot] = aug[pivot], aug[col]
+        inv_p = gf_inv(aug[col][col])
+        aug[col] = [gf_mul(x, inv_p) for x in aug[col]]
+        for r in range(n):
+            if r != col and aug[r][col]:
+                f = aug[r][col]
+                aug[r] = [x ^ gf_mul(f, y) for x, y in zip(aug[r], aug[col])]
+    return [row[n:] for row in aug]
+
+
+def encode(coding_rows: list[list[int]], data: list[bytes]) -> list[bytes]:
+    """isa-l ec_encode_data, scalar: parity[p][x] = XOR_j c[p][j]*d[j][x]."""
+    out = []
+    for row in coding_rows:
+        buf = bytearray(len(data[0]))
+        for coeff, chunk in zip(row, data):
+            if coeff == 0:
+                continue
+            if coeff == 1:
+                for x, byte in enumerate(chunk):
+                    buf[x] ^= byte
+            else:
+                for x, byte in enumerate(chunk):
+                    buf[x] ^= gf_mul(coeff, byte)
+        out.append(bytes(buf))
+    return out
+
+
+def decode_matrix(
+    dist: list[list[int]], erasures: list[int], k: int
+) -> tuple[list[list[int]], list[int]]:
+    """ErasureCodeIsa.cc:255-297 decode assembly: invert the survivor
+    submatrix; erased-data rows come straight from the inverse, erased-
+    parity rows re-encode through it."""
+    erased = set(erasures)
+    survivors = [r for r in range(len(dist)) if r not in erased][:k]
+    sub = [dist[r] for r in survivors]
+    inv = invert_matrix(sub)
+    if inv is None:
+        raise AssertionError("singular survivor matrix")
+    rows = []
+    for e in erasures:
+        if e < k:
+            rows.append(inv[e])
+        else:
+            # erased parity: its dist row applied to the decoded data
+            row = [0] * k
+            for j in range(k):
+                acc = 0
+                for x in range(k):
+                    acc ^= gf_mul(dist[e][x], inv[x][j])
+                row[j] = acc
+            rows.append(row)
+    return rows, survivors
+
+
+def lcg_bytes(n: int, seed: int) -> bytes:
+    """Deterministic test data with no numpy dependency (musl LCG)."""
+    out = bytearray(n)
+    state = seed & 0xFFFFFFFF
+    for i in range(n):
+        state = (state * 1103515245 + 12345) & 0xFFFFFFFF
+        out[i] = (state >> 16) & 0xFF
+    return bytes(out)

@@ -77,9 +77,14 @@ class TestCephxProtocol:
         secret = kr.add("client.admin")
         server = CephxAuth("mon.a", kr.add("mon.a"), keyring=kr)
         client = CephxAuth.for_client("client.admin", secret)
-        ticket, entity = run_handshake(client, server)
+        (ticket, client_key), (entity, server_key) = run_handshake(
+            client, server
+        )
         assert entity == "client.admin"
         assert server.verify_ticket(ticket) == "client.admin"
+        # both ends derive the SAME connection secret from the transcript
+        # (crypto_onwire's session key); 16 bytes = AES-128
+        assert client_key == server_key and len(client_key) == 16
 
     def test_bad_key_rejected(self):
         kr = KeyRing()
@@ -215,20 +220,23 @@ class TestTicketFastPath:
                     return send, recv
 
             ch1 = Channel()
-            t1, e1 = await asyncio.gather(
+            (t1, k1c), (e1, k1s) = await asyncio.gather(
                 client.client_auth(*ch1.client_end(), peer="mon-addr"),
                 server.server_auth(*ch1.server_end()),
             )
             assert e1 == "client.admin" and ch1.rounds == 2  # full handshake
+            assert k1c == k1s
 
             ch2 = Channel()
-            t2, e2 = await asyncio.gather(
+            (t2, k2c), (e2, k2s) = await asyncio.gather(
                 client.client_auth(*ch2.client_end(), peer="mon-addr"),
                 server.server_auth(*ch2.server_end()),
             )
             assert e2 == "client.admin"
             assert ch2.rounds == 1  # ticket accepted: one client frame only
             assert server.verify_ticket(t2) == "client.admin"
+            # fresh connection secret per session, agreed by both ends
+            assert k2c == k2s and k2c != k1c
 
         asyncio.run(run())
 

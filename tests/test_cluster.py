@@ -244,6 +244,7 @@ class TestAdminSocketIntrospection:
                         "osd_heartbeat_interval": 0.1,
                         "osd_heartbeat_grace": 0.6,
                         "admin_socket": str(tmp_path / f"osd.{i}.asok"),
+                        "jaeger_tracing_enable": True,
                     },
                     env=False,
                 )
@@ -295,7 +296,7 @@ class TestAdminSocketIntrospection:
             cfg = await loop.run_in_executor(
                 None, lambda: admin_command(sock, "config show")
             )
-            assert cfg["osd_tracing"] is True
+            assert cfg["jaeger_tracing_enable"] is True
 
             ops = await loop.run_in_executor(
                 None, lambda: admin_command(sock, "dump_ops_in_flight")

@@ -8,9 +8,9 @@ fails on any byte difference, then decodes 1- and 2-erasure cases.  Any
 future change to matrix math, padding, or kernel layout that alters a chunk
 byte fails here — the regression baseline VERDICT round 1 asked for.
 
-True ISA-L foreign-byte parity remains environment-blocked (the isa-l
-submodule is not vendored in the reference checkout and no ISA-L build
-exists in this image); the frozen corpus pins our re-derivation instead.
+Foreign-byte parity vs ISA-L's math is covered by tests/test_isal_golden.py
+(an independent scalar re-derivation of ec_base, since no ISA-L build
+exists in this image); this corpus pins the full chunk layout on top.
 Regenerate deliberately with:
   python -m ceph_tpu.tools.ec_corpus --create --standard --base tests/corpus
 """
