@@ -31,16 +31,18 @@ BASELINE_CONFIGS = [
         "plugin": "tpu",
         "profile": {"k": "8", "m": "3", "technique": "cauchy"},
         "size": 1 << 20,
+        # headline config: encode + decode at EVERY erasure count
+        # (reference invocation: isa/README:36-47, decode e=1,2,3)
         "workloads": ("encode", "decode"),
-        # BASELINE.md: "encode + single-erasure decode" — one erasure keeps
-        # the XOR fast path in play, matching the reference invocation
-        "erasures": 1,
+        "erasure_counts": (1, 2, 3),
     },
     {
+        # "64K stripes in flight" (BASELINE.md config 3): batching depth,
+        # so the chunk is small (4 KiB) and the batch is what's measured
         "name": "rs_10_4_bulk_stripes",
         "plugin": "tpu",
         "profile": {"k": "10", "m": "4"},
-        "size": 1 << 20,
+        "size": 10 * 4096,  # 4 KiB chunks (chunk = size / k)
         "workloads": ("bulk",),
     },
     {
@@ -86,59 +88,80 @@ def run_bulk(ec, size: int, batch: int, iters: int) -> tuple[float, int]:
     return time.perf_counter() - t0, batch * k * chunk * iters
 
 
-def run_baseline(iterations: int) -> int:
+def run_baseline(iterations: int, out=None) -> int:
     import jax
 
     platform = jax.devices()[0].platform
-    batch = 1024 if platform == "tpu" else 32
+    # "64K stripes in flight" on real hardware; scaled down off-chip so the
+    # CPU sweep stays tractable
+    bulk_batch = 65536 if platform == "tpu" else 64
+
+    def emit(rec: dict) -> None:
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if out is not None:
+            out.write(line + "\n")
+            out.flush()
+
     for cfg in BASELINE_CONFIGS:
         for workload in cfg["workloads"]:
-            rec = {
-                "config": cfg["name"],
-                "plugin": cfg["plugin"],
-                "profile": cfg["profile"],
-                "workload": workload,
-                "platform": platform,
-            }
+            erasure_counts = (
+                cfg.get("erasure_counts", (cfg.get("erasures", 2),))
+                if workload == "decode"
+                else (None,)
+            )
+            argv = ["-p", cfg["plugin"], "-S", str(cfg["size"]),
+                    "-i", str(iterations)]
+            for kv in cfg["profile"].items():
+                argv += ["-P", f"{kv[0]}={kv[1]}"]
+            opts = ec_benchmark.build_parser().parse_args(argv)
             try:
-                argv = ["-p", cfg["plugin"], "-S", str(cfg["size"]),
-                        "-i", str(iterations)]
-                for kv in cfg["profile"].items():
-                    argv += ["-P", f"{kv[0]}={kv[1]}"]
-                opts = ec_benchmark.build_parser().parse_args(argv)
                 ec = ec_benchmark.make_codec(opts)
-                if workload == "encode":
-                    elapsed = ec_benchmark.run_encode(ec, opts)
-                    total = iterations * cfg["size"]
-                elif workload == "decode":
-                    opts.erasures = cfg.get(
-                        "erasures", min(2, ec.get_coding_chunk_count())
-                    )
-                    rec["erasures"] = opts.erasures
-                    elapsed = ec_benchmark.run_decode(ec, opts)
-                    total = iterations * cfg["size"]
-                elif workload == "repair":
-                    elapsed, bytes_read, bytes_repaired = (
-                        ec_benchmark.run_repair(ec, opts)
-                    )
-                    total = iterations * cfg["size"]
-                    rec["bytes_read"] = bytes_read
-                    rec["bytes_repaired"] = bytes_repaired
-                    rec["read_amplification"] = round(
-                        bytes_read / max(1, bytes_repaired), 3
-                    )
-                else:  # bulk
-                    elapsed, total = run_bulk(
-                        ec, cfg["size"], batch, iterations
-                    )
-                    rec["stripes_in_flight"] = batch
-                rec["seconds"] = round(elapsed, 6)
-                rec["MBps"] = round(total / max(elapsed, 1e-9) / 1e6, 1)
             except (Exception, SystemExit) as e:
-                # record failures, keep sweeping (run_decode/run_repair
-                # signal content mismatch via SystemExit)
-                rec["error"] = str(e)
-            print(json.dumps(rec))
+                emit({"config": cfg["name"], "workload": workload,
+                      "platform": platform, "error": str(e)})
+                continue
+            for nerr in erasure_counts:
+                rec = {
+                    "config": cfg["name"],
+                    "plugin": cfg["plugin"],
+                    "profile": cfg["profile"],
+                    "workload": workload,
+                    "platform": platform,
+                }
+                try:
+                    if workload == "encode":
+                        elapsed = ec_benchmark.run_encode(ec, opts)
+                        total = iterations * cfg["size"]
+                    elif workload == "decode":
+                        opts.erasures = min(
+                            nerr, ec.get_coding_chunk_count()
+                        )
+                        rec["erasures"] = opts.erasures
+                        elapsed = ec_benchmark.run_decode(ec, opts)
+                        total = iterations * cfg["size"]
+                    elif workload == "repair":
+                        elapsed, bytes_read, bytes_repaired = (
+                            ec_benchmark.run_repair(ec, opts)
+                        )
+                        total = iterations * cfg["size"]
+                        rec["bytes_read"] = bytes_read
+                        rec["bytes_repaired"] = bytes_repaired
+                        rec["read_amplification"] = round(
+                            bytes_read / max(1, bytes_repaired), 3
+                        )
+                    else:  # bulk
+                        elapsed, total = run_bulk(
+                            ec, cfg["size"], bulk_batch, max(2, iterations // 4)
+                        )
+                        rec["stripes_in_flight"] = bulk_batch
+                    rec["seconds"] = round(elapsed, 6)
+                    rec["MBps"] = round(total / max(elapsed, 1e-9) / 1e6, 1)
+                except (Exception, SystemExit) as e:
+                    # record failures, keep sweeping (run_decode/run_repair
+                    # signal content mismatch via SystemExit)
+                    rec["error"] = str(e)
+                emit(rec)
     return 0
 
 
@@ -160,10 +183,19 @@ def main(argv=None) -> int:
         help="run the five BASELINE.md configs instead of the grid",
     )
     p.add_argument("--iterations", type=int, default=8)
+    p.add_argument(
+        "--out", default="",
+        help="also append JSONL to this file (baseline mode only)",
+    )
     args = p.parse_args(argv)
 
     if args.baseline:
-        return run_baseline(args.iterations)
+        out = open(args.out, "a") if args.out else None
+        try:
+            return run_baseline(args.iterations, out=out)
+        finally:
+            if out is not None:
+                out.close()
 
     techniques = {
         "tpu": ["reed_sol_van", "cauchy"],
