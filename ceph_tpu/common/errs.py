@@ -11,3 +11,5 @@ EXDEV = 18
 ETIMEDOUT = 110
 ENODATA = 61
 ENXIO = 6
+ENOTDIR = 20
+ENOTEMPTY = 39

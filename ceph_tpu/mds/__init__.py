@@ -1,0 +1,6 @@
+"""MDS — the CephFS metadata server (mirror of src/mds)."""
+
+from .mds import MDS
+from .client import CephFSClient, FsClientError
+
+__all__ = ["MDS", "CephFSClient", "FsClientError"]

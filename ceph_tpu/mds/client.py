@@ -1,0 +1,249 @@
+"""CephFS client against a live MDS — mirror of src/client/Client.cc.
+
+Metadata ops go to the MDS over MClientRequest/MClientReply; file DATA
+I/O goes straight to the data pool through the striper using the layout
+the MDS handed back (Client.cc file_to_extents → Objecter) — the MDS is
+never in the data path.  Capabilities gate file access: open() acquires
+them, a revoke push (MClientCaps REVOKE, when another client wants a
+conflicting open) invalidates the handle, and the next use raises so the
+caller re-opens (the reference's cap-wait loop, surfaced as an explicit
+error in this async library).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ..common.errs import EAGAIN, EEXIST
+from ..msg.messages import MClientCaps, MClientReply, MClientRequest
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..striper import StripedObject, StripePolicy
+
+
+class FsClientError(Exception):
+    def __init__(self, err: int, msg: str = ""):
+        self.errno = -abs(err)
+        super().__init__(f"{msg} (errno {self.errno})")
+
+
+class FileHandle:
+    """An open file: inode record + held caps (the Fh/Inode pair)."""
+
+    def __init__(self, client: "CephFSClient", path: str, entry: dict, caps: str):
+        self.client = client
+        self.path = path
+        self.entry = entry
+        self.caps = caps
+        self.valid = True
+
+    def _require(self, need: str) -> None:
+        if not self.valid:
+            raise FsClientError(
+                EAGAIN, f"{self.path}: caps revoked; re-open the file"
+            )
+        if need == "w" and self.caps != "w":
+            raise FsClientError(EAGAIN, f"{self.path}: no write caps")
+
+    def _data(self) -> StripedObject:
+        lay = self.entry["layout"]
+        return StripedObject(
+            self.client.data,
+            f"{self.entry['ino']:x}",
+            StripePolicy(
+                stripe_unit=lay["stripe_unit"],
+                stripe_count=lay["stripe_count"],
+                object_size=lay["object_size"],
+            ),
+        )
+
+    async def write(self, data: bytes, off: int = 0) -> None:
+        self._require("w")
+        await self._data().write(data, off)
+        new_size = max(self.entry.get("size", 0), off + len(data))
+        if new_size != self.entry.get("size", 0):
+            # ino-addressed: a concurrent rename must not land this on a
+            # different file that now occupies our old path
+            rep = await self.client._request(
+                "setattr",
+                {"path": self.path, "ino": self.entry["ino"], "size": new_size},
+            )
+            self.entry = rep["entry"]
+
+    async def read(self, length: int = 0, off: int = 0) -> bytes:
+        self._require("r")
+        size = self.entry.get("size", 0)
+        if off >= size:
+            return b""
+        length = min(length or size - off, size - off)
+        return await self._data().read(length, off)
+
+    async def truncate(self, size: int) -> None:
+        """Shrink/extend: data objects truncate first, then the inode size
+        (Client::ll_truncate ordering — stale striped bytes must never
+        reappear on a later extension)."""
+        self._require("w")
+        await self._data().truncate(size)
+        rep = await self.client._request(
+            "setattr",
+            {"path": self.path, "ino": self.entry["ino"], "size": size},
+        )
+        self.entry = rep["entry"]
+
+    async def close(self) -> None:
+        if self.valid:
+            self.valid = False
+            ino = self.entry["ino"]
+            held = self.client._handles.get(ino)
+            if held is not None:
+                try:
+                    held.remove(self)
+                except ValueError:
+                    pass
+                if not held:
+                    del self.client._handles[ino]
+            await self.client._release_caps(ino)
+
+
+class CephFSClient(Dispatcher):
+    """libcephfs-like handle to one MDS + a data pool."""
+
+    def __init__(self, mds_addr: str, data_ioctx, name: str = "client.fs"):
+        self.mds_addr = mds_addr
+        self.data = data_ioctx
+        self.msgr = Messenger(name)
+        self.msgr.add_dispatcher_head(self)
+        self._tid = 0
+        self._replies: dict[int, asyncio.Future] = {}
+        self._handles: dict[int, list[FileHandle]] = {}  # ino -> open fhs
+
+    async def shutdown(self) -> None:
+        await self.msgr.shutdown()
+
+    # -- dispatch --------------------------------------------------------------
+
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MClientReply):
+            fut = self._replies.pop(msg.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return True
+        if isinstance(msg, MClientCaps) and msg.op == MClientCaps.REVOKE:
+            # the MDS wants these caps back: invalidate local handles and
+            # ack (Client::handle_caps revoke path; writes here are
+            # synchronous so there is nothing to flush)
+            for fh in self._handles.pop(msg.ino, []):
+                fh.valid = False
+            ack = MClientCaps(
+                op=MClientCaps.ACK, ino=msg.ino, caps="", tid=msg.tid
+            )
+
+            async def _ack() -> None:
+                try:
+                    await conn.send_message(ack)
+                except ConnectionError:
+                    pass
+
+            asyncio.get_event_loop().create_task(_ack())
+            return True
+        return False
+
+    async def _request(self, op: str, args: dict, timeout: float = 10.0) -> dict:
+        self._tid += 1
+        tid = self._tid
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._replies[tid] = fut
+        msg = MClientRequest(tid=tid, op=op, args=json.dumps(args).encode())
+        await self.msgr.send_to(self.mds_addr, msg)
+        try:
+            reply: MClientReply = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._replies.pop(tid, None)
+        if reply.result < 0:
+            raise FsClientError(reply.result, f"{op} {args}")
+        return json.loads(reply.payload.decode() or "{}")
+
+    async def _release_caps(self, ino: int) -> None:
+        rel = MClientCaps(op=MClientCaps.RELEASE, ino=ino, caps="", tid=0)
+        try:
+            await self.msgr.get_connection(self.mds_addr).send_message(rel)
+        except ConnectionError:
+            pass
+
+    # -- namespace -------------------------------------------------------------
+
+    async def mkdir(self, path: str) -> None:
+        await self._request("mkdir", {"path": path})
+
+    async def listdir(self, path: str = "/") -> list[str]:
+        return (await self._request("readdir", {"path": path}))["entries"]
+
+    async def stat(self, path: str) -> dict:
+        return (await self._request("lookup", {"path": path}))["entry"]
+
+    async def rename(self, src: str, dst: str) -> None:
+        rep = await self._request("rename", {"src": src, "dst": dst})
+        replaced = rep.get("replaced")
+        if replaced and replaced.get("type") == "file":
+            await self._purge(replaced)
+
+    async def rmdir(self, path: str) -> None:
+        await self._request("rmdir", {"path": path})
+
+    async def unlink(self, path: str) -> None:
+        rep = await self._request("unlink", {"path": path})
+        await self._purge(rep["entry"])
+
+    async def _purge(self, entry: dict) -> None:
+        """Delete a file's data objects (the client-driven purge the
+        reference delegates to the MDS PurgeQueue; same pool effect)."""
+        lay = entry.get("layout")
+        if not lay:
+            return
+        await StripedObject(
+            self.data,
+            f"{entry['ino']:x}",
+            StripePolicy(
+                stripe_unit=lay["stripe_unit"],
+                stripe_count=lay["stripe_count"],
+                object_size=lay["object_size"],
+            ),
+        ).remove()
+
+    # -- files -----------------------------------------------------------------
+
+    async def create(self, path: str) -> FileHandle:
+        rep = await self._request("create", {"path": path, "caps": "w"})
+        fh = FileHandle(self, path, rep["entry"], rep["caps"])
+        self._handles.setdefault(rep["entry"]["ino"], []).append(fh)
+        return fh
+
+    async def open(self, path: str, mode: str = "r") -> FileHandle:
+        rep = await self._request("open", {"path": path, "caps": mode})
+        fh = FileHandle(self, path, rep["entry"], rep["caps"])
+        self._handles.setdefault(rep["entry"]["ino"], []).append(fh)
+        return fh
+
+    # -- convenience (whole-file ops) ------------------------------------------
+
+    async def write_file(self, path: str, data: bytes) -> None:
+        try:
+            fh = await self.create(path)
+        except FsClientError as e:
+            if e.errno != -EEXIST:
+                raise
+            fh = await self.open(path, "w")
+        try:
+            if len(data) < fh.entry.get("size", 0):
+                await fh.truncate(len(data))
+            if data:
+                await fh.write(data, 0)
+        finally:
+            await fh.close()
+
+    async def read_file(self, path: str) -> bytes:
+        fh = await self.open(path, "r")
+        try:
+            return await fh.read()
+        finally:
+            await fh.close()

@@ -1,0 +1,534 @@
+"""MDS daemon — server-side CephFS metadata (mirror of src/mds).
+
+The reference's MDS (src/mds/MDSDaemon.cc, MDCache.cc 13.6k LoC,
+Server.cc) owns the namespace: clients send MClientRequest metadata ops;
+the MDS journals every mutation into the metadata pool BEFORE applying
+it (MDLog/Journaler — metadata is never lost to an MDS crash), caches
+dirfrags, writes them back lazily, and hands out **capabilities** so
+clients can do file DATA I/O straight to the data pool without the MDS
+in the loop.  This daemon keeps that architecture:
+
+- **Namespace**: one object per directory in the metadata pool
+  (`dir.<ino>` holding the dentry map, the CDir/CDentry/CInode dirfrag
+  commit shape) — the same on-pool layout as the client-only
+  fs.FileSystem library, so the two interoperate.
+- **Journal (MDLog)**: every mutation appends a JSON event to
+  `mds_journal` (RADOS append) before the reply is sent; dirty dirfrags
+  flush lazily (tick or size threshold), then the journal trims by
+  recording the flushed sequence in `mds_journal_head` and resetting the
+  journal object (Journaler::flush + trim semantics).  Startup replays
+  events past the flushed sequence — a crashed MDS loses nothing that
+  was acknowledged.
+- **Caps** (Capability.h / Locker.cc essence): open("w") needs an
+  exclusive grant per inode; open("r") shares with other readers.  A
+  conflicting open REVOKEs the holders' caps (MClientCaps REVOKE), waits
+  for their ACKs (bounded — a dead client's session reset also releases),
+  then grants.  File data I/O is client-direct; the MDS only brokers the
+  right to do it.
+- **Sessions**: one per client connection; a reset drops its caps and
+  unblocks waiters (Server::handle_client_session teardown).
+
+Single-active-MDS scope (rank 0); multi-MDS subtree partitioning
+(MDCache migrator) is out of scope and documented as such.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ..common.errs import EEXIST, EINVAL, ENOENT, ENOTDIR, ENOTEMPTY
+from ..common.log import dout
+from ..msg.messages import MClientCaps, MClientReply, MClientRequest
+from ..msg.messenger import Connection, Dispatcher, Messenger
+
+ROOT_INO = 1  # MDS_INO_ROOT
+INOTABLE_OID = "mds_inotable"
+JOURNAL_OID = "mds_journal"
+JOURNAL_HEAD_OID = "mds_journal_head"
+FLUSH_INTERVAL = 0.5
+JOURNAL_FLUSH_BYTES = 1 << 20
+REVOKE_TIMEOUT = 3.0  # mds_session_timeout scaled down
+
+
+class MDS(Dispatcher):
+    """One active metadata server (rank 0)."""
+
+    def __init__(self, meta_ioctx, data_ioctx, addr: str = "127.0.0.1:0",
+                 layout: dict | None = None):
+        self.meta = meta_ioctx
+        self.data = data_ioctx
+        self.layout = layout or {
+            "stripe_unit": 64 * 1024, "stripe_count": 2, "object_size": 1 << 20
+        }
+        self._bind_addr = addr
+        self.msgr = Messenger("mds.0")
+        self.msgr.add_dispatcher_head(self)
+        # dirfrag cache: ino -> {name: entry dict}; which are dirty
+        self._dirs: dict[int, dict] = {}
+        self._dirty: set[int] = set()
+        self._next_ino = 0
+        self._ino_dirty = False
+        self._journal_seq = 0
+        self._journal_bytes = 0
+        self._flush_task: asyncio.Task | None = None
+        self._running = False
+        # caps: ino -> {conn: "r"|"w"} ; waiters for revoke acks
+        self.caps: dict[int, dict[Connection, str]] = {}
+        # (ino, tid) -> {"ev", "want", "requester"}: grant waits pending
+        # on conflicting holders acking/releasing/dying
+        self._revoke_waiters: dict[tuple[int, int], dict] = {}
+        self._cap_tid = 0
+        # file ino -> (parent dir ino, dentry name): lets handle-held ops
+        # (setattr) address the INODE, immune to concurrent renames
+        self._ino_loc: dict[int, tuple[int, str]] = {}
+        self._lock = asyncio.Lock()  # one mutation at a time (the MDS big lock)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> None:
+        await self._load_or_mkfs()
+        await self._replay_journal()
+        await self.msgr.bind(self._bind_addr)
+        self.addr = self.msgr.addr
+        self._running = True
+        self._flush_task = asyncio.create_task(self._flush_loop())
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        await self._flush()
+        await self.msgr.shutdown()
+
+    async def _load_or_mkfs(self) -> None:
+        try:
+            table = json.loads((await self.meta.read(INOTABLE_OID)).decode())
+            self._next_ino = table["next"]
+        except Exception:
+            # fresh fs (ceph fs new): root dir + inotable
+            self._next_ino = 2
+            await self.meta.write_full(
+                INOTABLE_OID, json.dumps({"next": 2}).encode()
+            )
+            await self.meta.write_full(f"dir.{ROOT_INO}", b"{}")
+
+    # -- journal (MDLog) -------------------------------------------------------
+
+    async def _replay_journal(self) -> None:
+        """Apply journaled events past the flushed sequence (MDLog replay:
+        a crash between journal append and dirfrag write-back must lose
+        nothing that was acknowledged to a client)."""
+        flushed = 0
+        try:
+            head = json.loads((await self.meta.read(JOURNAL_HEAD_OID)).decode())
+            flushed = head.get("flushed", 0)
+        except Exception:
+            pass
+        try:
+            raw = await self.meta.read(JOURNAL_OID)
+        except Exception:
+            return
+        replayed = 0
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                ev = json.loads(line.decode())
+            except json.JSONDecodeError:
+                break  # torn tail: a partial append never acked, drop it
+            self._journal_seq = max(self._journal_seq, ev["seq"])
+            if ev["seq"] <= flushed:
+                continue
+            await self._apply_event(ev)
+            replayed += 1
+        if replayed:
+            dout("mds", 1, f"mds.0: replayed {replayed} journal events")
+            self._journal_bytes = len(raw)
+
+    async def _apply_event(self, ev: dict) -> None:
+        op = ev["op"]
+        if op == "set_dentry":
+            d = await self._dir(ev["dir"])
+            d[ev["name"]] = ev["entry"]
+            self._dirty.add(ev["dir"])
+            if ev["entry"].get("type") == "file":
+                self._ino_loc[ev["entry"]["ino"]] = (ev["dir"], ev["name"])
+        elif op == "rm_dentry":
+            d = await self._dir(ev["dir"])
+            gone = d.pop(ev["name"], None)
+            self._dirty.add(ev["dir"])
+            if gone and gone.get("type") == "file":
+                # a rename's set_dentry already retargeted the map: only
+                # drop it when it still points at the removed location
+                if self._ino_loc.get(gone["ino"]) == (ev["dir"], ev["name"]):
+                    del self._ino_loc[gone["ino"]]
+        elif op == "mkdir_obj":
+            self._dirs.setdefault(ev["ino"], {})
+            self._dirty.add(ev["ino"])
+        elif op == "rmdir_obj":
+            self._dirs.pop(ev["ino"], None)
+            self._dirty.discard(ev["ino"])
+            try:
+                await self.meta.remove(f"dir.{ev['ino']}")
+            except Exception:
+                pass
+        elif op == "inotable":
+            self._next_ino = ev["next"]
+            self._ino_dirty = True
+
+    async def _journal(self, *events: dict) -> None:
+        """Append events durably BEFORE applying/replying (MDLog::submit +
+        flush: the write-ahead property)."""
+        lines = []
+        for ev in events:
+            self._journal_seq += 1
+            ev["seq"] = self._journal_seq
+            lines.append(json.dumps(ev).encode() + b"\n")
+        blob = b"".join(lines)
+        await self.meta.append(JOURNAL_OID, blob)
+        self._journal_bytes += len(blob)
+        for ev in events:
+            await self._apply_event(ev)
+
+    async def _flush_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(FLUSH_INTERVAL)
+            try:
+                await self._flush()
+            except Exception as e:  # pool hiccup: retry next tick
+                dout("mds", 1, f"mds.0: flush failed: {e}")
+
+    async def _flush(self) -> None:
+        """Write back dirty dirfrags, then trim the journal
+        (Journaler::flush + LogSegment trim).  Runs under the big lock:
+        a mutation journaled between the dirty-set snapshot and the trim
+        would otherwise be cleared unwritten and trimmed — losing acked
+        metadata, the exact thing the journal exists to prevent."""
+        async with self._lock:
+            if not self._dirty and not self._ino_dirty:
+                return
+            for ino in sorted(self._dirty):
+                await self.meta.write_full(
+                    f"dir.{ino}", json.dumps(self._dirs.get(ino, {})).encode()
+                )
+            self._dirty.clear()
+            if self._ino_dirty:
+                await self.meta.write_full(
+                    INOTABLE_OID, json.dumps({"next": self._next_ino}).encode()
+                )
+                self._ino_dirty = False
+            await self.meta.write_full(
+                JOURNAL_HEAD_OID,
+                json.dumps({"flushed": self._journal_seq}).encode(),
+            )
+            await self.meta.write_full(JOURNAL_OID, b"")
+            self._journal_bytes = 0
+
+    # -- namespace helpers -----------------------------------------------------
+
+    async def _dir(self, ino: int) -> dict:
+        d = self._dirs.get(ino)
+        if d is None:
+            try:
+                raw = await self.meta.read(f"dir.{ino}")
+                d = json.loads(raw.decode() or "{}")
+            except Exception:
+                d = {}
+            self._dirs[ino] = d
+            for name, entry in d.items():
+                if entry.get("type") == "file":
+                    self._ino_loc.setdefault(entry["ino"], (ino, name))
+        return d
+
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        return [p for p in path.split("/") if p]
+
+    async def _walk(self, path: str) -> tuple[int, dict]:
+        ino = ROOT_INO
+        d = await self._dir(ino)
+        for part in self._split(path):
+            entry = d.get(part)
+            if entry is None:
+                raise _Err(ENOENT, f"{path}: no such entry {part!r}")
+            if entry["type"] != "dir":
+                raise _Err(ENOTDIR, f"{path}: {part!r} is a file")
+            ino = entry["ino"]
+            d = await self._dir(ino)
+        return ino, d
+
+    async def _walk_parent(self, path: str) -> tuple[int, dict, str]:
+        parts = self._split(path)
+        if not parts:
+            raise _Err(EINVAL, "root has no parent")
+        ino, d = await self._walk("/".join(parts[:-1]))
+        return ino, d, parts[-1]
+
+    # -- dispatch --------------------------------------------------------------
+
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MClientRequest):
+            asyncio.get_event_loop().create_task(self._handle(conn, msg))
+            return True
+        if isinstance(msg, MClientCaps):
+            if msg.op in (MClientCaps.ACK, MClientCaps.RELEASE):
+                # a revoke-ack IS the release of the revoked caps
+                self._drop_cap(msg.ino, conn)
+            return True
+        return False
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        """Session death releases its caps (Session teardown in Server.cc)."""
+        for ino in list(self.caps):
+            self._drop_cap(ino, conn)
+
+    def _drop_cap(self, ino: int, conn: Connection) -> None:
+        holders = self.caps.get(ino)
+        if holders and conn in holders:
+            del holders[conn]
+            if not holders:
+                del self.caps[ino]
+        self._check_grant_waiters(ino)
+
+    def _check_grant_waiters(self, ino: int) -> None:
+        """Wake grant waits whose conflicts are gone (acked, released, or
+        session-reset)."""
+        for (w_ino, _tid), w in list(self._revoke_waiters.items()):
+            if w_ino != ino:
+                continue
+            remaining = [
+                c
+                for c in self._conflicting_holders(ino, w["want"])
+                if c is not w["requester"]
+            ]
+            if not remaining:
+                w["ev"].set()
+
+    async def _handle(self, conn: Connection, msg: MClientRequest) -> None:
+        try:
+            args = json.loads(msg.args.decode() or "{}")
+            async with self._lock:
+                payload = await self._dispatch_op(conn, msg.op, args)
+            reply = MClientReply(
+                tid=msg.tid, result=0, payload=json.dumps(payload).encode()
+            )
+        except _Err as e:
+            reply = MClientReply(tid=msg.tid, result=e.errno, payload=b"{}")
+        except Exception as e:  # a server bug must not wedge the client
+            dout("mds", 0, f"mds.0: {msg.op} raised {e!r}")
+            reply = MClientReply(tid=msg.tid, result=-EINVAL, payload=b"{}")
+        try:
+            await conn.send_message(reply)
+        except ConnectionError:
+            pass
+
+    async def _dispatch_op(self, conn, op: str, args: dict) -> dict:
+        if op == "mkdir":
+            return await self._op_mkdir(args)
+        if op == "create":
+            return await self._op_create(conn, args)
+        if op == "lookup":
+            return await self._op_lookup(args)
+        if op == "readdir":
+            ino, d = await self._walk(args["path"])
+            return {"entries": sorted(d)}
+        if op == "unlink":
+            return await self._op_unlink(args)
+        if op == "rmdir":
+            return await self._op_rmdir(args)
+        if op == "rename":
+            return await self._op_rename(args)
+        if op == "setattr":
+            return await self._op_setattr(args)
+        if op == "open":
+            return await self._op_open(conn, args)
+        raise _Err(EINVAL, f"unknown mds op {op!r}")
+
+    async def _op_mkdir(self, args) -> dict:
+        pino, pdir, name = await self._walk_parent(args["path"])
+        if name in pdir:
+            raise _Err(EEXIST, f"{args['path']} exists")
+        ino = self._next_ino
+        entry = {"ino": ino, "type": "dir", "mtime": time.time()}
+        await self._journal(
+            {"op": "inotable", "next": ino + 1},
+            {"op": "mkdir_obj", "ino": ino},
+            {"op": "set_dentry", "dir": pino, "name": name, "entry": entry},
+        )
+        return {"ino": ino}
+
+    async def _op_create(self, conn, args) -> dict:
+        pino, pdir, name = await self._walk_parent(args["path"])
+        if name in pdir:
+            raise _Err(EEXIST, f"{args['path']} exists")
+        ino = self._next_ino
+        entry = {
+            "ino": ino,
+            "type": "file",
+            "size": 0,
+            "mtime": time.time(),
+            "layout": dict(self.layout),
+        }
+        await self._journal(
+            {"op": "inotable", "next": ino + 1},
+            {"op": "set_dentry", "dir": pino, "name": name, "entry": entry},
+        )
+        caps = await self._acquire_caps(conn, ino, args.get("caps", "w"))
+        return {"entry": entry, "caps": caps}
+
+    async def _op_lookup(self, args) -> dict:
+        pino, pdir, name = await self._walk_parent(args["path"])
+        entry = pdir.get(name)
+        if entry is None:
+            raise _Err(ENOENT, args["path"])
+        return {"entry": entry}
+
+    async def _op_unlink(self, args) -> dict:
+        pino, pdir, name = await self._walk_parent(args["path"])
+        entry = pdir.get(name)
+        if entry is None:
+            raise _Err(ENOENT, args["path"])
+        if entry["type"] != "file":
+            raise _Err(EINVAL, f"{args['path']} is a directory (use rmdir)")
+        await self._journal(
+            {"op": "rm_dentry", "dir": pino, "name": name}
+        )
+        return {"entry": entry}  # client purges the data objects
+
+    async def _op_rmdir(self, args) -> dict:
+        pino, pdir, name = await self._walk_parent(args["path"])
+        entry = pdir.get(name)
+        if entry is None:
+            raise _Err(ENOENT, args["path"])
+        if entry["type"] != "dir":
+            raise _Err(ENOTDIR, args["path"])
+        if await self._dir(entry["ino"]):
+            raise _Err(ENOTEMPTY, args["path"])
+        await self._journal(
+            {"op": "rm_dentry", "dir": pino, "name": name},
+            {"op": "rmdir_obj", "ino": entry["ino"]},
+        )
+        return {}
+
+    async def _op_rename(self, args) -> dict:
+        sparts = self._split(args["src"])
+        dparts = self._split(args["dst"])
+        if sparts == dparts:
+            # self-rename is a no-op, NOT set+remove of the same dentry
+            _pino, pdir, name = await self._walk_parent(args["src"])
+            entry = pdir.get(name)
+            if entry is None:
+                raise _Err(ENOENT, args["src"])
+            return {"entry": entry, "replaced": None}
+        if dparts[: len(sparts)] == sparts:
+            # moving a directory into its own subtree detaches it into an
+            # unreachable cycle (fs.py guards identically)
+            raise _Err(EINVAL, f"cannot move {args['src']} into itself")
+        spino, spdir, sname = await self._walk_parent(args["src"])
+        entry = spdir.get(sname)
+        if entry is None:
+            raise _Err(ENOENT, args["src"])
+        dpino, dpdir, dname = await self._walk_parent(args["dst"])
+        existing = dpdir.get(dname)
+        if existing is not None:
+            if existing["type"] == "dir" and await self._dir(existing["ino"]):
+                raise _Err(ENOTEMPTY, args["dst"])
+            if existing["type"] != entry["type"]:
+                raise _Err(EINVAL, "rename across entry types")
+        await self._journal(
+            {"op": "set_dentry", "dir": dpino, "name": dname, "entry": entry},
+            {"op": "rm_dentry", "dir": spino, "name": sname},
+        )
+        return {"entry": entry, "replaced": existing}
+
+    async def _op_setattr(self, args) -> dict:
+        """Handle-held attribute updates address the INODE when the client
+        supplies it: a concurrent rename (or replace-by-create at the old
+        path) must never let one file's setattr land on another."""
+        want_ino = args.get("ino")
+        if want_ino is not None and want_ino in self._ino_loc:
+            pino, name = self._ino_loc[want_ino]
+            pdir = await self._dir(pino)
+        else:
+            pino, pdir, name = await self._walk_parent(args["path"])
+        entry = pdir.get(name)
+        if entry is None:
+            raise _Err(ENOENT, args["path"])
+        if want_ino is not None and entry["ino"] != want_ino:
+            raise _Err(ENOENT, f"{args['path']}: stale handle (renamed over)")
+        entry = dict(entry)
+        for field in ("size", "mtime"):
+            if field in args:
+                entry[field] = args[field]
+        await self._journal(
+            {"op": "set_dentry", "dir": pino, "name": name, "entry": entry}
+        )
+        return {"entry": entry}
+
+    # -- capabilities (Locker.cc essence) --------------------------------------
+
+    def _conflicting_holders(self, ino: int, want: str) -> list:
+        holders = self.caps.get(ino, {})
+        if want == "w":
+            return list(holders)  # exclusive: anyone conflicts
+        return [c for c, m in holders.items() if m == "w"]
+
+    async def _acquire_caps(self, conn, ino: int, want: str) -> str:
+        """Grant caps, revoking conflicting holders first (Locker's
+        issue/revoke cycle).  The grant WAITS for every conflicting holder
+        to ack/release (or die, or time out) — granting early would let
+        the old holder's in-flight writes land after the new holder's
+        open returns, the exact race revocation exists to prevent."""
+        conflicts = [
+            c for c in self._conflicting_holders(ino, want) if c is not conn
+        ]
+        if conflicts:
+            self._cap_tid += 1
+            tid = self._cap_tid
+            ev = asyncio.Event()
+            self._revoke_waiters[(ino, tid)] = {
+                "ev": ev, "want": want, "requester": conn
+            }
+            for holder in conflicts:
+                try:
+                    await holder.send_message(
+                        MClientCaps(
+                            op=MClientCaps.REVOKE, ino=ino, caps="", tid=tid
+                        )
+                    )
+                except ConnectionError:
+                    self._drop_cap(ino, holder)  # dead session forfeits now
+            self._check_grant_waiters(ino)
+            try:
+                await asyncio.wait_for(ev.wait(), REVOKE_TIMEOUT)
+            except asyncio.TimeoutError:
+                # unresponsive holders forfeit (mds_session_timeout)
+                for holder in [
+                    c
+                    for c in self._conflicting_holders(ino, want)
+                    if c is not conn
+                ]:
+                    self._drop_cap(ino, holder)
+            finally:
+                self._revoke_waiters.pop((ino, tid), None)
+        self.caps.setdefault(ino, {})[conn] = want
+        return want
+
+    async def _op_open(self, conn, args) -> dict:
+        pino, pdir, name = await self._walk_parent(args["path"])
+        entry = pdir.get(name)
+        if entry is None:
+            raise _Err(ENOENT, args["path"])
+        if entry["type"] != "file":
+            raise _Err(EINVAL, f"{args['path']} is a directory")
+        caps = await self._acquire_caps(conn, entry["ino"], args.get("caps", "r"))
+        return {"entry": entry, "caps": caps}
+
+
+class _Err(Exception):
+    def __init__(self, err: int, msg: str = ""):
+        self.errno = -abs(err)
+        super().__init__(msg)

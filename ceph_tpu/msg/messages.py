@@ -572,3 +572,35 @@ class MWatchNotify(Message):
         ("is_ack", "u8"),
         ("watcher", "str"),  # acking entity name
     ]
+
+
+# --- MDS / CephFS ------------------------------------------------------------
+
+
+@message_type(36)
+class MClientRequest(Message):
+    """Client -> MDS metadata op (src/messages/MClientRequest.h).  `op` is
+    the request name (mkdir, create, lookup, readdir, unlink, rmdir,
+    rename, setattr, open, release); `args` is a JSON blob — the dynamic
+    shape of the reference's filepath+args union."""
+
+    FIELDS = [("tid", "u64"), ("op", "str"), ("args", "bytes")]
+
+
+@message_type(37)
+class MClientReply(Message):
+    """MDS -> client reply (src/messages/MClientReply.h): result errno +
+    JSON payload (inode records, dentry lists, cap grants)."""
+
+    FIELDS = [("tid", "u64"), ("result", "i64"), ("payload", "bytes")]
+
+
+@message_type(38)
+class MClientCaps(Message):
+    """Capability traffic both ways (src/messages/MClientCaps.h): the MDS
+    REVOKEs caps it granted; clients ACK revokes and RELEASE caps they
+    drop.  `caps` is the wanted/held mask ("r", "w", "rw")."""
+
+    REVOKE, ACK, RELEASE = 0, 1, 2
+
+    FIELDS = [("op", "u8"), ("ino", "u64"), ("caps", "str"), ("tid", "u64")]

@@ -1,0 +1,249 @@
+"""MDS daemon tests: journaled metadata, capabilities, client protocol.
+
+Models the reference's MDS coverage (src/test/mds, qa/tasks/cephfs):
+namespace ops over the wire, journal replay after an MDS crash, cap
+revocation between competing clients, and data I/O bypassing the MDS.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.client import Rados
+from ceph_tpu.mds import MDS, CephFSClient, FsClientError
+from ceph_tpu.mds.mds import JOURNAL_OID
+from ceph_tpu.mon import MonMap, Monitor
+
+from test_cluster import start_cluster, stop_cluster, wait_until
+
+
+async def _fs_cluster():
+    monmap, mons, osds = await start_cluster(1, 3)
+    rados = Rados(monmap)
+    await rados.connect()
+    await rados.pool_create("fs_meta", "replicated", size=2, pg_num=2)
+    await rados.pool_create("fs_data", "replicated", size=2, pg_num=2)
+    meta = await rados.open_ioctx("fs_meta")
+    data = await rados.open_ioctx("fs_data")
+    mds = MDS(meta, data)
+    await mds.start()
+    return monmap, mons, osds, rados, meta, data, mds
+
+
+class TestMdsNamespace:
+    def test_namespace_and_file_io_over_the_wire(self):
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            fsc = CephFSClient(mds.addr, data)
+
+            await fsc.mkdir("/home")
+            await fsc.mkdir("/home/user")
+            assert await fsc.listdir("/") == ["home"]
+            assert await fsc.listdir("/home") == ["user"]
+            with pytest.raises(FsClientError):
+                await fsc.mkdir("/home")  # EEXIST
+            with pytest.raises(FsClientError):
+                await fsc.listdir("/ghost")
+
+            payload = b"filesystem bytes " * 5000  # multi-object via striper
+            await fsc.write_file("/home/user/doc.txt", payload)
+            assert await fsc.read_file("/home/user/doc.txt") == payload
+            st = await fsc.stat("/home/user/doc.txt")
+            assert st["type"] == "file" and st["size"] == len(payload)
+
+            # overwrite smaller: truncate-then-write, no stale tail
+            await fsc.write_file("/home/user/doc.txt", b"short")
+            assert await fsc.read_file("/home/user/doc.txt") == b"short"
+
+            await fsc.rename("/home/user/doc.txt", "/home/moved.txt")
+            assert await fsc.read_file("/home/moved.txt") == b"short"
+            assert await fsc.listdir("/home/user") == []
+
+            await fsc.unlink("/home/moved.txt")
+            with pytest.raises(FsClientError):
+                await fsc.stat("/home/moved.txt")
+            await fsc.rmdir("/home/user")
+            assert await fsc.listdir("/home") == []
+            with pytest.raises(FsClientError):
+                await fsc.rmdir("/home/ghost")
+
+            await fsc.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_rename_guards(self):
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            fsc = CephFSClient(mds.addr, data)
+            await fsc.mkdir("/a")
+            await fsc.write_file("/a/f", b"keep me")
+
+            # self-rename is a no-op, never a delete
+            await fsc.rename("/a/f", "/a/f")
+            assert await fsc.read_file("/a/f") == b"keep me"
+
+            # a directory cannot move into its own subtree
+            await fsc.mkdir("/a/b")
+            with pytest.raises(FsClientError):
+                await fsc.rename("/a", "/a/b/c")
+            assert await fsc.listdir("/a") == ["b", "f"]
+
+            await fsc.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_two_clients_share_namespace(self):
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            a = CephFSClient(mds.addr, data, name="client.a")
+            b = CephFSClient(mds.addr, data, name="client.b")
+
+            await a.mkdir("/shared")
+            await a.write_file("/shared/from_a", b"written by a")
+            # b sees a's metadata immediately (single authoritative MDS)
+            assert await b.listdir("/shared") == ["from_a"]
+            assert await b.read_file("/shared/from_a") == b"written by a"
+
+            for c in (a, b):
+                await c.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestMdsJournal:
+    def test_crash_before_flush_replays_journal(self):
+        """Acked metadata survives an MDS crash that never wrote back its
+        dirty dirfrags (the MDLog write-ahead property)."""
+
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            # stop the periodic flush FIRST: a tick between the ops and the
+            # simulated crash would legitimately trim the journal and
+            # invalidate the non-empty assertion below
+            mds._flush_task.cancel()
+            fsc = CephFSClient(mds.addr, data)
+            await fsc.mkdir("/durable")
+            await fsc.write_file("/durable/f", b"journal me")
+            await fsc.shutdown()
+
+            # crash: no flush, no clean stop — just drop the daemon
+            mds._running = False
+            mds._flush_task.cancel()
+            mds._flush_task = None
+            await mds.msgr.shutdown()
+            # the journal object must hold unflushed events
+            raw = await meta.read(JOURNAL_OID)
+            assert raw.strip(), "journal unexpectedly empty before flush"
+
+            # a fresh MDS replays and serves the namespace
+            mds2 = MDS(meta, data)
+            await mds2.start()
+            fsc2 = CephFSClient(mds2.addr, data)
+            assert await fsc2.listdir("/") == ["durable"]
+            assert await fsc2.read_file("/durable/f") == b"journal me"
+
+            # after a flush the journal trims
+            await mds2._flush()
+            assert (await meta.read(JOURNAL_OID)) == b""
+            head = json.loads((await meta.read("mds_journal_head")).decode())
+            assert head["flushed"] >= 1
+
+            await fsc2.shutdown()
+            await mds2.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestCapabilities:
+    def test_conflicting_writer_revokes_first_holder(self):
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            a = CephFSClient(mds.addr, data, name="client.a")
+            b = CephFSClient(mds.addr, data, name="client.b")
+
+            fh_a = await a.create("/contested")
+            await fh_a.write(b"a was here")
+            assert fh_a.caps == "w"
+
+            # b wants to write too: the MDS revokes a's caps first
+            fh_b = await b.open("/contested", "w")
+            assert fh_b.caps == "w"
+            await wait_until(lambda: not fh_a.valid, 3.0, "revoke reaches a")
+            with pytest.raises(FsClientError):
+                await fh_a.write(b"stale handle")
+
+            await fh_b.write(b"b takes over")
+            await fh_b.close()
+
+            # a re-opens and proceeds (the reference's cap-wait loop)
+            fh_a2 = await a.open("/contested", "r")
+            assert (await fh_a2.read()).startswith(b"b takes over")
+            await fh_a2.close()
+
+            for c in (a, b):
+                await c.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_readers_share_writer_excludes(self):
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            a = CephFSClient(mds.addr, data, name="client.a")
+            b = CephFSClient(mds.addr, data, name="client.b")
+            await a.write_file("/f", b"data")
+
+            r1 = await a.open("/f", "r")
+            r2 = await b.open("/f", "r")  # readers coexist
+            assert r1.valid and r2.valid
+            ino = r1.entry["ino"]
+            assert len(mds.caps[ino]) == 2
+
+            w = await b.open("/f", "w")  # writer revokes both readers
+            await wait_until(lambda: not r1.valid, 3.0, "reader caps revoked")
+            assert len(mds.caps[ino]) == 1
+            await w.close()
+
+            for c in (a, b):
+                await c.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_dead_client_session_reset_frees_caps(self):
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            dead = CephFSClient(mds.addr, data, name="client.dead")
+            live = CephFSClient(mds.addr, data, name="client.live")
+
+            fh = await dead.create("/orphan")
+            await fh.write(b"x")
+            await dead.shutdown()  # connection drops WITHOUT releasing
+
+            await wait_until(lambda: not mds.caps, 3.0, "caps freed on reset")
+            fh2 = await live.open("/orphan", "w")  # no revoke wait needed
+            await fh2.write(b"y")
+            await fh2.close()
+
+            await live.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
