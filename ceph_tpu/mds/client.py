@@ -36,6 +36,9 @@ class FileHandle:
         self.entry = entry
         self.caps = caps
         self.valid = True
+        self._inflight = 0
+        self._idle = asyncio.Event()
+        self._idle.set()
 
     def _require(self, need: str) -> None:
         if not self.valid:
@@ -57,18 +60,35 @@ class FileHandle:
             ),
         )
 
+    def _op_started(self) -> None:
+        self._inflight += 1
+        self._idle.clear()
+
+    def _op_done(self) -> None:
+        self._inflight -= 1
+        if self._inflight == 0:
+            self._idle.set()
+
     async def write(self, data: bytes, off: int = 0) -> None:
         self._require("w")
-        await self._data().write(data, off)
-        new_size = max(self.entry.get("size", 0), off + len(data))
-        if new_size != self.entry.get("size", 0):
-            # ino-addressed: a concurrent rename must not land this on a
-            # different file that now occupies our old path
-            rep = await self.client._request(
-                "setattr",
-                {"path": self.path, "ino": self.entry["ino"], "size": new_size},
-            )
-            self.entry = rep["entry"]
+        self._op_started()
+        try:
+            await self._data().write(data, off)
+            new_size = max(self.entry.get("size", 0), off + len(data))
+            if new_size != self.entry.get("size", 0):
+                # ino-addressed: a concurrent rename must not land this on
+                # a different file that now occupies our old path
+                rep = await self.client._request(
+                    "setattr",
+                    {
+                        "path": self.path,
+                        "ino": self.entry["ino"],
+                        "size": new_size,
+                    },
+                )
+                self.entry = rep["entry"]
+        finally:
+            self._op_done()
 
     async def read(self, length: int = 0, off: int = 0) -> bytes:
         self._require("r")
@@ -83,12 +103,16 @@ class FileHandle:
         (Client::ll_truncate ordering — stale striped bytes must never
         reappear on a later extension)."""
         self._require("w")
-        await self._data().truncate(size)
-        rep = await self.client._request(
-            "setattr",
-            {"path": self.path, "ino": self.entry["ino"], "size": size},
-        )
-        self.entry = rep["entry"]
+        self._op_started()
+        try:
+            await self._data().truncate(size)
+            rep = await self.client._request(
+                "setattr",
+                {"path": self.path, "ino": self.entry["ino"], "size": size},
+            )
+            self.entry = rep["entry"]
+        finally:
+            self._op_done()
 
     async def close(self) -> None:
         if self.valid:
@@ -129,22 +153,27 @@ class CephFSClient(Dispatcher):
                 fut.set_result(msg)
             return True
         if isinstance(msg, MClientCaps) and msg.op == MClientCaps.REVOKE:
-            # the MDS wants these caps back: invalidate local handles and
-            # ack (Client::handle_caps revoke path; writes here are
-            # synchronous so there is nothing to flush)
-            for fh in self._handles.pop(msg.ino, []):
+            # the MDS wants these caps back: invalidate local handles, then
+            # ack only after their in-flight data ops DRAIN — acking while
+            # a write coroutine is suspended mid-striper would let our
+            # bytes land after the new holder's grant (Client::handle_caps
+            # flush-before-ack)
+            handles = self._handles.pop(msg.ino, [])
+            for fh in handles:
                 fh.valid = False
             ack = MClientCaps(
                 op=MClientCaps.ACK, ino=msg.ino, caps="", tid=msg.tid
             )
 
-            async def _ack() -> None:
+            async def _drain_then_ack() -> None:
+                for fh in handles:
+                    await fh._idle.wait()
                 try:
                     await conn.send_message(ack)
                 except ConnectionError:
                     pass
 
-            asyncio.get_event_loop().create_task(_ack())
+            asyncio.get_event_loop().create_task(_drain_then_ack())
             return True
         return False
 

@@ -38,6 +38,7 @@ import asyncio
 import json
 import time
 
+from ..common.errs import EAGAIN as EAGAIN_
 from ..common.errs import EEXIST, EINVAL, ENOENT, ENOTDIR, ENOTEMPTY
 from ..common.log import dout
 from ..msg.messages import MClientCaps, MClientReply, MClientRequest
@@ -192,6 +193,10 @@ class MDS(Dispatcher):
         self._journal_bytes += len(blob)
         for ev in events:
             await self._apply_event(ev)
+        if self._journal_bytes > JOURNAL_FLUSH_BYTES and self._running:
+            # size-triggered early flush (Journaler's segment threshold);
+            # scheduled, not inline: _flush takes the big lock we hold
+            asyncio.get_event_loop().create_task(self._flush())
 
     async def _flush_loop(self) -> None:
         while self._running:
@@ -285,6 +290,25 @@ class MDS(Dispatcher):
         for ino in list(self.caps):
             self._drop_cap(ino, conn)
 
+    def _invalidate_caps(self, ino: int) -> None:
+        """The inode is gone (unlink / rename-over): revoke every holder
+        (fire-and-forget; the handle is invalid regardless) and clear the
+        cap table so nothing leaks."""
+        for holder in list(self.caps.pop(ino, {})):
+            self._cap_tid += 1
+            push = MClientCaps(
+                op=MClientCaps.REVOKE, ino=ino, caps="", tid=self._cap_tid
+            )
+
+            async def _send(holder=holder, push=push) -> None:
+                try:
+                    await holder.send_message(push)
+                except ConnectionError:
+                    pass
+
+            asyncio.get_event_loop().create_task(_send())
+        self._check_grant_waiters(ino)
+
     def _drop_cap(self, ino: int, conn: Connection) -> None:
         holders = self.caps.get(ino)
         if holders and conn in holders:
@@ -342,7 +366,7 @@ class MDS(Dispatcher):
         if op == "rename":
             return await self._op_rename(args)
         if op == "setattr":
-            return await self._op_setattr(args)
+            return await self._op_setattr(conn, args)
         if op == "open":
             return await self._op_open(conn, args)
         raise _Err(EINVAL, f"unknown mds op {op!r}")
@@ -396,6 +420,9 @@ class MDS(Dispatcher):
         await self._journal(
             {"op": "rm_dentry", "dir": pino, "name": name}
         )
+        # open holders lose their caps: the inode is gone and the client
+        # will purge its data objects (cap invalidation on unlink)
+        self._invalidate_caps(entry["ino"])
         return {"entry": entry}  # client purges the data objects
 
     async def _op_rmdir(self, args) -> dict:
@@ -433,22 +460,33 @@ class MDS(Dispatcher):
             raise _Err(ENOENT, args["src"])
         dpino, dpdir, dname = await self._walk_parent(args["dst"])
         existing = dpdir.get(dname)
+        events = []
         if existing is not None:
             if existing["type"] == "dir" and await self._dir(existing["ino"]):
                 raise _Err(ENOTEMPTY, args["dst"])
             if existing["type"] != entry["type"]:
                 raise _Err(EINVAL, "rename across entry types")
-        await self._journal(
+            if existing["type"] == "dir":
+                # reclaim the replaced empty directory's dirfrag object
+                events.append({"op": "rmdir_obj", "ino": existing["ino"]})
+            else:
+                self._invalidate_caps(existing["ino"])  # replaced-over file
+        events += [
             {"op": "set_dentry", "dir": dpino, "name": dname, "entry": entry},
             {"op": "rm_dentry", "dir": spino, "name": sname},
-        )
+        ]
+        await self._journal(*events)
         return {"entry": entry, "replaced": existing}
 
-    async def _op_setattr(self, args) -> dict:
+    async def _op_setattr(self, conn, args) -> dict:
         """Handle-held attribute updates address the INODE when the client
         supplies it: a concurrent rename (or replace-by-create at the old
         path) must never let one file's setattr land on another."""
         want_ino = args.get("ino")
+        if want_ino is not None and conn not in self.caps.get(want_ino, {}):
+            # a revoked holder's straggling size update must not land
+            # after the new holder's grant (Locker's cap check on flush)
+            raise _Err(EAGAIN_, f"ino {want_ino}: caps not held")
         if want_ino is not None and want_ino in self._ino_loc:
             pino, name = self._ino_loc[want_ino]
             pdir = await self._dir(pino)

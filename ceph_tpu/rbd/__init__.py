@@ -1,5 +1,19 @@
-"""RBD block layer (src/librbd)."""
+"""RBD block layer (src/librbd + src/journal + rbd_mirror)."""
 
+from .mirror import (
+    JournaledImage,
+    MirrorDaemon,
+    enable_journaling,
+    promote,
+)
 from .rbd import RBD, Image, RbdError
 
-__all__ = ["RBD", "Image", "RbdError"]
+__all__ = [
+    "RBD",
+    "Image",
+    "JournaledImage",
+    "MirrorDaemon",
+    "RbdError",
+    "enable_journaling",
+    "promote",
+]

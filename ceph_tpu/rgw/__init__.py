@@ -1,6 +1,7 @@
-"""Object gateway — S3 semantics over RADOS (src/rgw)."""
+"""Object gateway — S3 + Swift semantics over RADOS (src/rgw)."""
 
 from .rgw import RgwError, ObjectGateway
 from .http import S3Server
+from .swift import SwiftServer
 
-__all__ = ["ObjectGateway", "RgwError", "S3Server"]
+__all__ = ["ObjectGateway", "RgwError", "S3Server", "SwiftServer"]

@@ -143,15 +143,21 @@ class ObjectGateway:
             self.ioctx, f"rgw.obj.{bucket}/{key}", policy=self.policy
         )
 
-    async def put_object(self, bucket: str, key: str, data: bytes) -> str:
-        """PutObject; returns the etag (RGWPutObj)."""
+    async def put_object(
+        self, bucket: str, key: str, data: bytes, meta: dict | None = None
+    ) -> str:
+        """PutObject; returns the etag (RGWPutObj).  `meta` carries user
+        metadata (x-amz-meta-* / X-Object-Meta-*, RGWObjManifest attrs)."""
         await self._require_bucket(bucket)
         obj = self._data(bucket, key)
         await obj.remove()  # overwrite semantics
         await obj.write(data)
         etag = _etag(data)
         index = await self._load(self._index_oid(bucket))
-        index[key] = {"size": len(data), "etag": etag, "mtime": time.time()}
+        entry = {"size": len(data), "etag": etag, "mtime": time.time()}
+        if meta:
+            entry["meta"] = dict(meta)
+        index[key] = entry
         await self._store(self._index_oid(bucket), index)
         return etag
 
