@@ -73,7 +73,9 @@ class _GlobalPlanCache:
     """Process-wide encode/decode plan cache keyed by matrix content."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        from ceph_tpu.common.lockdep import make_lock
+
+        self._lock = make_lock("plan_cache")
         self._encode: dict[bytes, jnp.ndarray] = {}
         self._encode_coders: dict[bytes, _DeviceCoder] = {}
         self._decode: OrderedDict[tuple[bytes, str], tuple[jnp.ndarray, list[int]]] = (

@@ -84,7 +84,10 @@ class MDS(Dispatcher):
         # file ino -> (parent dir ino, dentry name): lets handle-held ops
         # (setattr) address the INODE, immune to concurrent renames
         self._ino_loc: dict[int, tuple[int, str]] = {}
-        self._lock = asyncio.Lock()  # one mutation at a time (the MDS big lock)
+        from ..common.lockdep import make_async_lock
+
+        # one mutation at a time (the MDS big lock; mds_lock in the ref)
+        self._lock = make_async_lock("mds_big_lock")
 
     # -- lifecycle -------------------------------------------------------------
 

@@ -100,7 +100,9 @@ class Connection:
         self._onwire: OnWireSession | None = None
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
-        self._send_lock = asyncio.Lock()
+        from ..common.lockdep import make_async_lock
+
+        self._send_lock = make_async_lock(f"conn_send:{msgr.name}")
         self._out_seq = 0
         self._closed = False
         self._read_task: asyncio.Task | None = None
