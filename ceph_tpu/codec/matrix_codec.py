@@ -265,6 +265,68 @@ class _GlobalPlanCache:
 PLAN_CACHE = _GlobalPlanCache()
 
 
+class EncodePipeline:
+    """Asynchronous chunk-encode hand-off — the completion queue behind
+    the synchronous `encode_chunks` interface (SURVEY §7's hard part).
+
+    `submit` stages the host->device transfer and LAUNCHES the encode
+    immediately (JAX dispatch is asynchronous: the call returns while the
+    device works), so consecutive submissions overlap compute with the
+    host-side gather of the next batch — the double-buffering the
+    reference gets from queued librados AIO in front of `ec_encode_data`.
+    Completions copy parity back into the caller's chunk buffers exactly
+    like `encode_chunks`; `poll()` reaps only finished launches
+    (non-blocking), `flush()` drains everything.  `depth` bounds
+    device-side in-flight work the way an AIO queue depth does.
+    """
+
+    def __init__(self, codec: "MatrixCodecMixin", depth: int = 4):
+        self.codec = codec
+        self.depth = max(1, depth)
+        self._tickets = 0
+        # in-flight: (ticket, caller chunk dict, device parity array)
+        self._inflight: list[tuple[int, Mapping[int, np.ndarray], object]] = []
+        # tickets completed inside submit's backpressure path: the next
+        # poll()/flush() reports them — a completed ticket is NEVER lost
+        self._reaped: list[int] = []
+
+    def submit(self, chunks: Mapping[int, np.ndarray]) -> int:
+        """Launch one stripe's encode; returns its ticket.  Blocks only
+        when `depth` launches are already in flight (backpressure)."""
+        parity_dev = self.codec.encode_array(self.codec._gather(chunks))
+        self._tickets += 1
+        self._inflight.append((self._tickets, chunks, parity_dev))
+        while len(self._inflight) > self.depth:
+            self._reaped += self._complete(*self._inflight.pop(0))
+        return self._tickets
+
+    def _complete(self, ticket: int, chunks, parity_dev) -> list[int]:
+        parity = np.asarray(parity_dev)  # blocks until the launch finishes
+        self.codec._scatter(chunks, parity)
+        return [ticket]
+
+    def poll(self) -> list[int]:
+        """Reap FINISHED launches without blocking (completion queue)."""
+        done, self._reaped = self._reaped, []
+        while self._inflight:
+            ticket, chunks, dev = self._inflight[0]
+            ready = getattr(dev, "is_ready", None)
+            # unknown readiness means NOT ready: popping would block in
+            # _complete and silently defeat the non-blocking contract
+            if ready is None or not ready():
+                break  # still computing; keep submission order
+            self._inflight.pop(0)
+            done += self._complete(ticket, chunks, dev)
+        return done
+
+    def flush(self) -> list[int]:
+        """Drain every in-flight encode (the barrier before a commit)."""
+        done, self._reaped = self._reaped, []
+        while self._inflight:
+            done += self._complete(*self._inflight.pop(0))
+        return done
+
+
 class MatrixCodecMixin:
     """Chunk-level + device-level coding for matrix-defined codecs.
 
@@ -323,14 +385,22 @@ class MatrixCodecMixin:
 
     # -- chunk-level interface ---------------------------------------------
 
-    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
-        k, m = self.k, self.m
-        data = np.stack(
-            [np.asarray(chunks[self.chunk_index(i)], dtype=np.uint8) for i in range(k)]
+    def _gather(self, chunks: Mapping[int, np.ndarray]) -> np.ndarray:
+        """Stack the k data chunks in encode order (shared by the sync
+        interface and the EncodePipeline so the paths cannot drift)."""
+        return np.stack(
+            [
+                np.asarray(chunks[self.chunk_index(i)], dtype=np.uint8)
+                for i in range(self.k)
+            ]
         )
-        parity = np.asarray(self.encode_array(data))
-        for i in range(m):
-            np.copyto(chunks[self.chunk_index(k + i)], parity[i])
+
+    def _scatter(self, chunks: Mapping[int, np.ndarray], parity: np.ndarray) -> None:
+        for i in range(self.m):
+            np.copyto(chunks[self.chunk_index(self.k + i)], parity[i])
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        self._scatter(chunks, np.asarray(self.encode_array(self._gather(chunks))))
 
     def _use_xor_decode(self, erasures: list[int]) -> bool:
         """Single-erasure XOR path: first k+1 chunks + all-ones parity row 0

@@ -45,7 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "-w",
         "--workload",
-        choices=("encode", "decode", "repair"),
+        choices=("encode", "encode-pipelined", "decode", "repair"),
         default="encode",
     )
     p.add_argument("-e", "--erasures", type=int, default=1)
@@ -81,6 +81,33 @@ def run_encode(ec, args) -> float:
     for i in range(args.iterations):
         buf[0] ^= np.uint8(i + 1)  # defeat identical-launch caching
         ec.encode(want, buf)
+    return time.perf_counter() - start
+
+
+def run_encode_pipelined(ec, args, depth: int = 4) -> float:
+    """Pipelined chunk encodes through the EncodePipeline completion
+    queue: device launches overlap the host-side gather of the next
+    stripe (the AIO-queue shape in front of ec_encode_data)."""
+    from ..codec.matrix_codec import EncodePipeline
+
+    k, n = ec.get_data_chunk_count(), ec.get_chunk_count()
+    chunk = ec.get_chunk_size(args.size)
+    rng = np.random.default_rng(0)
+    batches = []
+    for i in range(args.iterations):
+        chunks = {
+            ec.chunk_index(j): rng.integers(0, 256, chunk, dtype=np.uint8)
+            if j < k
+            else np.zeros(chunk, dtype=np.uint8)
+            for j in range(n)
+        }
+        batches.append(chunks)
+    pipe = EncodePipeline(ec, depth=depth)
+    start = time.perf_counter()
+    for chunks in batches:
+        pipe.submit(chunks)
+        pipe.poll()  # reap whatever already finished, without blocking
+    pipe.flush()
     return time.perf_counter() - start
 
 
@@ -162,6 +189,8 @@ def main(argv=None) -> int:
         return 1
     if args.workload == "encode":
         elapsed = run_encode(ec, args)
+    elif args.workload == "encode-pipelined":
+        elapsed = run_encode_pipelined(ec, args)
     elif args.workload == "decode":
         elapsed = run_decode(ec, args)
     else:
