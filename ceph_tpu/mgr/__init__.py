@@ -3,5 +3,6 @@ src/pybind/mgr)."""
 
 from .mgr import Mgr
 from .modules import MgrModule
+from .telemetry import TelemetryModule
 
-__all__ = ["Mgr", "MgrModule"]
+__all__ = ["Mgr", "MgrModule", "TelemetryModule"]

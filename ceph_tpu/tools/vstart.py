@@ -39,23 +39,28 @@ def _free_port_addrs(n: int) -> dict[str, str]:
 
 
 class DevCluster:
-    """mons + osds + mgr in-process (the vstart topology)."""
+    """mons + osds + mgr (+ optional MDS with its pools) in-process
+    (the vstart topology; vstart.sh also boots MDS=1 by default)."""
 
     def __init__(
         self,
         n_mons: int = 1,
         n_osds: int = 3,
         with_mgr: bool = True,
+        with_mds: bool = False,
         conf_overrides: dict | None = None,
     ):
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.with_mgr = with_mgr
+        self.with_mds = with_mds
         self.conf_overrides = conf_overrides or {}
         self.monmap: MonMap | None = None
         self.mons: list[Monitor] = []
         self.osds: list[OSD] = []
         self.mgr: Mgr | None = None
+        self.mds = None
+        self._mds_rados = None
 
     async def start(self) -> MonMap:
         self.monmap = MonMap(addrs=_free_port_addrs(self.n_mons))
@@ -81,9 +86,32 @@ class DevCluster:
             self.mgr.beacon_interval = 0.5
             await self.mgr.start()
             await self.mgr.wait_for_active()
+        if self.with_mds:
+            # `ceph fs new`-style bootstrap: metadata + data pools, then
+            # the metadata server (vstart.sh's MDS=1 default topology)
+            from ..client import Rados
+            from ..mds import MDS
+
+            self._mds_rados = Rados(self.monmap, name="client.mds-bootstrap")
+            await self._mds_rados.connect()
+            size = min(2, self.n_osds)
+            await self._mds_rados.pool_create(
+                "cephfs_metadata", "replicated", size=size, pg_num=4
+            )
+            await self._mds_rados.pool_create(
+                "cephfs_data", "replicated", size=size, pg_num=8
+            )
+            meta = await self._mds_rados.open_ioctx("cephfs_metadata")
+            data = await self._mds_rados.open_ioctx("cephfs_data")
+            self.mds = MDS(meta, data)
+            await self.mds.start()
         return self.monmap
 
     async def stop(self) -> None:
+        if self.mds is not None:
+            await self.mds.stop()
+        if self._mds_rados is not None:
+            await self._mds_rados.shutdown()
         if self.mgr is not None:
             await self.mgr.stop()
         for osd in self.osds:
@@ -95,8 +123,11 @@ class DevCluster:
 
     def write_cluster_file(self, path: str = CLUSTER_FILE) -> None:
         """Connection info for out-of-process CLIs."""
+        info = {"mon_addrs": self.monmap.addrs}
+        if self.mds is not None:
+            info["mds_addr"] = self.mds.addr
         with open(path, "w") as f:
-            json.dump({"mon_addrs": self.monmap.addrs}, f)
+            json.dump(info, f)
 
 
 def load_monmap(path: str = CLUSTER_FILE) -> MonMap:
@@ -106,12 +137,17 @@ def load_monmap(path: str = CLUSTER_FILE) -> MonMap:
 
 
 async def _main(args) -> None:
-    cluster = DevCluster(args.mons, args.osds, with_mgr=not args.no_mgr)
+    cluster = DevCluster(
+        args.mons, args.osds, with_mgr=not args.no_mgr, with_mds=args.mds
+    )
     await cluster.start()
     cluster.write_cluster_file(args.cluster_file)
-    print(f"cluster up: {args.mons} mon(s), {args.osds} osd(s); "
-          f"monmap -> {args.cluster_file}")
+    print(f"cluster up: {args.mons} mon(s), {args.osds} osd(s)"
+          + (", 1 mds" if args.mds else "")
+          + f"; monmap -> {args.cluster_file}")
     print("mon addrs:", ", ".join(cluster.monmap.addrs.values()))
+    if cluster.mds is not None:
+        print("mds addr:", cluster.mds.addr)
     try:
         while True:
             await asyncio.sleep(3600)
@@ -126,6 +162,8 @@ def main() -> None:
     p.add_argument("--mons", type=int, default=1)
     p.add_argument("--osds", type=int, default=3)
     p.add_argument("--no-mgr", action="store_true")
+    p.add_argument("--mds", action="store_true",
+                   help="boot an MDS with cephfs_metadata/cephfs_data pools")
     p.add_argument("--cluster-file", default=CLUSTER_FILE)
     args = p.parse_args()
     try:

@@ -206,3 +206,61 @@ class TestPgAutoscaler:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestTelemetry:
+    def test_opt_in_report_shapes_only(self):
+        """The telemetry module (pybind/mgr/telemetry): disabled by
+        default, explicit opt-in, and reports carry cluster SHAPE only —
+        a salted-hash id, counts, pool geometry — never names."""
+
+        async def run():
+            import json as _json
+
+            from ceph_tpu.mgr.telemetry import TelemetryModule
+
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            rv, rs, _ = await client.mon_command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "t21",
+                    "profile": ["k=2", "m=1", "plugin=tpu"],
+                }
+            )
+            assert rv == 0, rs
+            await client.pool_create("tec", "erasure", profile="t21", pg_num=4)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            tel = TelemetryModule()
+            mgr.register_module(tel)
+
+            # off by default: ticks never compile a report
+            tel.tick()
+            assert tel.reports == [] and not tel.enabled
+
+            tel.on()
+            tel.tick()
+            assert len(tel.reports) == 1
+            report = tel.reports[0]
+            assert report["osd"]["count"] == 3 and report["osd"]["up"] == 3
+            kinds = {p["type"] for p in report["pools"]}
+            assert "erasure" in kinds
+            ec_pool = next(p for p in report["pools"] if p["type"] == "erasure")
+            assert ("k", "2") in ec_pool["erasure_code_profile"]
+            # privacy: no pool NAMES, osd addresses, or object keys anywhere
+            blob = _json.dumps(report)
+            assert "tec" not in blob and "127.0.0.1" not in blob
+            assert len(report["cluster_id"]) == 16
+
+            # interval gating: an immediate second tick does not re-send
+            tel.tick()
+            assert len(tel.reports) == 1
+            assert _json.loads(tel.show())["osd"]["count"] == 3
+
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())

@@ -226,3 +226,34 @@ class TestCliSubprocess:
             await cluster.stop()
 
         asyncio.run(run())
+
+
+class TestVstartMds:
+    def test_dev_cluster_with_mds(self):
+        """vstart's MDS=1 topology: pools bootstrapped, MDS serving, and
+        a CephFS client round trip against the written cluster file."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.mds import CephFSClient
+            from ceph_tpu.tools.vstart import DevCluster
+
+            cluster = DevCluster(1, 3, with_mgr=False, with_mds=True)
+            await cluster.start()
+            assert cluster.mds is not None and cluster.mds.addr
+
+            rados = Rados(cluster.monmap)
+            await rados.connect()
+            assert {"cephfs_metadata", "cephfs_data"} <= set(
+                await rados.pool_list()
+            )
+            data = await rados.open_ioctx("cephfs_data")
+            fsc = CephFSClient(cluster.mds.addr, data)
+            await fsc.mkdir("/vstart")
+            await fsc.write_file("/vstart/hello", b"from the dev cluster")
+            assert await fsc.read_file("/vstart/hello") == b"from the dev cluster"
+            await fsc.shutdown()
+            await rados.shutdown()
+            await cluster.stop()
+
+        asyncio.run(run())
