@@ -87,7 +87,11 @@ def run_child(platform: str) -> None:
     k, m = 8, 3
     chunk = 128 * 1024  # 1 MiB object / 8 data chunks
     on_tpu = got == "tpu"
-    batch = 64 if on_tpu else 2  # 64 MiB of object data per launch
+    # 256 MiB of object data per launch: the codec's deep-batching design
+    # point.  Measured on-chip, launch overhead through the axon tunnel is
+    # ~2-3 ms regardless of size, so 64 MiB launches cap at ~21 GB/s while
+    # 256 MiB launches run at the kernel's ~53 GB/s bandwidth-bound rate.
+    batch = 256 if on_tpu else 2
     iters = 40 if on_tpu else 3
 
     # The SHIPPING path: the registered `tpu` plugin's device encode — the
@@ -133,6 +137,11 @@ def run_child(platform: str) -> None:
     for _ in range(iters):
         data, p = step(data, p)
     jax.block_until_ready((data, p))
+    # A tiny device->host readback of the final parity closes the timing
+    # window honestly: on the axon backend, block_until_ready alone has
+    # been observed to return before queued launches finish; materializing
+    # bytes cannot.  8 bytes amortized over `iters` launches is noise.
+    _ = np.asarray(p[0, 0, :8])
     elapsed = time.perf_counter() - t0
 
     total_bytes = batch * k * chunk * iters  # input object bytes, harness semantics
@@ -164,6 +173,14 @@ def _child_env(platform: str) -> dict:
             env["XLA_FLAGS"] = " ".join(flags)
         else:
             env.pop("XLA_FLAGS", None)
+    else:
+        # The axon sitecustomize registers its PJRT plugin in EVERY python
+        # process (gated on PALLAS_AXON_POOL_IPS) and that registration
+        # blocks in `import jax` when the tunnel is wedged.  The CPU
+        # fallback child must stay alive precisely when the TPU path is
+        # broken, so strip the gate variable and force the CPU platform.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
     return env
 
 

@@ -1,0 +1,220 @@
+"""Kernel-variant experiments for the Pallas GF coding kernel (run on TPU).
+
+Measures GB/s (input bytes / elapsed) for several kernel formulations to
+locate the bottleneck between MXU utilization (the (8m, 8k) matmul is tiny
+vs the 128x128 array) and VPU work (bit-plane expansion + mod-2 fold).
+
+Usage:  python benchmarks/diag/kern_exp.py [variant ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from ceph_tpu.gf import isa_rs_vandermonde_matrix
+from ceph_tpu.ops.pallas_gf import CodingPlan
+from ceph_tpu.gf.bitslice import expand_matrix
+
+
+def arrange_dense_matrix(gfm):
+    """(m, k) GF matrix -> dense (8m, 8k) matmul layout (the retired
+    MXU formulation this experiment measured)."""
+    import numpy as _np
+    gfm = _np.asarray(gfm, dtype=_np.uint8)
+    m, k = gfm.shape
+    plain = expand_matrix(gfm)
+    perm = _np.array([j * 8 + b for b in range(8) for j in range(k)])
+    return plain[:, perm].astype(_np.float32)
+
+K, M = 8, 3
+CHUNK = 128 * 1024
+BATCH = 64
+ITERS = 30
+
+
+def block_diag(bm: np.ndarray, g: int) -> np.ndarray:
+    r, c = bm.shape
+    out = np.zeros((r * g, c * g), dtype=bm.dtype)
+    for i in range(g):
+        out[i * r : (i + 1) * r, i * c : (i + 1) * c] = bm
+    return out
+
+
+def _kernel_grouped(bm_ref, data_ref, out_ref, *, k: int, m: int, g: int):
+    """g stripes per program: block-diag (8mg, 8kg) matmul."""
+    pieces = []
+    for s in range(g):
+        d32 = data_ref[s].astype(jnp.int32)  # (k, T)
+        for b in range(8):
+            pieces.append((d32 >> b) & 1)
+    planes = jnp.concatenate(pieces, axis=0)  # (8kg, T)
+    cd = bm_ref.dtype
+    acc = jax.lax.dot_general(
+        bm_ref[:],
+        planes.astype(cd),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32 if cd == jnp.int8 else jnp.float32,
+    )  # (8mg, T)
+    bits = acc.astype(jnp.int32) & 1
+    t = bits.shape[-1]
+    grouped = bits.reshape(g, m, 8, t)
+    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32)).reshape(1, 1, 8, 1)
+    out_ref[...] = (grouped * weights).sum(axis=2).astype(jnp.uint8)
+
+
+def make_grouped(gfm: np.ndarray, g: int, dtype, tile: int):
+    m, k = gfm.shape
+    bm = block_diag(arrange_dense_matrix(gfm), g)
+    bmj = jnp.asarray(bm, dtype=dtype)
+
+    @jax.jit
+    def run(data):  # (S, k, L) uint8
+        s, kk, L = data.shape
+        grid = (s // g, L // tile)
+        return pl.pallas_call(
+            functools.partial(_kernel_grouped, k=k, m=m, g=g),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(bm.shape, lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((g, k, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((g, m, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((s, m, L), jnp.uint8),
+        )(bmj, data)
+
+    return run
+
+
+def _kernel_mm_only(bm_ref, planes_ref, out_ref):
+    """Matmul ceiling probe: planes pre-expanded on host, bf16 in HBM."""
+    acc = jax.lax.dot_general(
+        bm_ref[:], planes_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    out_ref[0] = acc.astype(jnp.int32).astype(jnp.uint8)
+
+
+def make_mm_only(gfm: np.ndarray, tile: int):
+    bm = arrange_dense_matrix(gfm)
+    bmj = jnp.asarray(bm, dtype=jnp.bfloat16)
+    mm8 = bm.shape[0]
+
+    @jax.jit
+    def run(planes):  # (S, 8k, L) bf16
+        s, kk8, L = planes.shape
+        grid = (s, L // tile)
+        return pl.pallas_call(
+            _kernel_mm_only,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(bm.shape, lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, kk8, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, mm8, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((s, mm8, L), jnp.uint8),
+        )(bmj, planes)
+
+    return run
+
+
+def _kernel_expand_only(data_ref, out_ref):
+    d32 = data_ref[0].astype(jnp.int32)
+    planes = jnp.concatenate([(d32 >> b) & 1 for b in range(8)], axis=0)
+    out_ref[0] = planes.sum(axis=0, keepdims=True).astype(jnp.uint8)[:1]
+
+
+def make_expand_only(tile: int):
+    @jax.jit
+    def run(data):
+        s, k, L = data.shape
+        grid = (s, L // tile)
+        return pl.pallas_call(
+            _kernel_expand_only,
+            grid=grid,
+            in_specs=[pl.BlockSpec((1, k, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((s, 1, L), jnp.uint8),
+        )(data)
+
+    return run
+
+
+def measure(fn, data, label, in_bytes):
+    out = fn(data)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(data)
+    jax.block_until_ready(out)
+    el = time.perf_counter() - t0
+    gbps = in_bytes * ITERS / el / 1e9
+    print(f"{label:28s} {gbps:8.2f} GB/s  ({el/ITERS*1e3:.2f} ms/iter)", flush=True)
+    return gbps
+
+
+def main():
+    want = sys.argv[1:] or None
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+    gfm = isa_rs_vandermonde_matrix(K, M)[K:]
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (BATCH, K, CHUNK), dtype=np.uint8))
+    in_bytes = BATCH * K * CHUNK
+
+    oracle = None
+
+    def check(fn):
+        nonlocal oracle
+        if oracle is None:
+            from ceph_tpu.gf import gf_matmul
+            small = np.asarray(data[:2, :, :1024])
+            oracle = np.stack([gf_matmul(gfm, small[s]) for s in range(2)])
+        got = np.asarray(fn(data[:2, :, :1024]))
+        assert np.array_equal(got, oracle), "parity mismatch"
+
+    variants = {}
+    variants["cur_plan"] = lambda: CodingPlan(gfm)
+    for g in (2, 4, 8):
+        for dt, dn in ((jnp.bfloat16, "bf16"), (jnp.int8, "int8")):
+            for tile in (2048, 4096):
+                variants[f"g{g}_{dn}_t{tile}"] = functools.partial(
+                    make_grouped, gfm, g, dt, tile
+                )
+    variants["g1_int8_t4096"] = functools.partial(make_grouped, gfm, 1, jnp.int8, 4096)
+
+    for name, mk in variants.items():
+        if want and not any(w in name for w in want):
+            continue
+        try:
+            fn = mk()
+            check(fn)
+            measure(fn, data, name, in_bytes)
+        except Exception as e:
+            print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:120]}", flush=True)
+
+    if not want or "mm" in want:
+        # matmul-only ceiling (planes pre-expanded, so 8x the HBM read traffic
+        # in bf16 -> 16x bytes; still shows the MXU-side ceiling per column)
+        planes = jnp.concatenate(
+            [((data.astype(jnp.int32) >> b) & 1) for b in range(8)], axis=1
+        ).astype(jnp.bfloat16)
+        fn = make_mm_only(gfm, 2048)
+        measure(fn, planes, "mm_only(bf16 planes)", in_bytes)
+    if not want or "expand" in want:
+        fn = make_expand_only(4096)
+        measure(fn, data, "expand_only", in_bytes)
+
+
+if __name__ == "__main__":
+    main()

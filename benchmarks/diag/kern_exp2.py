@@ -1,0 +1,167 @@
+"""Kernel experiment round 2: SWAR XOR-schedule formulation vs matmul.
+
+Hypothesis from round 1 (kern_exp.py): expand_only (uint8 cast + 8x shift/and
+per byte) alone runs at ~22 GB/s -- the VPU expansion is the bottleneck, not
+the MXU matmul.  A SWAR formulation on int32 words (4 bytes/elem) does the
+plane extraction with 4x fewer vector elems and no uint8 relayouts, then
+computes output bit-planes as a compile-time XOR schedule (GF(2) linearity
+keeps the 4 packed byte fields independent), assembling output bytes with
+shift+or.  No MXU, no bf16 casts, no uint8 in the kernel.
+
+Usage: python benchmarks/diag/kern_exp2.py [filter ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from ceph_tpu.gf import gf_matmul, isa_rs_vandermonde_matrix
+from ceph_tpu.gf.bitslice import expand_matrix
+from ceph_tpu.ops.pallas_gf import CodingPlan
+
+K, M = 8, 3
+CHUNK = 128 * 1024
+BATCH = 64
+ITERS = 30
+MASK = 0x01010101
+
+
+def schedule_from_matrix(gfm: np.ndarray):
+    """(m, k) GF matrix -> per-output-bit-row list of (j, b) term pairs."""
+    plain = expand_matrix(np.asarray(gfm, dtype=np.uint8))  # (8m, 8k)
+    m8, k8 = plain.shape
+    return [
+        [(c // 8, c % 8) for c in range(k8) if plain[o, c]] for o in range(m8)
+    ]
+
+
+def _kernel_swar(data_ref, out_ref, *, sched, m: int):
+    """data_ref (1, k, 8, WT) int32; out_ref (1, m, 8, WT) int32."""
+    needed = sorted({t for row in sched for t in row})
+    planes = {}
+    for (j, b) in needed:
+        d = data_ref[0, j]  # (8, WT)
+        planes[(j, b)] = (
+            jax.lax.shift_right_logical(d, b) if b else d
+        ) & MASK
+    for i in range(m):
+        word = None
+        for r in range(8):
+            row = sched[i * 8 + r]
+            acc = planes[row[0]]
+            for t in row[1:]:
+                acc = acc ^ planes[t]
+            contrib = acc << r if r else acc
+            word = contrib if word is None else word | contrib
+        out_ref[0, i] = word
+
+
+def make_swar(gfm: np.ndarray, wt: int):
+    """Returns fn: (S, k, L) uint8 -> (S, m, L) uint8 via SWAR kernel."""
+    m, k = gfm.shape
+    sched = schedule_from_matrix(gfm)
+
+    @jax.jit
+    def run(data):
+        s, kk, L = data.shape
+        W = L // 4
+        w8 = W // 8
+        d32 = jax.lax.bitcast_convert_type(
+            data.reshape(s, kk, 8, w8, 4), jnp.int32
+        )  # (s, k, 8, w8)
+        grid = (s, w8 // wt)
+        out32 = pl.pallas_call(
+            functools.partial(_kernel_swar, sched=sched, m=m),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, kk, 8, wt), lambda i, j: (i, 0, 0, j), memory_space=pltpu.VMEM
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (1, m, 8, wt), lambda i, j: (i, 0, 0, j), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((s, m, 8, w8), jnp.int32),
+        )(d32)
+        return jax.lax.bitcast_convert_type(out32, jnp.uint8).reshape(s, m, L)
+
+    return run
+
+
+def make_bitcast_only():
+    """Cost of the uint8 <-> int32 view + reshape round trip alone."""
+
+    @jax.jit
+    def run(data):
+        s, k, L = data.shape
+        w8 = L // 32
+        d32 = jax.lax.bitcast_convert_type(data.reshape(s, k, 8, w8, 4), jnp.int32)
+        return jax.lax.bitcast_convert_type(d32, jnp.uint8).reshape(s, k, L)[:, :3]
+
+    return run
+
+
+def measure(fn, data, label, in_bytes):
+    out = fn(data)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(data)
+    jax.block_until_ready(out)
+    el = time.perf_counter() - t0
+    gbps = in_bytes * ITERS / el / 1e9
+    print(f"{label:28s} {gbps:8.2f} GB/s  ({el/ITERS*1e3:.2f} ms/iter)", flush=True)
+    return gbps
+
+
+def main():
+    want = sys.argv[1:] or None
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+    gfm = isa_rs_vandermonde_matrix(K, M)[K:]
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (BATCH, K, CHUNK), dtype=np.uint8))
+    in_bytes = BATCH * K * CHUNK
+
+    probe = np.asarray(data[:8, :, :16384])
+    oracle = np.stack([gf_matmul(gfm, probe[s]) for s in range(probe.shape[0])])
+
+    def check(fn):
+        got = np.asarray(fn(jnp.asarray(probe)))
+        assert np.array_equal(got, oracle), "parity mismatch"
+
+    variants = {}
+    variants["cur_plan"] = lambda: CodingPlan(gfm)
+    for wt in (128, 256, 512, 1024):
+        variants[f"swar_wt{wt}"] = functools.partial(make_swar, gfm, wt)
+
+    for name, mk in variants.items():
+        if want and not any(w in name for w in want):
+            continue
+        try:
+            fn = mk()
+            check(fn)
+            measure(fn, data, name, in_bytes)
+        except Exception as e:
+            print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+
+    if not want or any("bitcast" in w for w in want):
+        try:
+            fn = make_bitcast_only()
+            measure(fn, data, "bitcast_roundtrip_only", in_bytes)
+        except Exception as e:
+            print(f"bitcast_only FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""Kernel experiment round 3: SWAR XOR-schedule with in-kernel pltpu.bitcast.
+
+The exp2 SWAR variant died on XLA's uint8<->int32 marshalling (5.9 ms just
+for the bitcast round trip: lane-consecutive packing is a slow relayout).
+Here the kernel takes plain uint8 blocks and reinterprets them in VMEM with
+pltpu.bitcast along the SUBLANE axis -- on TPU a (4R, C) uint8 tile already
+stores 4 sublanes packed per 32-bit register row, so the bitcast is a free
+register reinterpret.  The byte->word grouping this induces (bytes strided
+by the lane count) is fine: the GF(2^8) transform is byte-elementwise, so
+any consistent grouping of bytes into words is valid as long as the output
+is bitcast back the same way.
+
+Usage: python benchmarks/diag/kern_exp3.py [filter ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from ceph_tpu.gf import gf_matmul, isa_rs_vandermonde_matrix
+from ceph_tpu.ops.pallas_gf import CodingPlan
+from kern_exp2 import schedule_from_matrix
+
+K, M = 8, 3
+CHUNK = 128 * 1024
+BATCH = 64
+ITERS = 30
+MASK = 0x01010101
+
+
+def _kernel_swar3(data_ref, out_ref, *, sched, m: int):
+    """data_ref (1, k, R, C) uint8; out_ref (1, m, R, C) uint8; R % 4 == 0."""
+    k = data_ref.shape[1]
+    planes = {}
+    for j in range(k):
+        d32 = pltpu.bitcast(data_ref[0, j], jnp.int32)  # (R/4, C)
+        for b in range(8):
+            planes[(j, b)] = (
+                jax.lax.shift_right_logical(d32, b) if b else d32
+            ) & MASK
+    for i in range(m):
+        word = None
+        for r in range(8):
+            row = sched[i * 8 + r]
+            acc = planes[row[0]]
+            for t in row[1:]:
+                acc = acc ^ planes[t]
+            contrib = acc << r if r else acc
+            word = contrib if word is None else word | contrib
+        out_ref[0, i] = pltpu.bitcast(word, jnp.uint8)
+
+
+def make_swar3(gfm: np.ndarray, rows: int, cols: int):
+    """fn: (S, k, L) uint8 -> (S, m, L) uint8.  Block = (rows, cols) bytes."""
+    m, k = gfm.shape
+    sched = schedule_from_matrix(gfm)
+
+    @jax.jit
+    def run(data):
+        s, kk, L = data.shape
+        tile = rows * cols
+        assert L % tile == 0, (L, tile)
+        nt = L // tile
+        d = data.reshape(s, kk, nt, rows, cols)
+        grid = (s, nt)
+        out = pl.pallas_call(
+            functools.partial(_kernel_swar3, sched=sched, m=m),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, kk, 1, rows, cols),
+                    lambda i, j: (i, 0, j, 0, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (1, m, 1, rows, cols),
+                lambda i, j: (i, 0, j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((s, m, nt, rows, cols), jnp.uint8),
+        )(d)
+        return out.reshape(s, m, L)
+
+    return run
+
+
+def measure(fn, data, label, in_bytes):
+    out = fn(data)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(data)
+    jax.block_until_ready(out)
+    el = time.perf_counter() - t0
+    gbps = in_bytes * ITERS / el / 1e9
+    print(f"{label:28s} {gbps:8.2f} GB/s  ({el/ITERS*1e3:.2f} ms/iter)", flush=True)
+    return gbps
+
+
+def main():
+    want = sys.argv[1:] or None
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+    gfm = isa_rs_vandermonde_matrix(K, M)[K:]
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (BATCH, K, CHUNK), dtype=np.uint8))
+    in_bytes = BATCH * K * CHUNK
+
+    probe = np.asarray(data[:4, :, :32768])
+    oracle = np.stack([gf_matmul(gfm, probe[s]) for s in range(probe.shape[0])])
+
+    def check(fn):
+        got = np.asarray(fn(jnp.asarray(probe)))
+        assert np.array_equal(got, oracle), "parity mismatch"
+
+    variants = {"cur_plan": lambda: CodingPlan(gfm)}
+    for rows, cols in ((8, 512), (16, 256), (16, 512), (32, 128), (32, 256), (32, 512), (64, 512), (128, 256)):
+        variants[f"swar3_r{rows}_c{cols}"] = functools.partial(make_swar3, gfm, rows, cols)
+
+    for name, mk in variants.items():
+        if want and not any(w in name for w in want):
+            continue
+        try:
+            fn = mk()
+            check(fn)
+            measure(fn, data, name, in_bytes)
+        except Exception as e:
+            print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

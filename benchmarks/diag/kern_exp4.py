@@ -1,0 +1,94 @@
+"""Kernel experiment round 4: separate launch overhead from kernel compute.
+
+Across exp1-3 the SAME kernel swings 8-21 GB/s between processes, and every
+variant lands in 3-6 ms/iter regardless of content -- smells like per-launch
+overhead (axon = tunneled TPU) rather than compute.  Probes:
+  - copy-only pallas kernel (the floor: HBM read+write, no math)
+  - batch scaling 16/64/256 MB per launch for copy, cur, swar3
+  - repeated interleaved measurement for variance
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from ceph_tpu.gf import isa_rs_vandermonde_matrix
+from ceph_tpu.ops.pallas_gf import CodingPlan
+from kern_exp3 import make_swar3
+
+K, M = 8, 3
+CHUNK = 128 * 1024
+ITERS = 30
+
+
+def _copy_kernel(data_ref, out_ref):
+    out_ref[0] = data_ref[0, :3]
+
+
+def make_copy(tile: int):
+    @jax.jit
+    def run(data):
+        s, k, L = data.shape
+        grid = (s, L // tile)
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec((1, k, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, 3, tile), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((s, 3, L), jnp.uint8),
+        )(data)
+
+    return run
+
+
+def measure(fn, data, label, reps=3):
+    in_bytes = data.shape[0] * data.shape[1] * data.shape[2]
+    out = fn(data)
+    jax.block_until_ready(out)
+    res = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = fn(data)
+        jax.block_until_ready(out)
+        el = time.perf_counter() - t0
+        res.append(in_bytes * ITERS / el / 1e9)
+    msiter = in_bytes * ITERS / max(res) / 1e9 and (in_bytes / max(res) / 1e6)
+    print(
+        f"{label:24s} " + " ".join(f"{g:7.2f}" for g in res) + f" GB/s (best {max(res):.1f}, {msiter:.2f} ms/iter)",
+        flush=True,
+    )
+    return max(res)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+    gfm = isa_rs_vandermonde_matrix(K, M)[K:]
+    rng = np.random.default_rng(0)
+
+    copy = make_copy(4096)
+    cur = CodingPlan(gfm)
+    swar = make_swar3(gfm, 128, 256)
+
+    for batch in (16, 64, 256):
+        data = jnp.asarray(rng.integers(0, 256, (batch, K, CHUNK), dtype=np.uint8))
+        print(f"--- batch={batch} ({batch * K * CHUNK // 2**20} MiB/launch)", flush=True)
+        measure(copy, data, f"copy b{batch}")
+        measure(cur, data, f"cur b{batch}")
+        measure(swar, data, f"swar3_r128_c256 b{batch}")
+        del data
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Kernel experiment round 5: serial-chain methodology (bench.py's) applied
+to the kernel variants.  Round-4 showed that independent repeated launches
+overlap/elide on the axon backend (18 TB/s "copy"), so every measurement here
+chains launch n+1's input on launch n's output with buffer donation, exactly
+like bench.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from ceph_tpu.gf import isa_rs_vandermonde_matrix
+from ceph_tpu.ops.pallas_gf import CodingPlan
+from kern_exp3 import make_swar3
+from kern_exp4 import make_copy
+
+K, M = 8, 3
+CHUNK = 128 * 1024
+ITERS = 30
+
+
+def measure_chained(fn, data, label, reps=3):
+    in_bytes = data.shape[0] * data.shape[1] * data.shape[2]
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(d, p):
+        patch = (p[:1, :1, :128] ^ jnp.uint8(1)).reshape(1, 1, 128)
+        d2 = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
+        return d2, fn(d2)
+
+    p = fn(data)
+    data, p = step(data, p)
+    jax.block_until_ready((data, p))
+    res = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            data, p = step(data, p)
+        jax.block_until_ready((data, p))
+        el = time.perf_counter() - t0
+        res.append(in_bytes * ITERS / el / 1e9)
+    print(
+        f"{label:24s} " + " ".join(f"{g:7.2f}" for g in res)
+        + f" GB/s (best {max(res):.1f}, {in_bytes / max(res) / 1e6:.3f} ms/iter)",
+        flush=True,
+    )
+    return max(res)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+    gfm = isa_rs_vandermonde_matrix(K, M)[K:]
+    rng = np.random.default_rng(0)
+
+    variants = {
+        "copy_t4096": make_copy(4096),
+        "cur_plan": CodingPlan(gfm),
+        "swar3_r128_c256": make_swar3(gfm, 128, 256),
+        "swar3_r32_c128": make_swar3(gfm, 32, 128),
+        "swar3_r512_c256": make_swar3(gfm, 512, 256),
+    }
+    for batch in (64, 256):
+        print(f"--- batch={batch} ({batch * K * CHUNK // 2**20} MiB/launch)", flush=True)
+        for name, fn in variants.items():
+            data = jnp.asarray(rng.integers(0, 256, (batch, K, CHUNK), dtype=np.uint8))
+            try:
+                measure_chained(fn, data, f"{name} b{batch}")
+            except Exception as e:
+                print(f"{name:24s} FAILED: {type(e).__name__}: {str(e)[:140]}", flush=True)
+            del data
+
+
+if __name__ == "__main__":
+    main()

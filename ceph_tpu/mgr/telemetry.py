@@ -34,10 +34,15 @@ class TelemetryModule(MgrModule):
         self.last_report: dict | None = None
         self.reports: list[dict] = []  # the "sent" log (no egress here)
         self._last_sent = 0.0
-        # RANDOM per-cluster salt (the reference's random report id): a
-        # fixed salt would make cluster_id a publicly recomputable hash
-        # of the fsid, de-anonymizing reports
-        self._salt = secrets.token_hex(16)
+        # Cluster salt (the reference's persisted report id): random so a
+        # fixed salt can't make cluster_id a publicly recomputable hash of
+        # the fsid, but cluster-persistent so reports from the same cluster
+        # stay correlated across mgr failovers.  The durable home is the
+        # centralized config DB (`telemetry_salt`, pushed by the
+        # ConfigMonitor like the reference's mgr kv store); the random
+        # value is the fallback for unconfigured clusters and is only
+        # per-instance.
+        self._salt: str | None = None
 
     def on(self) -> None:
         """`ceph telemetry on` — explicit opt-in."""
@@ -46,9 +51,23 @@ class TelemetryModule(MgrModule):
     def off(self) -> None:
         self.enabled = False
 
+    def _get_salt(self) -> str:
+        configured = None
+        conf = getattr(self.mgr, "conf", None)
+        if conf is not None:
+            try:
+                configured = conf.get("telemetry_salt")
+            except KeyError:
+                configured = None
+        if configured:
+            return str(configured)
+        if self._salt is None:
+            self._salt = secrets.token_hex(16)
+        return self._salt
+
     def _cluster_id(self) -> str:
         fsid = getattr(self.mgr.osdmap, "fsid", "") or "unset"
-        return hashlib.sha256((self._salt + fsid).encode()).hexdigest()[:16]
+        return hashlib.sha256((self._get_salt() + fsid).encode()).hexdigest()[:16]
 
     def compile_report(self) -> dict:
         """telemetry's report assembly (module.py compile_report): shapes
@@ -78,9 +97,7 @@ class TelemetryModule(MgrModule):
             "pools": pools,
             "daemons_reporting": len(self.mgr.daemons),
             "health_checks": sorted(
-                code
-                for mod in self.mgr.modules
-                for code in mod.health_checks
+                {code for mod in self.mgr.modules for code in mod.health_checks}
             ),
         }
         self.last_report = report

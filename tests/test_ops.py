@@ -10,7 +10,7 @@ from ceph_tpu.gf import (
     isa_decode_matrix,
     isa_rs_vandermonde_matrix,
 )
-from ceph_tpu.ops.pallas_gf import CodingPlan, arrange_dense_matrix, pick_tile
+from ceph_tpu.ops.pallas_gf import CodingPlan, pick_geometry, schedule_from_matrix
 from ceph_tpu.ops.xor_mm import xor_matmul, xor_reduce
 
 
@@ -47,11 +47,15 @@ def test_xor_reduce():
     )
 
 
-def test_pick_tile():
-    assert pick_tile(128 * 1024) == 4096
-    assert pick_tile(128) == 128
-    assert pick_tile(384) == 128  # 384 = 3*128: only 128 divides
-    assert pick_tile(2048) == 2048
+def test_pick_geometry():
+    # every multiple of 128 has a tile; rows % 4 == 0 always
+    for L in (128, 256, 384, 512, 2048, 4096, 128 * 1024, 1 << 20, 3 * 128):
+        geom = pick_geometry(L)
+        assert geom is not None, L
+        rows, cols = geom
+        assert rows % 4 == 0 and L % (rows * cols) == 0
+    assert pick_geometry(128 * 1024) == (128, 256)  # full-size lane tiles
+    assert pick_geometry(100) is None  # not 128-aligned -> jnp fallback
 
 
 class TestPallasInterpret:
@@ -104,14 +108,12 @@ class TestPallasInterpret:
             assert np.array_equal(out[s], gf_matmul(mat, data[s]))
 
 
-def test_arrange_dense_matrix_layout():
+def test_schedule_from_matrix_layout():
     mat = isa_cauchy_matrix(4, 2)[4:]
-    arranged = arrange_dense_matrix(mat)
+    sched = schedule_from_matrix(mat)
     plain = expand_matrix(mat)
     m, k = mat.shape
-    assert arranged.shape == (8 * m, 8 * k)  # dense: no padded rows
-    for i in range(m):
-        for r in range(8):
-            for b in range(8):
-                for j in range(k):
-                    assert arranged[i * 8 + r, b * k + j] == plain[8 * i + r, 8 * j + b]
+    assert len(sched) == 8 * m  # one term list per output bit-row
+    for o, row in enumerate(sched):
+        want = [(c // 8, c % 8) for c in range(8 * k) if plain[o, c]]
+        assert list(row) == want
