@@ -182,6 +182,13 @@ OPTIONS: dict[str, Option] = _opts(
     Option("jaeger_tracing_enable", bool, False, A,
            "record spans through the EC data path in the in-process tracer "
            "(default off, matching the reference)", runtime=True),
+    # --- mgr modules --------------------------------------------------------
+    Option("telemetry_salt", str, "", A,
+           "cluster-persistent salt for the telemetry report's anonymized "
+           "cluster id; set once (e.g. via the central config DB) so reports "
+           "stay correlated across mgr failovers.  Empty -> a per-mgr random "
+           "salt (ids change on failover).  Mirrors the reference telemetry "
+           "module's persisted report id.", runtime=True),
     # --- fault injection ----------------------------------------------------
     Option("heartbeat_inject_failure", float, 0.0, D,
            "seconds to pretend heartbeats fail (global.yaml.in:865)",

@@ -183,9 +183,6 @@ class CodingPlan:
         self.sched = schedule_from_matrix(gf_matrix)
         self.bm = jnp.asarray(expand_matrix(gf_matrix), dtype=jnp.uint8)
 
-    def supports(self, L: int) -> bool:
-        return pick_geometry(L) is not None
-
     def __call__(self, data: jax.Array) -> jax.Array:
         """(..., k, L) uint8 -> (..., m, L) uint8 coded output."""
         *lead, k, L = data.shape

@@ -15,6 +15,7 @@ import math
 import jax
 from jax.sharding import Mesh
 
+POD_AXIS = "pod"  # DCN boundary: pods are islands of fast ICI
 STRIPE_AXIS = "stripe"  # data-parallel over stripe batches (PG analog)
 LANE_AXIS = "lane"  # intra-chunk byte-range parallelism (SP analog)
 
@@ -22,27 +23,44 @@ LANE_AXIS = "lane"  # intra-chunk byte-range parallelism (SP analog)
 def make_mesh(
     n_devices: int | None = None,
     lane_parallelism: int | None = None,
+    pods: int = 1,
 ) -> Mesh:
-    """Build a (stripe, lane) 2-D mesh over the first n_devices.
+    """Build a (stripe, lane) 2-D mesh — or (pod, stripe, lane) 3-D with
+    `pods` > 1 — over the first n_devices.
 
-    lane_parallelism defaults to the largest power-of-two <= sqrt(n) that
-    divides n, keeping both axes useful without fragmenting either.
+    lane_parallelism defaults to the largest power-of-two <= sqrt(n/pods)
+    that divides n/pods, keeping both intra-pod axes useful without
+    fragmenting either.
+
+    The pod axis is the DCN boundary (multi-pod deployments: devices within
+    a pod share ICI; pods talk over data-center network).  Shardings place
+    stripes over ('pod', 'stripe') jointly, so bulk chunk bytes NEVER cross
+    the pod boundary — only scalar scrub reductions do (see
+    sharded.scrub_step), which is the right DCN design: ICI carries tiles,
+    DCN carries verdicts.  Device order follows jax.devices(), which enumerates
+    ICI-adjacent devices contiguously, so a contiguous slice per pod row
+    matches the physical topology.
     """
     devices = jax.devices()
     n = n_devices or len(devices)
     devices = devices[:n]
+    assert n % pods == 0, (n, pods)
+    per_pod = n // pods
     if lane_parallelism is None:
         lane_parallelism = 1
         while (
-            lane_parallelism * 2 <= math.isqrt(n)
-            and n % (lane_parallelism * 2) == 0
+            lane_parallelism * 2 <= math.isqrt(per_pod)
+            and per_pod % (lane_parallelism * 2) == 0
         ):
             lane_parallelism *= 2
-    assert n % lane_parallelism == 0
+    assert per_pod % lane_parallelism == 0
     import numpy as np
 
     grid = np.empty(n, dtype=object)
     for i, d in enumerate(devices):
         grid[i] = d
-    grid = grid.reshape(n // lane_parallelism, lane_parallelism)
+    if pods > 1:
+        grid = grid.reshape(pods, per_pod // lane_parallelism, lane_parallelism)
+        return Mesh(grid, (POD_AXIS, STRIPE_AXIS, LANE_AXIS))
+    grid = grid.reshape(per_pod // lane_parallelism, lane_parallelism)
     return Mesh(grid, (STRIPE_AXIS, LANE_AXIS))

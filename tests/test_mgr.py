@@ -264,3 +264,30 @@ class TestTelemetry:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestTelemetrySalt:
+    def test_salt_from_config_is_failover_stable(self):
+        """With telemetry_salt configured (the central-config path), two
+        module instances — the failover scenario — produce the SAME
+        cluster_id; without it, ids are per-instance random."""
+        from ceph_tpu.common.config import Config
+        from ceph_tpu.mgr.telemetry import TelemetryModule
+
+        class FakeMgr:
+            def __init__(self, conf):
+                self.conf = conf
+                self.osdmap = type("M", (), {"fsid": "abc-123"})()
+
+        conf = Config({"name": "mgr.x", "telemetry_salt": "s3cret"})
+        a, b = TelemetryModule(), TelemetryModule()
+        a.mgr, b.mgr = FakeMgr(conf), FakeMgr(conf)
+        assert a._cluster_id() == b._cluster_id()
+        # and it is a salted hash, not the raw fsid
+        assert "abc-123" not in a._cluster_id()
+
+        unconf = Config({"name": "mgr.y"})
+        c, d = TelemetryModule(), TelemetryModule()
+        c.mgr, d.mgr = FakeMgr(unconf), FakeMgr(unconf)
+        assert c._cluster_id() != d._cluster_id()  # random per instance
+        assert c._cluster_id() == c._cluster_id()  # but stable within one
