@@ -126,8 +126,20 @@ class Objecter(Dispatcher):
 
     # -- targeting -------------------------------------------------------------
 
+    def _effective_pool(self, pool_id: int) -> int:
+        """Cache-tier overlay redirect (Objecter.cc _calc_target honoring
+        pg_pool_t.read_tier): ops targeting a base pool with an overlay go
+        to the cache pool; the cache PG promotes/flushes against the base
+        (PrimaryLogPG promote_object / agent).  Re-evaluated every resend,
+        so adding/removing an overlay retargets in-flight retries."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is not None and pool.read_tier >= 0 and pool.read_tier in self.osdmap.pools:
+            return pool.read_tier
+        return pool_id
+
     def _calc_target(self, pool_id: int, oid: str) -> tuple[PgId, int]:
         """_calc_target (Objecter.cc:2775): (pgid, acting_primary)."""
+        pool_id = self._effective_pool(pool_id)
         _pool, ps = self.osdmap.object_to_pg(pool_id, oid)
         _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
         return PgId(pool_id, ps, -1), primary

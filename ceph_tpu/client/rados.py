@@ -213,6 +213,20 @@ class IoCtx:
         )
         _check(rep.result, f"copy_from {src_oid} -> {oid}")
 
+    # -- cache tiering ---------------------------------------------------------
+
+    async def cache_flush(self, oid: str) -> None:
+        """Write a dirty cache-tier object back to its base pool
+        (rados cache-flush / CEPH_OSD_OP_CACHE_FLUSH)."""
+        rep = await self._op(oid, [OSDOp(op=OSDOp.CACHE_FLUSH)])
+        _check(rep.result, f"cache_flush {oid}")
+
+    async def cache_evict(self, oid: str) -> None:
+        """Drop a clean object from the cache tier (rados cache-evict);
+        -EBUSY while dirty."""
+        rep = await self._op(oid, [OSDOp(op=OSDOp.CACHE_EVICT)])
+        _check(rep.result, f"cache_evict {oid}")
+
     # -- watch / notify --------------------------------------------------------
 
     async def watch(self, oid: str, callback) -> int:

@@ -5,7 +5,9 @@ one place keeps errno audits greppable."""
 ENOENT = 2
 EIO = 5
 EAGAIN = 11
+EBUSY = 16
 EINVAL = 22
+EPERM = 1
 EEXIST = 17
 EXDEV = 18
 ETIMEDOUT = 110

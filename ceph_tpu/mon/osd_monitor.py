@@ -215,6 +215,11 @@ class OSDMonitor:
             "osd reweight": (self._cmd_reweight, True),
             "osd pool set": (self._cmd_pool_set, True),
             "osd pool selfmanaged-snap-create": (self._cmd_snap_create, True),
+            "osd tier add": (self._cmd_tier_add, True),
+            "osd tier remove": (self._cmd_tier_remove, True),
+            "osd tier cache-mode": (self._cmd_tier_cache_mode, True),
+            "osd tier set-overlay": (self._cmd_tier_set_overlay, True),
+            "osd tier remove-overlay": (self._cmd_tier_remove_overlay, True),
         }
         entry = handlers.get(prefix)
         if entry is None:
@@ -364,6 +369,109 @@ class OSDMonitor:
 
         self._queue(mutate, lambda rv, rs: reply(rv, rs))
 
+    # -- cache tiering (OSDMonitor prepare_command `osd tier ...`) -----------
+
+    def _cmd_tier_add(self, cmd, reply) -> None:
+        """`osd tier add <base> <tierpool>` — attach tierpool as a cache
+        tier of base (OSDMonitor.cc tier add: both must exist, neither may
+        already be in a tier relationship)."""
+        base_n, tier_n = cmd["pool"], cmd["tierpool"]
+
+        def mutate(m: OSDMap) -> str:
+            base, tier = m.get_pool(base_n), m.get_pool(tier_n)
+            if base is None or tier is None:
+                raise KeyError(f"no such pool {base_n if base is None else tier_n}")
+            if tier.tier_of >= 0:
+                raise ValueError(f"pool '{tier_n}' is already a tier")
+            if tier.tiers or base.tier_of >= 0:
+                raise ValueError("tiers cannot be stacked")
+            if tier.id == base.id:
+                raise ValueError("pool cannot be a tier of itself")
+            if tier.is_erasure():
+                # The reference requires replicated cache pools too
+                # (OSDMonitor tier add: EC tiers rejected).
+                raise ValueError("cache tier pools must be replicated")
+            tier.tier_of = base.id
+            base.tiers.append(tier.id)
+            return f"pool '{tier_n}' is now (or already was) a tier of '{base_n}'"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_tier_remove(self, cmd, reply) -> None:
+        base_n, tier_n = cmd["pool"], cmd["tierpool"]
+
+        def mutate(m: OSDMap) -> str:
+            base, tier = m.get_pool(base_n), m.get_pool(tier_n)
+            if base is None or tier is None:
+                raise KeyError(f"no such pool {base_n if base is None else tier_n}")
+            if tier.tier_of != base.id:
+                raise ValueError(f"pool '{tier_n}' is not a tier of '{base_n}'")
+            if base.read_tier == tier.id:
+                raise ValueError("remove the overlay first (osd tier remove-overlay)")
+            tier.tier_of = -1
+            tier.cache_mode = "none"
+            base.tiers.remove(tier.id)
+            return f"pool '{tier_n}' is now (or already was) not a tier of '{base_n}'"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_tier_cache_mode(self, cmd, reply) -> None:
+        tier_n, mode = cmd["pool"], cmd["mode"]
+
+        def mutate(m: OSDMap) -> str:
+            tier = m.get_pool(tier_n)
+            if tier is None:
+                raise KeyError(f"no such pool {tier_n}")
+            if tier.tier_of < 0:
+                raise ValueError(f"pool '{tier_n}' is not a tier")
+            if mode not in ("none", "writeback", "readonly"):
+                raise ValueError(f"unknown cache mode '{mode}'")
+            base = m.get_pool(tier.tier_of)
+            if mode == "none" and base is not None and base.read_tier == tier.id:
+                # mode 'none' disables the PG-side tier gate while clients
+                # still redirect to this pool: base-resident data would
+                # stop promoting.  Same ordering rule as tier remove.
+                raise ValueError(
+                    "pool is an overlay; remove the overlay first "
+                    "(osd tier remove-overlay)"
+                )
+            tier.cache_mode = mode
+            return f"set cache-mode for pool '{tier_n}' to {mode}"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_tier_set_overlay(self, cmd, reply) -> None:
+        """`osd tier set-overlay <base> <overlaypool>` — clients targeting
+        base redirect ops to the overlay (Objecter _calc_target read_tier)."""
+        base_n, overlay_n = cmd["pool"], cmd["overlaypool"]
+
+        def mutate(m: OSDMap) -> str:
+            base, overlay = m.get_pool(base_n), m.get_pool(overlay_n)
+            if base is None or overlay is None:
+                raise KeyError(
+                    f"no such pool {base_n if base is None else overlay_n}"
+                )
+            if overlay.tier_of != base.id:
+                raise ValueError(f"pool '{overlay_n}' is not a tier of '{base_n}'")
+            if overlay.cache_mode == "none":
+                raise ValueError("set a cache-mode first (osd tier cache-mode)")
+            base.read_tier = overlay.id
+            return f"overlay for '{base_n}' is now (or already was) '{overlay_n}'"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_tier_remove_overlay(self, cmd, reply) -> None:
+        base_n = cmd["pool"]
+
+        def mutate(m: OSDMap) -> str:
+            base = m.get_pool(base_n)
+            if base is None:
+                raise KeyError(f"no such pool {base_n}")
+            base.read_tier = -1
+            return f"there is now (or already was) no overlay for '{base_n}'"
+
+        self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
     def _cmd_dump(self, cmd, reply) -> None:
         m = self.osdmap
         reply(
@@ -486,6 +594,8 @@ class OSDMonitor:
                 pool.min_size = int(val)
             elif var == "fast_read":
                 pool.fast_read = str(val).lower() in ("1", "true", "yes")
+            elif var == "target_max_objects":
+                pool.target_max_objects = int(val)
             else:
                 raise ValueError(f"unknown pool variable {var!r}")
             return f"set pool {name} {var} to {val}"

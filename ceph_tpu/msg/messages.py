@@ -68,6 +68,8 @@ class OSDOp(Struct):
     WATCH = 13        # register/unregister a watch (off = cookie, len = 1/0)
     NOTIFY = 14       # notify watchers (data = payload, off = timeout ms)
     COPY_FROM = 15    # copy another object's content (name = src oid)
+    CACHE_FLUSH = 16  # write a dirty cache-tier object back to the base pool
+    CACHE_EVICT = 17  # drop a clean object from the cache tier
 
     FIELDS = [
         ("op", "u8"),

@@ -303,6 +303,12 @@ class OSD(Dispatcher):
                         pg = self.pgs[key] = PG(
                             self, pool, ps, self.osdmap.erasure_code_profiles
                         )
+                    else:
+                        # Full-map decodes build fresh PgPool objects, and
+                        # pool metadata mutates across epochs (cache-tier
+                        # attach/overlay, target sizes): the PG must see
+                        # the CURRENT pool, not its creation-time snapshot.
+                        pg.pool = pool
                     pg.on_new_interval(epoch, acting)
                 elif key in self.pgs:
                     # no longer in the acting set: drop the in-memory PG
@@ -495,13 +501,20 @@ class OSD(Dispatcher):
 
     # -- ordered cluster sends -------------------------------------------------
 
-    def internal_read(
-        self, pool_id: int, oid: str, snap_id: int, cb, timeout: float = 5.0
+    def internal_op(
+        self,
+        pool_id: int,
+        oid: str,
+        ops: list[OSDOp],
+        cb,
+        snap_id: int = 0,
+        timeout: float = 5.0,
     ) -> None:
-        """Whole-object fetch with this OSD acting as a RADOS client toward
-        the object's primary — the objecter leg of COPY_FROM
-        (PrimaryLogPG::do_copy_from → Objecter).  cb(err, data); -EAGAIN
-        on timeout or unplaceable source so the client op retries."""
+        """One op with this OSD acting as a RADOS client toward the
+        object's primary — the objecter leg of COPY_FROM and of the cache
+        tier's promote/flush (PrimaryLogPG::do_copy_from / agent_work →
+        Objecter).  cb(err, data); -EAGAIN on timeout or unplaceable
+        target so the calling op retries."""
         from ..common.errs import EAGAIN
 
         _pool, ps = self.osdmap.object_to_pg(pool_id, oid)
@@ -525,10 +538,19 @@ class OSD(Dispatcher):
                 reqid=ReqId(client=f"osd.{self.whoami}", tid=tid),
                 pgid=PgId(pool_id, ps, -1),
                 oid=oid,
-                ops=[OSDOp(op=OSDOp.READ)],
+                ops=ops,
                 epoch=self.osdmap.epoch,
                 snap_id=snap_id,
             ),
+        )
+
+    def internal_read(
+        self, pool_id: int, oid: str, snap_id: int, cb, timeout: float = 5.0
+    ) -> None:
+        """Whole-object fetch via internal_op (cb(err, data))."""
+        self.internal_op(
+            pool_id, oid, [OSDOp(op=OSDOp.READ)], cb, snap_id=snap_id,
+            timeout=timeout,
         )
 
     def send_cluster(self, osd: int, msg: Message) -> None:

@@ -77,9 +77,20 @@ class PgPool:
     flags: int = 0
     fast_read: bool = False
     snap_seq: int = 0  # self-managed snap id allocator (pg_pool_t::snap_seq)
+    # Cache tiering (pg_pool_t tier_of/read_tier/cache_mode,
+    # src/osd/osd_types.h; administered via `osd tier ...`,
+    # src/mon/OSDMonitor.cc prepare_command tier block):
+    tier_of: int = -1  # base pool this pool is a cache tier FOR
+    tiers: list[int] = field(default_factory=list)  # cache pools over this one
+    read_tier: int = -1  # overlay: clients redirect ops here (set-overlay)
+    cache_mode: str = "none"  # none | writeback | readonly
+    target_max_objects: int = 0  # tier agent flush/evict threshold (0 = off)
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE
+
+    def is_cache_tier(self) -> bool:
+        return self.tier_of >= 0 and self.cache_mode != "none"
 
     def raw_pg_to_pps(self, ps: int) -> int:
         """Placement seed: pool id folded into the pg seed
@@ -211,7 +222,10 @@ class OSDMap(Encodable):
     # -- encoding ------------------------------------------------------------
 
     def encode(self, enc: Encoder) -> None:
-        enc.start(1, 1)
+        # v2 appends the per-pool tiering map AFTER the v1 payload, so v1
+        # decoders skip it via the frame length (the reference's rolling-
+        # upgrade convention, src/include/encoding.h ENCODE_START).
+        enc.start(2, 1)
         enc.u32(self.epoch)
         enc.string(self.fsid)
         enc.map_(
@@ -250,12 +264,30 @@ class OSDMap(Encodable):
             ),
         )
         self.crush.encode(enc)
+        # --- v2 trailer: cache tiering ----------------------------------
+        tiered = {
+            pid: p
+            for pid, p in self.pools.items()
+            if p.tier_of >= 0 or p.tiers or p.read_tier >= 0
+            or p.cache_mode != "none" or p.target_max_objects
+        }
+        enc.map_(
+            tiered,
+            lambda e, k: e.u32(k),
+            lambda e, p: (
+                e.i64(p.tier_of),
+                e.list_(p.tiers, lambda e2, t: e2.u32(t)),
+                e.i64(p.read_tier),
+                e.string(p.cache_mode),
+                e.u64(p.target_max_objects),
+            ),
+        )
         enc.finish()
 
     @classmethod
     def decode(cls, dec: Decoder) -> "OSDMap":
         m = cls()
-        dec.start(1)
+        struct_v = dec.start(2)
         m.epoch = dec.u32()
         m.fsid = dec.string()
         m.osds = dec.map_(
@@ -292,6 +324,22 @@ class OSDMap(Encodable):
             lambda d: d.map_(lambda d2: d2.string(), lambda d2: d2.string()),
         )
         m.crush = CrushWrapper.decode(dec)
+        if struct_v >= 2:
+            tiered = dec.map_(
+                lambda d: d.u32(),
+                lambda d: dict(
+                    tier_of=d.i64(),
+                    tiers=d.list_(lambda d2: d2.u32()),
+                    read_tier=d.i64(),
+                    cache_mode=d.string(),
+                    target_max_objects=d.u64(),
+                ),
+            )
+            for pid, kw in tiered.items():
+                p = m.pools.get(pid)
+                if p is not None:
+                    for attr, val in kw.items():
+                        setattr(p, attr, val)
         dec.finish()
         return m
 

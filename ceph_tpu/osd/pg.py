@@ -23,9 +23,10 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import OrderedDict
 from typing import TYPE_CHECKING, Callable
 
-from ..common.errs import EAGAIN, EINVAL, ENODATA, ENOENT
+from ..common.errs import EAGAIN, EBUSY, EINVAL, ENODATA, ENOENT, EPERM
 from ..common.log import dout
 from ..msg.messages import (
     MBackfillReserve,
@@ -56,6 +57,11 @@ WRITE_OPS = {
     OSDOp.ROLLBACK,
     OSDOp.COPY_FROM,
 }
+
+# Cache-tier dirty marker (object_info_t FLAG_DIRTY analog): set by client
+# writes on a writeback cache PG, cleared by flush; rides the write
+# transaction so replicas agree.
+DIRTY_ATTR = "cache_dirty"
 
 
 class PG(PGListener):
@@ -112,6 +118,16 @@ class PG(PGListener):
         self._notify_id = 0
         # notify_id -> {"pending": set[(entity, cookie)], "acks", "finish"}
         self._notifies: dict[int, dict] = {}
+        # cache tiering (PrimaryLogPG promote_object / TierAgent):
+        self._promoting: dict[str, list] = {}  # oid -> queued (msg,reply,conn)
+        self._tier_pass: set[tuple[str, int]] = set()  # reqids past the gate
+        self._tier_lru: "OrderedDict[str, None]" = OrderedDict()
+        self._tier_tid = 0
+        self._tier_agent_busy = False
+        # oids mid-flush: writes are blocked (queued) until the write-back
+        # and dirty-clear land, else a racing write could be marked clean
+        # and lost on evict (the reference's wait_for_blocked_object).
+        self._flushing: dict[str, list] = {}
 
     # -- interval / peering ----------------------------------------------------
 
@@ -346,6 +362,17 @@ class PG(PGListener):
             # here the character is reserved.
             reply(self._errored(msg, -EINVAL))
             return
+        # Cache-tier gate (PrimaryLogPG::maybe_handle_cache): promote on
+        # miss, forward deletes to the base, reject writes on readonly.
+        # OSD-internal traffic ("osd." clients: promote writes, flush acks)
+        # bypasses it.
+        if (
+            self.pool.is_cache_tier()
+            and msg.reqid.client
+            and not msg.reqid.client.startswith("osd.")
+            and not self._tier_gate(msg, reply, conn)
+        ):
+            return
         first = msg.ops[0].op if msg.ops else 0
         if first == OSDOp.WATCH:
             self._do_watch(conn, msg, reply)
@@ -465,6 +492,18 @@ class PG(PGListener):
                     ss.seq = newest
                     ss.born = newest
                     pgt.attrs[SS_ATTR] = ss.encode()
+        # Cache-tier dirty marking (object_info_t FLAG_DIRTY): client
+        # mutations on a writeback cache are flush candidates; internal
+        # writes (promotes, flush bookkeeping) stay clean.
+        if (
+            self.pool.cache_mode == "writeback"
+            and self.pool.tier_of >= 0
+            and not pgt.delete
+            and msg.reqid.client
+            and not msg.reqid.client.startswith("osd.")
+        ):
+            pgt.attrs[DIRTY_ATTR] = b"1"
+
         def finish(rep: MOSDOpReply, remember: bool) -> None:
             self._finish_write(msg, reply, rep, remember)
 
@@ -593,6 +632,16 @@ class PG(PGListener):
             self._reqid_results[key] = rep
             if len(self._reqid_results) > 1000:  # bounded dup window
                 self._reqid_results.pop(next(iter(self._reqid_results)))
+        # Cache-tier residency bookkeeping: every completed mutation is the
+        # authoritative place to learn an object now exists (first writes
+        # arrive via the promotion pass-through, which skips the gate's
+        # touch) or is gone (deletes).
+        if self.pool.is_cache_tier() and rep.result == 0:
+            if self._object_exists(msg.oid):
+                self._tier_touch(msg.oid)
+                self._tier_maybe_agent()
+            else:
+                self._tier_lru.pop(msg.oid, None)
         reply(rep)
         for dup_reply in self._inflight_reqids.pop(key, []):
             dup_reply(rep)
@@ -688,6 +737,275 @@ class PG(PGListener):
             self._do_write(msg, reply)
 
         self.osd.internal_read(self.pool.id, src, src_snap, on_fetched)
+
+    # -- cache tiering (PrimaryLogPG maybe_handle_cache / TierAgentState) ------
+
+    def _tier_gate(self, msg: MOSDOp, reply, conn) -> bool:
+        """Returns True to continue normal dispatch, False when the op was
+        consumed (promotion in flight, forwarded, or rejected).
+
+        Scope mirrors the reference's writeback/readonly modes with two
+        documented simplifications: promotion copies object BYTES (not
+        xattrs), and cache pools don't combine with pool snapshots.
+        """
+        first = msg.ops[0].op if msg.ops else 0
+        writing = any(op.op in WRITE_OPS for op in msg.ops)
+        if msg.oid in self._flushing and (
+            writing or first in (OSDOp.CACHE_FLUSH, OSDOp.CACHE_EVICT)
+        ):
+            # Mid-flush: a write racing the write-back could get its dirty
+            # mark cleared and then be evicted — queue until the flush
+            # completes (PrimaryLogPG wait_for_blocked_object).
+            self._flushing[msg.oid].append((msg, reply, conn))
+            return False
+        if first == OSDOp.CACHE_FLUSH:
+            self._do_cache_flush(msg, reply)
+            return False
+        if first == OSDOp.CACHE_EVICT:
+            self._do_cache_evict(msg, reply)
+            return False
+        if first in (OSDOp.PGLS, OSDOp.NOTIFY):
+            return True
+        key = msg.reqid.key()
+        if key in self._tier_pass:
+            return True
+        if writing and self.pool.cache_mode == "readonly":
+            reply(self._errored(msg, -EPERM))
+            return False
+        pure_delete = (
+            writing
+            and all(op.op == OSDOp.DELETE for op in msg.ops)
+            and not msg.snap_id
+        )
+        if pure_delete and self.pool.cache_mode == "writeback":
+            # Forward the delete to the base pool FIRST: a cache-only
+            # delete would resurrect from the base on the next miss.
+            def on_base(err: int, _data: bytes) -> None:
+                if err and err != -ENOENT:
+                    reply(self._errored(msg, err))
+                    return
+                self._tier_lru.pop(msg.oid, None)
+                self._tier_pass.add(key)
+                try:
+                    self.do_op(msg, reply, conn)
+                finally:
+                    self._tier_pass.discard(key)
+
+            self.osd.internal_op(
+                self.pool.tier_of, msg.oid, [OSDOp(op=OSDOp.DELETE)], on_base
+            )
+            return False
+        if self._object_exists(msg.oid):
+            self._tier_touch(msg.oid)
+            if writing:
+                self._tier_maybe_agent()
+            return True
+        # Miss: promote from the base pool, queue the op behind the fetch
+        # (PrimaryLogPG::promote_object + wait_for_blocked_object).
+        entry = (msg, reply, conn)
+        waiters = self._promoting.get(msg.oid)
+        if waiters is not None:
+            waiters.append(entry)
+            return False
+        self._promoting[msg.oid] = [entry]
+        if writing:
+            self._tier_maybe_agent()
+
+        def on_fetched(err: int, data: bytes) -> None:
+            self._tier_promoted(msg.oid, err, data)
+
+        self.osd.internal_read(self.pool.tier_of, msg.oid, 0, on_fetched)
+        return False
+
+    def _tier_drain(self, oid: str) -> None:
+        """Re-dispatch ops queued behind a promotion; each gets a one-shot
+        gate pass so a base-absent object can't loop through promotion."""
+        for m, r, c in self._promoting.pop(oid, []):
+            k = m.reqid.key()
+            self._tier_pass.add(k)
+            try:
+                self.do_op(m, r, c)
+            finally:
+                self._tier_pass.discard(k)
+
+    def _tier_promoted(self, oid: str, err: int, data: bytes) -> None:
+        if err == -ENOENT:
+            # Base has nothing: reads answer ENOENT, writes create fresh.
+            self._tier_drain(oid)
+            return
+        if err:
+            for m, r, _c in self._promoting.pop(oid, []):
+                r(self._errored(m, -EAGAIN if err == -EAGAIN else err))
+            return
+        # Write the promoted copy through the replicated pipeline as an
+        # internal (clean, non-dirty) object, then release the waiters.
+        self._tier_tid += 1
+        pm = MOSDOp(
+            reqid=ReqId(client=f"osd.{self.osd.whoami}.promote", tid=self._tier_tid),
+            pgid=PgId(self.pool.id, self.pgid.ps, -1),
+            oid=oid,
+            ops=[OSDOp(op=OSDOp.WRITEFULL, data=data)],
+            epoch=self._epoch,
+        )
+
+        def on_written(rep: MOSDOpReply) -> None:
+            if rep.result:
+                for m, r, _c in self._promoting.pop(oid, []):
+                    r(self._errored(m, rep.result))
+                return
+            self._tier_touch(oid)
+            self._tier_drain(oid)
+
+        self.do_op(pm, on_written)
+
+    def _tier_touch(self, oid: str) -> None:
+        self._tier_lru[oid] = None
+        self._tier_lru.move_to_end(oid)
+
+    def _is_dirty(self, oid: str) -> bool:
+        return bool(self._getxattr(oid, DIRTY_ATTR))
+
+    def _tier_flush(self, oid: str, done) -> None:
+        """Write a dirty object's bytes back to the base pool, then clear
+        the dirty marker through the replicated pipeline.  done(err).
+        Writes on `oid` are blocked (queued in _flushing) for the duration,
+        so the clear cannot race a fresh mutation."""
+        if not self._object_exists(oid):
+            done(-ENOENT)
+            return
+        if not self._is_dirty(oid):
+            done(0)
+            return
+        if oid in self._flushing:
+            done(-EBUSY)  # a flush is already running; writes are queued
+            return
+        self._flushing[oid] = []
+        coll = shard_coll(self.pgid, -1)
+        data = self.osd.store.read(coll, oid, 0, self._object_size(oid))
+
+        def finish(err: int) -> None:
+            waiters = self._flushing.pop(oid, [])
+            done(err)
+            for m, r, c in waiters:
+                self.do_op(m, r, c)
+
+        def on_ack(err: int, _data: bytes) -> None:
+            if err:
+                finish(err)
+                return
+            pgt = PGTransaction(oid=oid)
+            pgt.attrs[DIRTY_ATTR] = None  # rm
+            self._tier_tid += 1
+            self.backend.submit_transaction(
+                pgt,
+                ReqId(client=f"osd.{self.osd.whoami}.flush", tid=self._tier_tid),
+                lambda: finish(0),
+            )
+
+        self.osd.internal_op(
+            self.pool.tier_of, oid, [OSDOp(op=OSDOp.WRITEFULL, data=bytes(data))],
+            on_ack,
+        )
+
+    def _tier_evict(self, oid: str, done) -> None:
+        """Drop a CLEAN object from the cache (local delete only — the base
+        copy is authoritative; the next miss re-promotes).  done(err)."""
+        if not self._object_exists(oid):
+            done(-ENOENT)
+            return
+        if self._is_dirty(oid):
+            done(-EBUSY)
+            return
+        pgt = PGTransaction(oid=oid, delete=True)
+        self._tier_tid += 1
+        self._tier_lru.pop(oid, None)
+        self.backend.submit_transaction(
+            pgt,
+            ReqId(client=f"osd.{self.osd.whoami}.evict", tid=self._tier_tid),
+            lambda: done(0),
+        )
+
+    def _tier_op_done(self, msg: MOSDOp, reply):
+        """done(err) closure answering a CACHE_FLUSH/CACHE_EVICT client op."""
+
+        def done(err: int) -> None:
+            if err:
+                reply(self._errored(msg, err))
+            else:
+                reply(
+                    MOSDOpReply(
+                        reqid=msg.reqid,
+                        result=0,
+                        outdata=[b""] * len(msg.ops),
+                        version=self._version,
+                        epoch=self._epoch,
+                    )
+                )
+
+        return done
+
+    def _do_cache_flush(self, msg: MOSDOp, reply) -> None:
+        self._tier_flush(msg.oid, self._tier_op_done(msg, reply))
+
+    def _do_cache_evict(self, msg: MOSDOp, reply) -> None:
+        self._tier_evict(msg.oid, self._tier_op_done(msg, reply))
+
+    def _tier_share(self) -> int:
+        """This PG's slice of the pool-wide object target (ceil split;
+        the reference agent works from per-PG dirty/full ratios)."""
+        return -(-self.pool.target_max_objects // max(1, self.pool.pg_num))
+
+    def _tier_maybe_agent(self) -> None:
+        """Cheap write-path trigger: only schedule the agent's full store
+        scan when the in-memory LRU (an approximate local head count —
+        rebuilt lazily after a primary restart) crosses the PG's share."""
+        if (
+            self.pool.target_max_objects
+            and self.pool.cache_mode == "writeback"
+            and len(self._tier_lru) > self._tier_share()
+        ):
+            asyncio.get_event_loop().call_soon(self._tier_agent)
+
+    def _tier_agent(self) -> None:
+        """Flush-and-evict down to target_max_objects, coldest first
+        (TierAgentState evict_mode; utilization-driven in the reference,
+        object-count-driven here).  One object per pass; reschedules
+        itself until under target."""
+        target = self.pool.target_max_objects
+        if (
+            not target
+            or self.pool.cache_mode != "writeback"
+            or self._tier_agent_busy
+            or not self.peering.is_primary()
+        ):
+            return
+        share = self._tier_share()
+        heads = [o for o in self._list_local() if "@" not in o]
+        if len(heads) <= share:
+            return
+        # coldest = LRU order, with never-touched objects (e.g. after a
+        # primary restart, the in-memory LRU is empty) treated as coldest
+        in_lru = {o: i for i, o in enumerate(self._tier_lru)}
+        victim = min(heads, key=lambda o: in_lru.get(o, -1))
+        self._tier_agent_busy = True
+
+        def evicted(err: int) -> None:
+            self._tier_agent_busy = False
+            loop = asyncio.get_event_loop()
+            if err:
+                # e.g. base pool unplaceable (-EAGAIN): back off instead of
+                # spinning call_soon against the same stuck victim
+                loop.call_later(0.5, self._tier_agent)
+            else:
+                loop.call_soon(self._tier_agent)
+
+        def flushed(err: int) -> None:
+            if err:
+                evicted(err)
+                return
+            self._tier_evict(victim, evicted)
+
+        self._tier_flush(victim, flushed)
 
     # -- watch / notify (PrimaryLogPG watchers, Watch.cc) ----------------------
 

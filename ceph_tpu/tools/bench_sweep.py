@@ -65,26 +65,42 @@ BASELINE_CONFIGS = [
 def run_bulk(ec, size: int, batch: int, iters: int) -> tuple[float, int]:
     """BASELINE config 3: many stripes in flight through the held device
     executable (codec encode_array on a (S, k, L) batch) — the batched
-    bulk-rebuild path, not per-object calls."""
-    import jax
-    import numpy as np
+    bulk-rebuild path, not per-object calls.
 
+    Serial-chain methodology (same as bench.py): each launch's input is
+    patched with bytes of the previous launch's parity under buffer
+    donation, and a tiny device->host readback closes the timing window.
+    Both guards matter on the axon backend, which caches identical launches
+    and whose block_until_ready has been observed returning early — repeated
+    same-input launches report impossible TB/s numbers.
+    """
+    import functools
+
+    import jax
     import jax.numpy as jnp
+    import numpy as np
 
     k = ec.get_data_chunk_count()
     chunk = ec.get_chunk_size(size)
     data = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, (batch, k, chunk), dtype=np.uint8)
     )
-    out = ec.encode_array(data)  # warm/compile
-    out.block_until_ready()
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(d, p):
+        n = min(128, chunk)
+        patch = (p[:1, :1, :n] ^ jnp.uint8(1)).reshape(1, 1, n)
+        d2 = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
+        return d2, ec.encode_array(d2)
+
+    p = ec.encode_array(data)
+    data, p = step(data, p)  # compile + warm
+    jax.block_until_ready((data, p))
     t0 = time.perf_counter()
     for _ in range(iters):
-        # JAX dispatches every call — there is no result memoization for
-        # identical launches — so re-encoding the same resident batch is a
-        # clean steady-state measurement with no per-iteration device copy.
-        out = ec.encode_array(data)
-    jax.block_until_ready(out)
+        data, p = step(data, p)
+    jax.block_until_ready((data, p))
+    _ = np.asarray(p[0, 0, :8])
     return time.perf_counter() - t0, batch * k * chunk * iters
 
 

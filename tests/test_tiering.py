@@ -1,0 +1,290 @@
+"""Cache tiering: overlay redirect, promotion on miss, writeback dirty
+tracking, flush/evict, delete forwarding, and the tier agent.
+
+Models the reference's cache-tier coverage (PrimaryLogPG
+maybe_handle_cache / promote_object, OSDMonitor `osd tier *` commands,
+qa/workunits tiering suites) over live clusters.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import Rados, RadosError
+
+from test_cluster import start_cluster, stop_cluster, wait_until
+
+
+async def _tiered_cluster(cache_mode="writeback", target_max_objects=0):
+    monmap, mons, osds = await start_cluster(1, 3)
+    client = Rados(monmap)
+    await client.connect()
+    await client.pool_create("base", "replicated", pg_num=4)
+    await client.pool_create("hot", "replicated", pg_num=4)
+    for prefix, cmd in [
+        ("osd tier add", {"pool": "base", "tierpool": "hot"}),
+        ("osd tier cache-mode", {"pool": "hot", "mode": cache_mode}),
+        ("osd tier set-overlay", {"pool": "base", "overlaypool": "hot"}),
+    ]:
+        rv, rs, _ = await client.mon_command({"prefix": prefix, **cmd})
+        assert rv == 0, (prefix, rs)
+    if target_max_objects:
+        rv, rs, _ = await client.mon_command(
+            {
+                "prefix": "osd pool set",
+                "pool": "hot",
+                "var": "target_max_objects",
+                "val": str(target_max_objects),
+            }
+        )
+        assert rv == 0, rs
+
+    def overlaid():
+        base = client.objecter.osdmap.get_pool("base")
+        hot = client.objecter.osdmap.get_pool("hot")
+        return (
+            base is not None
+            and hot is not None
+            and base.read_tier == hot.id
+            and hot.tier_of == base.id
+            and hot.cache_mode == cache_mode
+        )
+
+    await wait_until(overlaid, 5.0, "overlay visible to client")
+    return monmap, mons, osds, client
+
+
+async def _remove_overlay(client):
+    rv, rs, _ = await client.mon_command(
+        {"prefix": "osd tier remove-overlay", "pool": "base"}
+    )
+    assert rv == 0, rs
+    await wait_until(
+        lambda: client.objecter.osdmap.get_pool("base").read_tier < 0,
+        5.0,
+        "overlay removed",
+    )
+
+
+class TestTierCommands:
+    def test_tier_lifecycle_and_validation(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("base", "replicated", pg_num=4)
+            await client.pool_create("hot", "replicated", pg_num=4)
+            # overlay before tier add: rejected
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "osd tier set-overlay", "pool": "base",
+                 "overlaypool": "hot"}
+            )
+            assert rv != 0
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier add", "pool": "base", "tierpool": "hot"}
+            )
+            assert rv == 0
+            # double-tiering rejected
+            await client.pool_create("hot2", "replicated", pg_num=4)
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "osd tier add", "pool": "hot", "tierpool": "hot2"}
+            )
+            assert rv != 0, "stacked tiers must be rejected"
+            # overlay still needs a cache mode
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier set-overlay", "pool": "base",
+                 "overlaypool": "hot"}
+            )
+            assert rv != 0
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier cache-mode", "pool": "hot",
+                 "mode": "writeback"}
+            )
+            assert rv == 0
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier set-overlay", "pool": "base",
+                 "overlaypool": "hot"}
+            )
+            assert rv == 0
+            # removal requires dropping the overlay first
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier remove", "pool": "base", "tierpool": "hot"}
+            )
+            assert rv != 0
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier remove-overlay", "pool": "base"}
+            )
+            assert rv == 0
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier remove", "pool": "base", "tierpool": "hot"}
+            )
+            assert rv == 0
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestWriteback:
+    def test_write_lands_in_cache_and_flushes_to_base(self):
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster()
+            base_io = await client.open_ioctx("base")  # redirects to hot
+            hot_io = await client.open_ioctx("hot")
+            await base_io.write_full("obj", b"hot bytes")
+            assert await base_io.read("obj") == b"hot bytes"
+            # the cache pool holds it...
+            assert "obj" in await hot_io.list_objects()
+            # ...and the base does not until a flush
+            await _remove_overlay(client)
+            assert "obj" not in await base_io.list_objects()
+            # re-overlay, flush, verify base copy
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd tier set-overlay", "pool": "base",
+                 "overlaypool": "hot"}
+            )
+            assert rv == 0
+            await wait_until(
+                lambda: client.objecter.osdmap.get_pool("base").read_tier >= 0,
+                5.0,
+            )
+            await hot_io.cache_flush("obj")
+            await _remove_overlay(client)
+            assert await base_io.read("obj") == b"hot bytes"
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_promote_on_miss_and_evict(self):
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster()
+            hot_io = await client.open_ioctx("hot")
+            base_io = await client.open_ioctx("base")
+            # seed the BASE directly (no overlay interference: write via
+            # overlay, flush, evict leaves only the base copy)
+            await base_io.write_full("cold", b"base bytes")
+            await hot_io.cache_flush("cold")
+            await hot_io.cache_evict("cold")
+            assert "cold" not in await hot_io.list_objects()
+            # a read through the overlay misses -> promotes -> serves
+            assert await base_io.read("cold") == b"base bytes"
+            assert "cold" in await hot_io.list_objects()
+            # promoted copy is CLEAN: evict works without a flush
+            await hot_io.cache_evict("cold")
+            assert "cold" not in await hot_io.list_objects()
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_evict_dirty_is_ebusy(self):
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster()
+            hot_io = await client.open_ioctx("hot")
+            await hot_io.write_full("d", b"dirty")
+            with pytest.raises(RadosError):
+                await hot_io.cache_evict("d")
+            await hot_io.cache_flush("d")
+            await hot_io.cache_evict("d")  # clean now
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_delete_forwards_to_base(self):
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster()
+            base_io = await client.open_ioctx("base")
+            hot_io = await client.open_ioctx("hot")
+            await base_io.write_full("gone", b"x" * 64)
+            await hot_io.cache_flush("gone")
+            # delete through the overlay: must remove BOTH copies, so a
+            # later miss can't resurrect from the base
+            await base_io.remove("gone")
+            assert "gone" not in await hot_io.list_objects()
+            with pytest.raises(RadosError):
+                await base_io.read("gone")
+            await _remove_overlay(client)
+            assert "gone" not in await base_io.list_objects()
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_readonly_mode_rejects_writes(self):
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster(
+                cache_mode="readonly"
+            )
+            base_io = await client.open_ioctx("base")
+            with pytest.raises(RadosError):
+                await base_io.write_full("ro", b"nope")
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestTierAgent:
+    def test_agent_flushes_and_evicts_to_target(self):
+        async def run():
+            # pool-wide target 4 over pg_num=4 -> each PG keeps <= 1 head
+            monmap, mons, osds, client = await _tiered_cluster(
+                target_max_objects=4
+            )
+            base_io = await client.open_ioctx("base")
+            hot_io = await client.open_ioctx("hot")
+            for i in range(12):
+                await base_io.write_full(f"o{i}", f"payload{i}".encode())
+
+            async def count_hot():
+                return len(await hot_io.list_objects())
+
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while await count_hot() > 4:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(
+                        f"agent never reached target: {await count_hot()} left"
+                    )
+                await asyncio.sleep(0.1)
+            # every object still readable (flushed copies promote back)
+            for i in range(12):
+                assert await base_io.read(f"o{i}") == f"payload{i}".encode()
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestFlushWriteRace:
+    def test_write_racing_flush_stays_dirty(self):
+        """A write landing while its object is mid-flush must not get its
+        dirty mark cleared by the flush's completion (lost-write hazard:
+        a clean object can be evicted, resurrecting the pre-write bytes
+        from the base).  Writes are queued behind the flush
+        (PrimaryLogPG wait_for_blocked_object), so afterwards the cache
+        holds v2 AND still reports dirty."""
+
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster()
+            base_io = await client.open_ioctx("base")
+            hot_io = await client.open_ioctx("hot")
+            await base_io.write_full("r", b"v1")
+            # concurrent flush + overwrite
+            await asyncio.gather(
+                hot_io.cache_flush("r"),
+                base_io.write_full("r", b"v2"),
+            )
+            assert await base_io.read("r") == b"v2"
+            # v2 must still be flush-pending: evict refuses
+            with pytest.raises(RadosError):
+                await hot_io.cache_evict("r")
+            # flush again -> now clean -> evict works, base serves v2
+            await hot_io.cache_flush("r")
+            await hot_io.cache_evict("r")
+            assert await base_io.read("r") == b"v2"  # re-promoted from base
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
