@@ -1,8 +1,18 @@
 """Manager daemon + module runtime (SURVEY.md §2.7; src/mgr +
 src/pybind/mgr)."""
 
+from .dashboard import DashboardModule
 from .mgr import Mgr
 from .modules import MgrModule
+from .orchestrator import OrchBackend, OrchestratorModule, ServiceSpec
 from .telemetry import TelemetryModule
 
-__all__ = ["Mgr", "MgrModule", "TelemetryModule"]
+__all__ = [
+    "DashboardModule",
+    "Mgr",
+    "MgrModule",
+    "OrchBackend",
+    "OrchestratorModule",
+    "ServiceSpec",
+    "TelemetryModule",
+]

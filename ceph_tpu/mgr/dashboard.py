@@ -1,0 +1,132 @@
+"""dashboard mgr module — mirror of src/pybind/mgr/dashboard.
+
+The reference dashboard is a full web UI (cherrypy + Angular, ~100k LoC);
+this module keeps its architectural role — an HTTP window onto live
+cluster state served FROM the active mgr — with the REST layer and a
+minimal index page, dropping the SPA.  Routes mirror the reference's
+/api endpoints (dashboard/controllers/*): health, osds, pools, pgs,
+daemons, config.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .modules import HttpServedModule, MgrModule
+
+
+class DashboardModule(HttpServedModule, MgrModule):
+    NAME = "dashboard"
+
+    def __init__(self, port: int = 0):
+        MgrModule.__init__(self)
+        HttpServedModule.__init__(self, port)
+
+    # -- REST payloads (dashboard/controllers/{health,osd,pool,...}.py) ------
+
+    def api_health(self) -> dict:
+        checks = {}
+        for mod in self.mgr.modules:
+            for code, info in mod.health_checks.items():
+                checks[code] = info
+        status = "HEALTH_OK"
+        if any(c.get("severity") == "warning" for c in checks.values()):
+            status = "HEALTH_WARN"
+        if any(c.get("severity") == "error" for c in checks.values()):
+            status = "HEALTH_ERR"
+        m = self.mgr.osdmap
+        return {
+            "status": status,
+            "checks": checks,
+            "osdmap_epoch": m.epoch,
+            "num_osds": len(m.osds),
+            "num_up_osds": m.num_up_osds(),
+            "num_pools": len(m.pools),
+        }
+
+    def api_osds(self) -> list[dict]:
+        return [
+            {
+                "osd": osd,
+                "up": info.up,
+                "in": info.in_,
+                "weight": info.weight,
+                "addr": info.addr,
+            }
+            for osd, info in sorted(self.mgr.osdmap.osds.items())
+        ]
+
+    def api_pools(self) -> list[dict]:
+        out = []
+        for p in self.mgr.osdmap.pools.values():
+            out.append(
+                {
+                    "id": p.id,
+                    "name": p.name,
+                    "type": "erasure" if p.is_erasure() else "replicated",
+                    "size": p.size,
+                    "pg_num": p.pg_num,
+                    "erasure_code_profile": p.erasure_code_profile,
+                    "cache_mode": p.cache_mode,
+                    "tier_of": p.tier_of,
+                    "read_tier": p.read_tier,
+                }
+            )
+        return out
+
+    def api_pgs(self) -> list[dict]:
+        m = self.mgr.osdmap
+        out = []
+        for p in m.pools.values():
+            for ps in range(p.pg_num):
+                try:
+                    up, primary, acting, _ = m.pg_to_up_acting_osds(p.id, ps)
+                except Exception:
+                    continue
+                out.append(
+                    {
+                        "pgid": f"{p.id}.{ps}",
+                        "up": up,
+                        "acting": acting,
+                        "primary": primary,
+                    }
+                )
+        return out
+
+    def api_daemons(self) -> list[dict]:
+        return [
+            {"daemon": d, "status": self.mgr.get_daemon_status(d)}
+            for d in self.mgr.list_daemons()
+        ]
+
+    def render(self, path: str) -> tuple[int, str, str]:
+        """(status, content-type, body) for a request path."""
+        routes = {
+            "/api/health": self.api_health,
+            "/api/osds": self.api_osds,
+            "/api/pools": self.api_pools,
+            "/api/pgs": self.api_pgs,
+            "/api/daemons": self.api_daemons,
+        }
+        fn = routes.get(path)
+        if fn is not None:
+            return 200, "application/json", json.dumps(fn())
+        if path == "/":
+            h = self.api_health()
+            rows = "".join(
+                f"<tr><td>osd.{o['osd']}</td><td>{'up' if o['up'] else 'down'}"
+                f"</td><td>{'in' if o['in'] else 'out'}</td></tr>"
+                for o in self.api_osds()
+            )
+            body = (
+                "<html><head><title>ceph_tpu dashboard</title></head><body>"
+                f"<h1>Cluster: {h['status']}</h1>"
+                f"<p>epoch {h['osdmap_epoch']} — {h['num_up_osds']}/"
+                f"{h['num_osds']} OSDs up — {h['num_pools']} pools</p>"
+                f"<table border=1><tr><th>daemon</th><th>state</th><th>membership"
+                f"</th></tr>{rows}</table>"
+                "<p>API: /api/health /api/osds /api/pools /api/pgs /api/daemons</p>"
+                "</body></html>"
+            )
+            return 200, "text/html", body
+        return 404, "text/plain", "not found"

@@ -3,6 +3,56 @@
 
 from __future__ import annotations
 
+import asyncio
+
+
+class HttpServedModule:
+    """Shared HTTP/1.0 scaffold for modules exposing an endpoint (the
+    cherrypy analog): subclasses implement `render(path) -> (status,
+    content_type, body)` and inherit serve()/shutdown().  One copy of the
+    request parse / response framing, used by prometheus and dashboard."""
+
+    def __init__(self, port: int = 0):
+        self.port = port
+        self._server = None
+        self.addr = ""
+
+    def render(self, path: str) -> tuple[int, str, str]:
+        raise NotImplementedError
+
+    async def serve(self, host: str = "127.0.0.1") -> str:
+        async def handle(reader, writer):
+            try:
+                line = await reader.readline()
+                parts = line.decode("latin1").split()
+                path = parts[1] if len(parts) >= 2 else "/"
+                while (await reader.readline()).strip():
+                    pass  # drain request headers
+                status, ctype, body = self.render(path.split("?")[0])
+                payload = body.encode()
+                writer.write(
+                    f"HTTP/1.0 {status} {'OK' if status == 200 else 'NO'}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                    + payload
+                )
+                await writer.drain()
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                writer.close()
+
+        self._server = await asyncio.start_server(handle, host, self.port)
+        sock = self._server.sockets[0].getsockname()
+        self.addr = f"{sock[0]}:{sock[1]}"
+        return self.addr
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
 
 class MgrModule:
     """Base class modules subclass (mgr_module.py MgrModule): `tick()`

@@ -9,23 +9,19 @@ the reference's cherrypy server (module.py StandbyModule/Module).
 
 from __future__ import annotations
 
-import asyncio
-
-from .modules import MgrModule
+from .modules import HttpServedModule, MgrModule
 
 
 def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
-class PrometheusModule(MgrModule):
+class PrometheusModule(HttpServedModule, MgrModule):
     NAME = "prometheus"
 
     def __init__(self, port: int = 0):
-        super().__init__()
-        self.port = port
-        self._server: asyncio.AbstractServer | None = None
-        self.addr = ""
+        MgrModule.__init__(self)
+        HttpServedModule.__init__(self, port)
 
     # -- exposition ------------------------------------------------------------
 
@@ -60,31 +56,9 @@ class PrometheusModule(MgrModule):
                 out.append(f'{metric}{{daemon="{daemon}"}} {value}')
         return "\n".join(out) + "\n"
 
-    # -- HTTP endpoint ---------------------------------------------------------
+    # -- HTTP endpoint (scaffold in modules.HttpServedModule) ----------------
 
-    async def serve(self, host: str = "127.0.0.1") -> str:
-        """Start the /metrics HTTP listener; returns host:port."""
-
-        async def handle(reader, writer):
-            try:
-                await reader.readline()  # request line; rest ignored
-                body = self.scrape().encode()
-                writer.write(
-                    b"HTTP/1.0 200 OK\r\n"
-                    b"Content-Type: text/plain; version=0.0.4\r\n"
-                    b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
-                )
-                await writer.drain()
-            finally:
-                writer.close()
-
-        self._server = await asyncio.start_server(handle, host, self.port)
-        sock = self._server.sockets[0].getsockname()
-        self.addr = f"{sock[0]}:{sock[1]}"
-        return self.addr
-
-    async def shutdown(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+    def render(self, path: str) -> tuple[int, str, str]:
+        """Every path serves the exposition (the reference's exporter also
+        answers /metrics only, with / as a convenience)."""
+        return 200, "text/plain; version=0.0.4", self.scrape()

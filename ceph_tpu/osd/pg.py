@@ -795,6 +795,13 @@ class PG(PGListener):
                 self.pool.tier_of, msg.oid, [OSDOp(op=OSDOp.DELETE)], on_base
             )
             return False
+        # COPY_FROM reads its SOURCE locally via an internal fetch that
+        # bypasses this gate, so a cold (base-resident) source must be
+        # promoted before the copy can run.
+        for op in msg.ops:
+            if op.op == OSDOp.COPY_FROM and not self._object_exists(op.name):
+                self._tier_promote(op.name, (msg, reply, conn))
+                return False
         if self._object_exists(msg.oid):
             self._tier_touch(msg.oid)
             if writing:
@@ -802,20 +809,24 @@ class PG(PGListener):
             return True
         # Miss: promote from the base pool, queue the op behind the fetch
         # (PrimaryLogPG::promote_object + wait_for_blocked_object).
-        entry = (msg, reply, conn)
-        waiters = self._promoting.get(msg.oid)
-        if waiters is not None:
-            waiters.append(entry)
-            return False
-        self._promoting[msg.oid] = [entry]
+        self._tier_promote(msg.oid, (msg, reply, conn))
         if writing:
             self._tier_maybe_agent()
+        return False
+
+    def _tier_promote(self, oid: str, entry) -> None:
+        """Queue an op behind promotion of `oid`; start the base fetch if
+        this is the first waiter."""
+        waiters = self._promoting.get(oid)
+        if waiters is not None:
+            waiters.append(entry)
+            return
+        self._promoting[oid] = [entry]
 
         def on_fetched(err: int, data: bytes) -> None:
-            self._tier_promoted(msg.oid, err, data)
+            self._tier_promoted(oid, err, data)
 
-        self.osd.internal_read(self.pool.tier_of, msg.oid, 0, on_fetched)
-        return False
+        self.osd.internal_read(self.pool.tier_of, oid, 0, on_fetched)
 
     def _tier_drain(self, oid: str) -> None:
         """Re-dispatch ops queued behind a promotion; each gets a one-shot
@@ -873,12 +884,29 @@ class PG(PGListener):
         if not self._object_exists(oid):
             done(-ENOENT)
             return
-        if not self._is_dirty(oid):
-            done(0)
-            return
         if oid in self._flushing:
             done(-EBUSY)  # a flush is already running; writes are queued
             return
+        if not self._is_dirty(oid):
+            # Clean normally means base-backed — but an object written into
+            # the pool BEFORE `osd tier add` is clean with no base copy
+            # (and would be unevictable, see _tier_evict).  Verify, and
+            # write it back if the base lacks it.
+            def on_stat(err: int, _data: bytes) -> None:
+                if err == -ENOENT:
+                    self._tier_writeback(oid, done)
+                else:
+                    done(0 if not err else err)
+
+            self.osd.internal_op(
+                self.pool.tier_of, oid, [OSDOp(op=OSDOp.STAT)], on_stat
+            )
+            return
+        self._tier_writeback(oid, done)
+
+    def _tier_writeback(self, oid: str, done) -> None:
+        """The write-back leg of a flush: copy bytes to the base pool, then
+        clear the dirty marker.  Writers on `oid` queue in _flushing."""
         self._flushing[oid] = []
         coll = shard_coll(self.pgid, -1)
         data = self.osd.store.read(coll, oid, 0, self._object_size(oid))
@@ -909,20 +937,40 @@ class PG(PGListener):
 
     def _tier_evict(self, oid: str, done) -> None:
         """Drop a CLEAN object from the cache (local delete only — the base
-        copy is authoritative; the next miss re-promotes).  done(err)."""
+        copy is authoritative; the next miss re-promotes).  done(err).
+
+        Before deleting, the base copy's existence is verified: an object
+        that predates the tier relationship (written into the pool before
+        `osd tier add`) carries no dirty mark yet exists nowhere else —
+        deleting it would be permanent loss.  Such objects answer -EBUSY
+        (flush them first), which also covers the reference's reason for
+        refusing non-empty tier pools without --force-nonempty."""
         if not self._object_exists(oid):
             done(-ENOENT)
             return
         if self._is_dirty(oid):
             done(-EBUSY)
             return
-        pgt = PGTransaction(oid=oid, delete=True)
-        self._tier_tid += 1
-        self._tier_lru.pop(oid, None)
-        self.backend.submit_transaction(
-            pgt,
-            ReqId(client=f"osd.{self.osd.whoami}.evict", tid=self._tier_tid),
-            lambda: done(0),
+
+        def on_base_stat(err: int, _data: bytes) -> None:
+            if err:
+                # base copy unverifiable (absent or unreachable): refuse
+                done(-EBUSY)
+                return
+            if self._is_dirty(oid):  # re-dirtied while we checked
+                done(-EBUSY)
+                return
+            pgt = PGTransaction(oid=oid, delete=True)
+            self._tier_tid += 1
+            self._tier_lru.pop(oid, None)
+            self.backend.submit_transaction(
+                pgt,
+                ReqId(client=f"osd.{self.osd.whoami}.evict", tid=self._tier_tid),
+                lambda: done(0),
+            )
+
+        self.osd.internal_op(
+            self.pool.tier_of, oid, [OSDOp(op=OSDOp.STAT)], on_base_stat
         )
 
     def _tier_op_done(self, msg: MOSDOp, reply):
@@ -956,12 +1004,14 @@ class PG(PGListener):
         return -(-self.pool.target_max_objects // max(1, self.pool.pg_num))
 
     def _tier_maybe_agent(self) -> None:
-        """Cheap write-path trigger: only schedule the agent's full store
-        scan when the in-memory LRU (an approximate local head count —
-        rebuilt lazily after a primary restart) crosses the PG's share."""
+        """Cheap trigger: only schedule the agent's full store scan when
+        the in-memory LRU (an approximate local head count — rebuilt
+        lazily after a primary restart) crosses the PG's share.  Runs for
+        readonly caches too: promotions accumulate there and must still
+        honor target_max_objects (evict-only; nothing is ever dirty)."""
         if (
             self.pool.target_max_objects
-            and self.pool.cache_mode == "writeback"
+            and self.pool.cache_mode in ("writeback", "readonly")
             and len(self._tier_lru) > self._tier_share()
         ):
             asyncio.get_event_loop().call_soon(self._tier_agent)
@@ -969,43 +1019,51 @@ class PG(PGListener):
     def _tier_agent(self) -> None:
         """Flush-and-evict down to target_max_objects, coldest first
         (TierAgentState evict_mode; utilization-driven in the reference,
-        object-count-driven here).  One object per pass; reschedules
-        itself until under target."""
+        object-count-driven here).  One store scan computes the whole
+        victim batch; victims are processed sequentially, then the scan
+        repeats only if still over target."""
         target = self.pool.target_max_objects
         if (
             not target
-            or self.pool.cache_mode != "writeback"
+            or self.pool.cache_mode == "none"
             or self._tier_agent_busy
             or not self.peering.is_primary()
         ):
             return
         share = self._tier_share()
         heads = [o for o in self._list_local() if "@" not in o]
-        if len(heads) <= share:
+        excess = len(heads) - share
+        if excess <= 0:
             return
         # coldest = LRU order, with never-touched objects (e.g. after a
         # primary restart, the in-memory LRU is empty) treated as coldest
         in_lru = {o: i for i, o in enumerate(self._tier_lru)}
-        victim = min(heads, key=lambda o: in_lru.get(o, -1))
+        victims = sorted(heads, key=lambda o: in_lru.get(o, -1))[:excess]
         self._tier_agent_busy = True
+        loop = asyncio.get_event_loop()
 
-        def evicted(err: int) -> None:
-            self._tier_agent_busy = False
-            loop = asyncio.get_event_loop()
+        def next_victim(err: int) -> None:
             if err:
-                # e.g. base pool unplaceable (-EAGAIN): back off instead of
-                # spinning call_soon against the same stuck victim
+                # e.g. base pool unplaceable (-EAGAIN): stop this batch and
+                # back off instead of spinning against a stuck victim
+                self._tier_agent_busy = False
                 loop.call_later(0.5, self._tier_agent)
-            else:
-                loop.call_soon(self._tier_agent)
-
-        def flushed(err: int) -> None:
-            if err:
-                evicted(err)
                 return
-            self._tier_evict(victim, evicted)
+            if not victims:
+                self._tier_agent_busy = False
+                loop.call_soon(self._tier_agent)  # rescan; exits when under
+                return
+            victim = victims.pop(0)
 
-        self._tier_flush(victim, flushed)
+            def flushed(e: int) -> None:
+                if e:
+                    next_victim(e)
+                else:
+                    self._tier_evict(victim, next_victim)
+
+            self._tier_flush(victim, flushed)
+
+        next_victim(0)
 
     # -- watch / notify (PrimaryLogPG watchers, Watch.cc) ----------------------
 

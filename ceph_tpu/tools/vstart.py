@@ -86,6 +86,17 @@ class DevCluster:
             self.mgr.beacon_interval = 0.5
             await self.mgr.start()
             await self.mgr.wait_for_active()
+            # standard module set (vstart.sh enables the same four)
+            from ..mgr import DashboardModule, OrchestratorModule, TelemetryModule
+            from ..mgr.prometheus import PrometheusModule
+
+            for module in (
+                PrometheusModule(),
+                DashboardModule(),
+                TelemetryModule(),
+                OrchestratorModule(),
+            ):
+                self.mgr.register_module(module)
         if self.with_mds:
             # `ceph fs new`-style bootstrap: metadata + data pools, then
             # the metadata server (vstart.sh's MDS=1 default topology)

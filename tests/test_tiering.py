@@ -288,3 +288,63 @@ class TestFlushWriteRace:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestPreexistingObjects:
+    def test_evict_refuses_object_with_no_base_copy(self):
+        """An object written into the cache pool BEFORE the tier
+        relationship has no dirty mark and no base copy; evicting it would
+        be permanent loss (the reference refuses non-empty tier pools
+        outright).  Evict verifies the base copy exists and answers EBUSY;
+        a flush creates the base copy, after which evict proceeds."""
+
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("base", "replicated", pg_num=4)
+            await client.pool_create("hot", "replicated", pg_num=4)
+            hot_io = await client.open_ioctx("hot")
+            await hot_io.write_full("pre", b"precious")  # pre-tiering
+            for prefix, cmd in [
+                ("osd tier add", {"pool": "base", "tierpool": "hot"}),
+                ("osd tier cache-mode", {"pool": "hot", "mode": "writeback"}),
+                ("osd tier set-overlay", {"pool": "base", "overlaypool": "hot"}),
+            ]:
+                rv, rs, _ = await client.mon_command({"prefix": prefix, **cmd})
+                assert rv == 0, rs
+            await wait_until(
+                lambda: client.objecter.osdmap.get_pool("hot").tier_of >= 0,
+                5.0,
+            )
+            with pytest.raises(RadosError):
+                await hot_io.cache_evict("pre")
+            assert "pre" in await hot_io.list_objects()  # still there
+            await hot_io.cache_flush("pre")  # clean AND base-backed now
+            await hot_io.cache_evict("pre")
+            base_io = await client.open_ioctx("base")
+            assert await base_io.read("pre") == b"precious"  # re-promotes
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_copy_from_cold_source_promotes(self):
+        """COPY_FROM with a base-resident (evicted) source: the gate must
+        promote the source before the internal fetch, which bypasses the
+        tier gate."""
+
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster()
+            base_io = await client.open_ioctx("base")
+            hot_io = await client.open_ioctx("hot")
+            await base_io.write_full("src", b"the source bytes")
+            await hot_io.cache_flush("src")
+            await hot_io.cache_evict("src")
+            assert "src" not in await hot_io.list_objects()
+            await base_io.copy_from("dst", "src")
+            assert await base_io.read("dst") == b"the source bytes"
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
