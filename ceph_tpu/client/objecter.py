@@ -49,9 +49,12 @@ class Objecter(Dispatcher):
         auth=None,
         secure: bool = False,
         compress: bool = False,
+        stack: str = "posix",
     ):
         self.name = name
-        self.msgr = Messenger(name, auth=auth, secure=secure, compress=compress)
+        self.msgr = Messenger(
+            name, auth=auth, secure=secure, compress=compress, stack=stack
+        )
         self.monc = MonClient(name, monmap, msgr=self.msgr)
         self.msgr.add_dispatcher_head(self)
         self.osdmap = OSDMap()

@@ -38,6 +38,7 @@ class Rados:
         secret: bytes | None = None,  # cephx key (rados_conf key equivalent)
         secure: bool = False,
         compress: bool = False,
+        stack: str = "posix",  # ms_type (msg/stack.py)
     ):
         self.name = name
         auth = None
@@ -46,7 +47,8 @@ class Rados:
 
             auth = CephxAuth.for_client(name, secret)
         self.objecter = Objecter(
-            name, monmap, auth=auth, secure=secure, compress=compress
+            name, monmap, auth=auth, secure=secure, compress=compress,
+            stack=stack,
         )
         self._connected = False
 

@@ -141,7 +141,9 @@ OPTIONS: dict[str, Option] = _opts(
     Option("mon_osd_down_out_interval", float, 30.0, A,
            "seconds down before an osd is marked out"),
     # --- messenger (global.yaml.in:1240-1271 fault injection) ---------------
-    Option("ms_type", str, "async+posix", A, "messenger stack"),
+    Option("ms_type", str, "async+posix", A,
+           "messenger stack: async+posix (TCP) or async+inproc "
+           "(in-process pipes, kernel-bypass for one-host topologies)"),
     Option("ms_crc_data", bool, True, A, "crc32c-protect frame payloads"),
     Option("ms_inject_socket_failures", int, 0, D,
            "1-in-N chance of injected connection failure "

@@ -132,10 +132,13 @@ class FileHandle:
 class CephFSClient(Dispatcher):
     """libcephfs-like handle to one MDS + a data pool."""
 
-    def __init__(self, mds_addr: str, data_ioctx, name: str = "client.fs"):
+    def __init__(
+        self, mds_addr: str, data_ioctx, name: str = "client.fs",
+        stack: str = "posix",
+    ):
         self.mds_addr = mds_addr
         self.data = data_ioctx
-        self.msgr = Messenger(name)
+        self.msgr = Messenger(name, stack=stack)
         self.msgr.add_dispatcher_head(self)
         self._tid = 0
         self._replies: dict[int, asyncio.Future] = {}

@@ -57,14 +57,14 @@ class MDS(Dispatcher):
     """One active metadata server (rank 0)."""
 
     def __init__(self, meta_ioctx, data_ioctx, addr: str = "127.0.0.1:0",
-                 layout: dict | None = None):
+                 layout: dict | None = None, stack: str = "posix"):
         self.meta = meta_ioctx
         self.data = data_ioctx
         self.layout = layout or {
             "stripe_unit": 64 * 1024, "stripe_count": 2, "object_size": 1 << 20
         }
         self._bind_addr = addr
-        self.msgr = Messenger("mds.0")
+        self.msgr = Messenger("mds.0", stack=stack)
         self.msgr.add_dispatcher_head(self)
         # dirfrag cache: ino -> {name: entry dict}; which are dirty
         self._dirs: dict[int, dict] = {}

@@ -51,8 +51,11 @@ class Mgr(Dispatcher):
         self.monmap = monmap
         self.conf = conf or Config({"name": f"mgr.{name}"})
         self._bind_addr = addr
-        self.msgr = Messenger(f"mgr.{name}")
-        self.monc = MonClient(f"mgr.{name}", monmap)
+        stack = self.conf.get("ms_type")
+        self.msgr = Messenger(f"mgr.{name}", stack=stack)
+        self.monc = MonClient(
+            f"mgr.{name}", monmap, msgr=Messenger(f"mgr.{name}", stack=stack)
+        )
         self.osdmap = OSDMap()
         self.mgrmap_epoch = 0
         self.active = False

@@ -58,6 +58,7 @@ class Monitor(Dispatcher):
         keyring=None,  # KeyRing enabling cephx on this mon's sessions
         secure: bool = False,
         compress: bool = False,
+        stack: str = "posix",  # ms_type (msg/stack.py)
     ):
         self.name = name
         self.monmap = monmap
@@ -68,7 +69,8 @@ class Monitor(Dispatcher):
 
             auth = CephxAuth.for_daemon(f"mon.{name}", keyring)
         self.msgr = Messenger(
-            f"mon.{name}", auth=auth, secure=secure, compress=compress
+            f"mon.{name}", auth=auth, secure=secure, compress=compress,
+            stack=stack,
         )
         self.msgr.default_policy = Policy.lossless_peer()
         self.elector = Elector(

@@ -121,7 +121,7 @@ class Connection:
         self._read_task = asyncio.create_task(self._read_loop())
 
     async def _connect(self) -> None:
-        reader, writer = await asyncio.open_connection(*_split(self.peer_addr))
+        reader, writer = await self.msgr.stack.connect(self.peer_addr)
         # hello: announce who we are + desired on-wire features
         # (ProtocolV2 hello/ident phase; features negotiate like
         # ProtocolV2's connection modes)
@@ -265,11 +265,6 @@ class Connection:
                 self._fault()
 
 
-def _split(addr: str) -> tuple[str, int]:
-    host, _, port = addr.rpartition(":")
-    return host, int(port)
-
-
 def _frame_io(reader, writer, crc_data: bool):
     """(send_frame, recv_frame) pair for the auth handshake — raw tagged
     frames on the not-yet-attached stream."""
@@ -300,9 +295,13 @@ class Messenger:
         auth=None,  # CephxAuth (src/auth/cephx); None = auth_none
         secure: bool = False,  # AES-GCM sessions (ms_mode=secure)
         compress: bool = False,  # on-wire frame compression
+        stack: str = "posix",  # ms_type: posix | inproc (msg/stack.py)
     ):
+        from .stack import make_stack
+
         self.name = name  # entity name, e.g. "osd.0"
         self.addr = addr  # host:port once bound (or for identification)
+        self.stack = make_stack(stack)
         self.crc_data = crc_data
         if secure and auth is None:
             raise ValueError(
@@ -334,10 +333,7 @@ class Messenger:
         self.dispatchers.append(d)
 
     async def bind(self, addr: str) -> None:
-        host, port = _split(addr)
-        self._server = await asyncio.start_server(self._accept, host, port)
-        actual_port = self._server.sockets[0].getsockname()[1]
-        self.addr = f"{host}:{actual_port}"
+        self._server, self.addr = await self.stack.listen(addr, self._accept)
 
     async def shutdown(self) -> None:
         # Close live connections before the listener: Python 3.12's

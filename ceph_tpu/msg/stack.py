@@ -1,0 +1,181 @@
+"""Pluggable network stacks — mirror of the reference's NetworkStack
+family (src/msg/async/Stack.h; PosixStack.h, rdma/, dpdk/ selected by
+`ms_type`).
+
+The messenger talks to a `NetworkStack` for exactly two things: dial a
+peer and listen for peers.  Two stacks ship:
+
+- `posix` — asyncio TCP, the default (PosixStack analog).
+- `inproc` — zero-copy in-process pipes between messengers sharing an
+  interpreter.  This is the kernel-bypass member of the family: where
+  the reference's dpdk/rdma stacks skip the kernel between HOSTS, this
+  one skips the kernel for the many-daemons-one-process topology the
+  framework actually runs (vstart dev clusters, the standalone test
+  tier, and OSD-colocated TPU hosts), moving frames by reference
+  through asyncio StreamReader buffers instead of loopback TCP.
+
+Stacks preserve asyncio's (reader, writer) stream contract, so the
+protocol layer (frames, auth, secure/compressed on-wire sessions) is
+byte-identical over every stack — the same invariant the reference
+keeps by running Protocol V2 unchanged over posix/rdma/dpdk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+
+class NetworkStack:
+    """connect/listen boundary (Stack.h NetworkStack)."""
+
+    async def connect(self, addr: str):
+        """-> (StreamReader, StreamWriter-like) for a dialed peer."""
+        raise NotImplementedError
+
+    async def listen(self, addr: str, client_cb) -> tuple[object, str]:
+        """Start accepting; `client_cb(reader, writer)` per peer.
+        -> (server-like with close()/wait_closed(), bound address)."""
+        raise NotImplementedError
+
+
+def _split(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class PosixStack(NetworkStack):
+    """asyncio TCP (PosixStack.h)."""
+
+    async def connect(self, addr: str):
+        return await asyncio.open_connection(*_split(addr))
+
+    async def listen(self, addr: str, client_cb):
+        host, port = _split(addr)
+        server = await asyncio.start_server(client_cb, host, port)
+        actual = server.sockets[0].getsockname()[1]
+        return server, f"{host}:{actual}"
+
+
+class _PipeWriter:
+    """StreamWriter contract over a peer's StreamReader buffer."""
+
+    HIGH_WATER = 4 << 20  # drain() backpressure threshold (bytes buffered)
+
+    def __init__(self, peer_reader: asyncio.StreamReader):
+        self._peer = peer_reader
+        self._closed = False
+
+    def write(self, data: bytes) -> None:
+        if not self._closed:
+            # by-reference when already immutable; copy only mutable views
+            self._peer.feed_data(
+                data if isinstance(data, bytes) else bytes(data)
+            )
+
+    async def drain(self) -> None:
+        # Backpressure analog of TCP's: yield until the peer has consumed
+        # down to the high-water mark, so a fast sender can't grow the
+        # peer's StreamReader buffer without bound.
+        while (
+            not self._closed
+            and len(getattr(self._peer, "_buffer", b"")) > self.HIGH_WATER
+        ):
+            await asyncio.sleep(0)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._peer.feed_eof()
+
+    def is_closing(self) -> bool:
+        return self._closed
+
+
+def _pipe_pair():
+    """Two cross-connected (reader, writer) stream pairs."""
+    a_reads = asyncio.StreamReader()
+    b_reads = asyncio.StreamReader()
+    return (a_reads, _PipeWriter(b_reads)), (b_reads, _PipeWriter(a_reads))
+
+
+class _InProcListener:
+    def __init__(self, stack: "InProcStack", addr: str):
+        self._stack = stack
+        self._addr = addr
+        self._handlers: set[asyncio.Task] = set()
+
+    def _spawn(self, client_cb, reader, writer) -> None:
+        task = asyncio.get_event_loop().create_task(client_cb(reader, writer))
+        self._handlers.add(task)
+        task.add_done_callback(self._handlers.discard)
+
+    def close(self) -> None:
+        self._stack._listeners.pop(self._addr, None)
+        for t in list(self._handlers):
+            t.cancel()
+
+    async def wait_closed(self) -> None:
+        await asyncio.gather(*self._handlers, return_exceptions=True)
+
+
+class InProcStack(NetworkStack):
+    """In-process pipes with a process-wide listener registry.  Addresses
+    are plain strings ("inproc:N" auto-assigned on bind, or any explicit
+    string), carried through monmaps/OSDMaps like host:port addrs.
+    Registry entries remember their event loop: a listener whose loop is
+    gone (a test that died before shutdown) is stale — it is dropped
+    rather than poisoning later binds/connects in the same process."""
+
+    _listeners: dict[str, tuple[_InProcListener, object, object]] = {}
+    _ports = itertools.count(1)
+
+    @classmethod
+    def _live_entry(cls, addr: str):
+        entry = cls._listeners.get(addr)
+        if entry is None:
+            return None
+        loop = entry[2]
+        try:
+            current = asyncio.get_event_loop()
+        except RuntimeError:
+            current = None
+        if loop.is_closed() or loop is not current:
+            cls._listeners.pop(addr, None)
+            return None
+        return entry
+
+    async def connect(self, addr: str):
+        entry = self._live_entry(addr)
+        if entry is None:
+            raise ConnectionRefusedError(f"no inproc listener at {addr}")
+        listener, client_cb, _loop = entry
+        (c_reader, c_writer), (s_reader, s_writer) = _pipe_pair()
+        listener._spawn(client_cb, s_reader, s_writer)
+        return c_reader, c_writer
+
+    async def listen(self, addr: str, client_cb):
+        if not addr or addr.endswith(":0"):
+            addr = f"inproc:{next(self._ports)}"
+        if self._live_entry(addr) is not None:
+            raise OSError(f"inproc address {addr} in use")
+        listener = _InProcListener(self, addr)
+        self._listeners[addr] = (listener, client_cb, asyncio.get_event_loop())
+        return listener, addr
+
+
+STACKS = {"posix": PosixStack, "inproc": InProcStack}
+
+# ms_type spellings (the reference's "async+posix" etc., ceph_osd.cc:541)
+_ALIASES = {"async+posix": "posix", "async+inproc": "inproc"}
+
+
+def make_stack(kind: str | NetworkStack) -> NetworkStack:
+    """ms_type -> stack instance (Stack.cc NetworkStack::create)."""
+    if isinstance(kind, NetworkStack):
+        return kind
+    kind = _ALIASES.get(kind, kind)
+    cls = STACKS.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown ms_type {kind!r} (have {sorted(STACKS)})")
+    return cls()

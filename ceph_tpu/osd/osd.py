@@ -118,6 +118,7 @@ class OSD(Dispatcher):
             auth=auth,
             secure=self.conf.get("ms_secure"),
             compress=self.conf.get("ms_compress"),
+            stack=self.conf.get("ms_type"),
         )
         self.msgr = Messenger(f"osd.{whoami}", **msgr_kw)
         self.msgr.default_policy = Policy.lossless_peer()
