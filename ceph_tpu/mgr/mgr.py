@@ -53,9 +53,7 @@ class Mgr(Dispatcher):
         self._bind_addr = addr
         stack = self.conf.get("ms_type")
         self.msgr = Messenger(f"mgr.{name}", stack=stack)
-        self.monc = MonClient(
-            f"mgr.{name}", monmap, msgr=Messenger(f"mgr.{name}", stack=stack)
-        )
+        self.monc = MonClient(f"mgr.{name}", monmap, stack=stack)
         self.osdmap = OSDMap()
         self.mgrmap_epoch = 0
         self.active = False

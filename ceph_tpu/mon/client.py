@@ -28,10 +28,16 @@ from ..common.errs import EAGAIN, ETIMEDOUT
 
 
 class MonClient(Dispatcher):
-    def __init__(self, name: str, monmap: MonMap, msgr: Messenger | None = None):
+    def __init__(
+        self,
+        name: str,
+        monmap: MonMap,
+        msgr: Messenger | None = None,
+        stack: str = "posix",  # ms_type for the fallback messenger
+    ):
         self.name = name
         self.monmap = monmap
-        self.msgr = msgr or Messenger(name)
+        self.msgr = msgr or Messenger(name, stack=stack)
         self.msgr.add_dispatcher_tail(self)
         self._tid = 0
         self._acks: dict[int, asyncio.Future] = {}

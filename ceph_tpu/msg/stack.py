@@ -57,12 +57,51 @@ class PosixStack(NetworkStack):
         return server, f"{host}:{actual}"
 
 
+class _PipeReader(asyncio.StreamReader):
+    """StreamReader that publishes its own buffered-byte count and signals
+    consumption, so the writing side gets real backpressure without
+    poking at StreamReader privates."""
+
+    def __init__(self):
+        super().__init__()
+        self.pending = 0  # bytes fed minus bytes consumed
+        self.drained = asyncio.Event()
+
+    def feed_data(self, data) -> None:
+        self.pending += len(data)
+        super().feed_data(data)
+
+    def _note_consumed(self, data) -> None:
+        self.pending -= len(data)
+        self.drained.set()
+
+    async def read(self, n: int = -1):
+        data = await super().read(n)
+        self._note_consumed(data)
+        return data
+
+    async def readexactly(self, n: int):
+        data = await super().readexactly(n)
+        self._note_consumed(data)
+        return data
+
+    async def readline(self):
+        data = await super().readline()
+        self._note_consumed(data)
+        return data
+
+    async def readuntil(self, separator: bytes = b"\n"):
+        data = await super().readuntil(separator)
+        self._note_consumed(data)
+        return data
+
+
 class _PipeWriter:
-    """StreamWriter contract over a peer's StreamReader buffer."""
+    """StreamWriter contract over a peer's _PipeReader buffer."""
 
     HIGH_WATER = 4 << 20  # drain() backpressure threshold (bytes buffered)
 
-    def __init__(self, peer_reader: asyncio.StreamReader):
+    def __init__(self, peer_reader: _PipeReader):
         self._peer = peer_reader
         self._closed = False
 
@@ -74,14 +113,16 @@ class _PipeWriter:
             )
 
     async def drain(self) -> None:
-        # Backpressure analog of TCP's: yield until the peer has consumed
+        # Backpressure analog of TCP's: park until the peer has consumed
         # down to the high-water mark, so a fast sender can't grow the
-        # peer's StreamReader buffer without bound.
-        while (
-            not self._closed
-            and len(getattr(self._peer, "_buffer", b"")) > self.HIGH_WATER
-        ):
-            await asyncio.sleep(0)
+        # peer's buffer without bound.  The timeout bounds a peer that
+        # stops reading entirely (its read-loop death closes the pipe).
+        while not self._closed and self._peer.pending > self.HIGH_WATER:
+            self._peer.drained.clear()
+            try:
+                await asyncio.wait_for(self._peer.drained.wait(), 0.1)
+            except asyncio.TimeoutError:
+                pass
 
     def close(self) -> None:
         if not self._closed:
@@ -94,8 +135,8 @@ class _PipeWriter:
 
 def _pipe_pair():
     """Two cross-connected (reader, writer) stream pairs."""
-    a_reads = asyncio.StreamReader()
-    b_reads = asyncio.StreamReader()
+    a_reads = _PipeReader()
+    b_reads = _PipeReader()
     return (a_reads, _PipeWriter(b_reads)), (b_reads, _PipeWriter(a_reads))
 
 
@@ -131,23 +172,21 @@ class InProcStack(NetworkStack):
     _ports = itertools.count(1)
 
     @classmethod
-    def _live_entry(cls, addr: str):
+    def _prune_stale(cls, addr: str):
+        """Entry at addr, dropping it first if its loop died without
+        shutdown (a failed test) — stale entries must not poison later
+        binds/connects in the same process."""
         entry = cls._listeners.get(addr)
-        if entry is None:
-            return None
-        loop = entry[2]
-        try:
-            current = asyncio.get_event_loop()
-        except RuntimeError:
-            current = None
-        if loop.is_closed() or loop is not current:
+        if entry is not None and entry[2].is_closed():
             cls._listeners.pop(addr, None)
             return None
         return entry
 
     async def connect(self, addr: str):
-        entry = self._live_entry(addr)
-        if entry is None:
+        entry = self._prune_stale(addr)
+        # A live listener on a FOREIGN loop is refused without touching
+        # the registry: cross-loop pipes would race two schedulers.
+        if entry is None or entry[2] is not asyncio.get_event_loop():
             raise ConnectionRefusedError(f"no inproc listener at {addr}")
         listener, client_cb, _loop = entry
         (c_reader, c_writer), (s_reader, s_writer) = _pipe_pair()
@@ -157,7 +196,7 @@ class InProcStack(NetworkStack):
     async def listen(self, addr: str, client_cb):
         if not addr or addr.endswith(":0"):
             addr = f"inproc:{next(self._ports)}"
-        if self._live_entry(addr) is not None:
+        if self._prune_stale(addr) is not None:
             raise OSError(f"inproc address {addr} in use")
         listener = _InProcListener(self, addr)
         self._listeners[addr] = (listener, client_cb, asyncio.get_event_loop())

@@ -29,6 +29,11 @@ _PREFIXES = [
     "osd pool set",
     "osd pool ls",
     "osd pool rm",
+    "osd tier add",
+    "osd tier remove-overlay",
+    "osd tier remove",
+    "osd tier cache-mode",
+    "osd tier set-overlay",
     "osd reweight",
     "osd dump",
     "osd out",
@@ -58,6 +63,14 @@ def build_cmd(words: list[str]) -> dict:
             elif prefix in ("osd pool rm",):
                 if rest:
                     cmd["pool"] = rest[0]
+            elif prefix in ("osd tier add", "osd tier remove"):
+                cmd["pool"], cmd["tierpool"] = rest[0], rest[1]
+            elif prefix == "osd tier cache-mode":
+                cmd["pool"], cmd["mode"] = rest[0], rest[1]
+            elif prefix == "osd tier set-overlay":
+                cmd["pool"], cmd["overlaypool"] = rest[0], rest[1]
+            elif prefix == "osd tier remove-overlay":
+                cmd["pool"] = rest[0]
             elif prefix == "osd reweight":
                 cmd["id"], cmd["weight"] = rest[0], rest[1]
             elif prefix in ("osd out", "osd in"):

@@ -54,6 +54,13 @@ async def _run(args) -> int:
         elif args.op == "ls":
             for oid in await ioctx.list_objects():
                 print(oid)
+        elif args.op == "cache-flush":
+            # rados cache-flush: write a dirty cache-tier object back
+            await ioctx.cache_flush(args.args[0])
+            print(f"flushed {args.args[0]}")
+        elif args.op == "cache-evict":
+            await ioctx.cache_evict(args.args[0])
+            print(f"evicted {args.args[0]}")
         elif args.op == "bench":
             await _bench(ioctx, int(args.args[0]), args.args[1] if len(args.args) > 1 else "write")
         else:
@@ -101,7 +108,7 @@ def main() -> None:
     p.add_argument("-p", "--pool", default="")
     p.add_argument("--cluster-file", default=CLUSTER_FILE)
     p.add_argument("--size", type=int, default=3, help="pool size for mkpool")
-    p.add_argument("op", help="put|get|rm|stat|ls|bench|lspools|mkpool")
+    p.add_argument("op", help="put|get|rm|stat|ls|bench|lspools|mkpool|cache-flush|cache-evict")
     p.add_argument("args", nargs="*")
     sys.exit(asyncio.run(_run(p.parse_args())))
 

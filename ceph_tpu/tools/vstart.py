@@ -63,9 +63,23 @@ class DevCluster:
         self._mds_rados = None
 
     async def start(self) -> MonMap:
-        self.monmap = MonMap(addrs=_free_port_addrs(self.n_mons))
+        # ms_type applies cluster-wide (every daemon + client must share a
+        # stack); inproc clusters use inproc monmap addresses.
+        from ..msg.stack import _ALIASES
+
+        raw = self.conf_overrides.get("ms_type", "async+posix")
+        stack = self._stack = _ALIASES.get(raw, raw)
+        if stack == "inproc":
+            self.monmap = MonMap(
+                addrs={
+                    name: f"inproc:mon.{name}"
+                    for name in ("abcdefghij"[: self.n_mons])
+                }
+            )
+        else:
+            self.monmap = MonMap(addrs=_free_port_addrs(self.n_mons))
         self.mons = [
-            Monitor(name, self.monmap, election_timeout=0.3)
+            Monitor(name, self.monmap, election_timeout=0.3, stack=stack)
             for name in self.monmap.addrs
         ]
         for m in self.mons:
@@ -82,7 +96,11 @@ class DevCluster:
         for osd in self.osds:
             await osd.wait_for_up()
         if self.with_mgr:
-            self.mgr = Mgr("x", self.monmap)
+            self.mgr = Mgr(
+                "x",
+                self.monmap,
+                conf=Config({"name": "mgr.x", **self.conf_overrides}, env=False),
+            )
             self.mgr.beacon_interval = 0.5
             await self.mgr.start()
             await self.mgr.wait_for_active()
@@ -103,7 +121,9 @@ class DevCluster:
             from ..client import Rados
             from ..mds import MDS
 
-            self._mds_rados = Rados(self.monmap, name="client.mds-bootstrap")
+            self._mds_rados = Rados(
+                self.monmap, name="client.mds-bootstrap", stack=self._stack
+            )
             await self._mds_rados.connect()
             size = min(2, self.n_osds)
             await self._mds_rados.pool_create(
@@ -114,7 +134,7 @@ class DevCluster:
             )
             meta = await self._mds_rados.open_ioctx("cephfs_metadata")
             data = await self._mds_rados.open_ioctx("cephfs_data")
-            self.mds = MDS(meta, data)
+            self.mds = MDS(meta, data, stack=self._stack)
             await self.mds.start()
         return self.monmap
 

@@ -146,3 +146,31 @@ class TestInProcCluster:
             await mon.stop()
 
         asyncio.run(run())
+
+
+class TestInProcVstart:
+    def test_devcluster_over_inproc(self):
+        """vstart honors ms_type cluster-wide: mons get inproc monmap
+        addresses, OSDs/mgr/client share the stack, and the whole dev
+        topology boots and serves I/O with zero TCP sockets."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.tools.vstart import DevCluster
+
+            cluster = DevCluster(
+                1, 3, with_mgr=True,
+                conf_overrides={"ms_type": "async+inproc"},
+            )
+            monmap = await cluster.start()
+            assert all(a.startswith("inproc:") for a in monmap.addrs.values())
+            client = Rados(monmap, stack="inproc")
+            await client.connect()
+            await client.pool_create("vp", "replicated", pg_num=4)
+            io = await client.open_ioctx("vp")
+            await io.write_full("o", b"inproc vstart")
+            assert await io.read("o") == b"inproc vstart"
+            await client.shutdown()
+            await cluster.stop()
+
+        asyncio.run(run())
