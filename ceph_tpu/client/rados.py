@@ -215,6 +215,18 @@ class IoCtx:
         )
         _check(rep.result, f"copy_from {src_oid} -> {oid}")
 
+    # -- object classes --------------------------------------------------------
+
+    async def exec(self, oid: str, cls: str, method: str, data: bytes = b"") -> bytes:
+        """Run an object-class method server-side (rados_exec /
+        CEPH_OSD_OP_CALL): returns the method's output bytes; negative
+        method results raise."""
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.CALL, name=f"{cls}.{method}", data=bytes(data))]
+        )
+        _check(rep.result, f"exec {cls}.{method} on {oid}")
+        return rep.outdata[0]
+
     # -- cache tiering ---------------------------------------------------------
 
     async def cache_flush(self, oid: str) -> None:

@@ -1,0 +1,37 @@
+"""RADOS object classes — mirror of src/objclass + src/cls.
+
+The reference's third plugin family (beside erasure-code and compressor):
+shared libraries `libcls_<name>.so` loaded into the OSD register named
+METHODS that execute server-side against one object, invoked by clients
+through the CEPH_OSD_OP_CALL op (`ioctx.exec(oid, cls, method, in)` ->
+(rc, out)).  Methods declare RD/WR flags; WR methods mutate the object
+through the op's transaction, so class side effects replicate exactly
+like plain writes (PrimaryLogPG::do_osd_ops CALL case).
+
+Here classes are python modules under ceph_tpu.cls registered through
+the same decorator surface (`objclass.py`); the dlopen analog is
+importlib with a preload list (`osd_op_class_load_list`).  In-tree
+classes mirror the reference's most-used ones: `lock` (cls_lock),
+`version` (cls_version), `numops` (cls_numops), `refcount`
+(cls_refcount).
+"""
+
+from .objclass import (
+    ClsError,
+    HCtx,
+    MethodNotFound,
+    cls_method,
+    get_method,
+    load_class,
+    registry,
+)
+
+__all__ = [
+    "ClsError",
+    "HCtx",
+    "MethodNotFound",
+    "cls_method",
+    "get_method",
+    "load_class",
+    "registry",
+]

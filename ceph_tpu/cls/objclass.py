@@ -1,0 +1,158 @@
+"""Object-class runtime: registry, method decorator, handler context.
+
+Mirrors src/objclass/objclass.h: `cls_register` / `cls_register_cxx_method`
+with CLS_METHOD_RD / CLS_METHOD_WR flags, and the `cls_method_context_t`
+handle through which a method reads and mutates ITS object (never other
+objects — the reference's isolation rule).  Methods return non-negative
+on success (becomes the op result) or raise ClsError(errno).
+
+Mutations accumulate into the enclosing op's PGTransaction — the same
+replication/journaling path as plain writes — with a read-your-writes
+overlay so a later method in the same op observes earlier staged state.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable
+
+from ..common.errs import ENOENT, EOPNOTSUPP
+
+RD = 1  # method reads the object (CLS_METHOD_RD)
+WR = 2  # method mutates the object (CLS_METHOD_WR)
+
+
+class ClsError(Exception):
+    """Negative-errno failure from a class method (CLS_... error return)."""
+
+    def __init__(self, err: int, msg: str = ""):
+        self.errno = -abs(err)
+        super().__init__(msg or f"cls error {self.errno}")
+
+
+class MethodNotFound(ClsError):
+    def __init__(self, what: str):
+        super().__init__(EOPNOTSUPP, f"no such class method {what}")
+
+
+# cls name -> method name -> (flags, fn(ctx, indata) -> bytes | (rc, bytes))
+registry: dict[str, dict[str, tuple[int, Callable]]] = {}
+
+_BUILTIN_PKG = __name__.rsplit(".", 1)[0]  # ceph_tpu.cls
+
+
+def cls_method(cls_name: str, method: str, flags: int):
+    """Register a method (objclass.h cls_register_cxx_method)."""
+
+    def deco(fn):
+        registry.setdefault(cls_name, {})[method] = (flags, fn)
+        return fn
+
+    return deco
+
+
+def load_class(name: str) -> None:
+    """The dlopen analog: import ceph_tpu.cls.<name>, whose module body
+    registers its methods (a `libcls_<name>.so` __cls_init)."""
+    if name in registry:
+        return
+    importlib.import_module(f"{_BUILTIN_PKG}.{name}")
+    if name not in registry:
+        raise MethodNotFound(f"{name} (module registered no methods)")
+
+
+def get_method(cls_name: str, method: str) -> tuple[int, Callable]:
+    """Resolve, loading the class on first use (PrimaryLogPG CALL path:
+    osd->class_handler->open_class)."""
+    methods = registry.get(cls_name)
+    if methods is None:
+        try:
+            load_class(cls_name)
+        except (ImportError, MethodNotFound):
+            raise MethodNotFound(f"{cls_name}.{method}") from None
+        methods = registry.get(cls_name, {})
+    entry = methods.get(method)
+    if entry is None:
+        raise MethodNotFound(f"{cls_name}.{method}")
+    return entry
+
+
+class HCtx:
+    """cls_method_context_t: the method's window onto its object.
+
+    Reads see the object's pre-op state overlaid with writes staged
+    earlier in the same op; writes stage into `attrs` / `data` and are
+    folded into the PGTransaction by the PG after the method returns.
+    `entity` is the calling client (reqid), the identity cls_lock keys on.
+    """
+
+    def __init__(
+        self,
+        *,
+        exists: bool,
+        read_fn: Callable[[], bytes],
+        getattr_fn: Callable[[str], bytes | None],
+        entity: str = "",
+        writable: bool = False,
+    ):
+        self._exists = exists
+        self._read_fn = read_fn
+        self._getattr_fn = getattr_fn
+        self.entity = entity
+        self.writable = writable
+        # staged state (read-your-writes overlay; None value = removed)
+        self.attrs: dict[str, bytes | None] = {}
+        self.data: bytes | None = None
+        # whole-object view already folded into the enclosing transaction
+        # by an earlier method in the same op (set by the PG)
+        self.folded_data: bytes | None = None
+        self.created = False
+
+    # -- reads ----------------------------------------------------------------
+
+    def exists(self) -> bool:
+        return self._exists or self.created
+
+    def read(self) -> bytes:
+        """cls_cxx_read (whole object)."""
+        if self.data is not None:
+            return self.data
+        if self.folded_data is not None:
+            return self.folded_data
+        if not self._exists:
+            raise ClsError(ENOENT, "object does not exist")
+        return self._read_fn()
+
+    def getxattr(self, name: str) -> bytes | None:
+        """cls_cxx_getxattr; None when absent."""
+        if name in self.attrs:
+            return self.attrs[name]
+        return self._getattr_fn(name)
+
+    # -- writes (WR methods only) ---------------------------------------------
+
+    def _need_wr(self) -> None:
+        if not self.writable:
+            raise ClsError(EOPNOTSUPP, "RD method attempted a write")
+
+    def create(self) -> None:
+        """cls_cxx_create: materialize the object (touch)."""
+        self._need_wr()
+        self.created = True
+
+    def write_full(self, data: bytes) -> None:
+        self._need_wr()
+        self.data = bytes(data)
+        self.created = True
+
+    def setxattr(self, name: str, value: bytes) -> None:
+        self._need_wr()
+        self.attrs[name] = bytes(value)
+        self.created = True
+
+    def rmxattr(self, name: str) -> None:
+        self._need_wr()
+        self.attrs[name] = None
+
+    def dirty(self) -> bool:
+        return bool(self.attrs) or self.data is not None or self.created

@@ -15,3 +15,5 @@ ENODATA = 61
 ENXIO = 6
 ENOTDIR = 20
 ENOTEMPTY = 39
+EOPNOTSUPP = 95
+ECANCELED = 125

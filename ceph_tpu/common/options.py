@@ -87,6 +87,14 @@ OPTIONS: dict[str, Option] = _opts(
         "default stripe unit (bytes) for EC pools (osd.yaml.in)",
     ),
     Option(
+        "osd_op_class_load_list",
+        str,
+        "lock version numops refcount",
+        A,
+        "object classes preloaded at OSD boot (osd_class_load_list; "
+        "others load lazily on first CALL)",
+    ),
+    Option(
         "osd_pool_default_erasure_code_profile",
         str,
         "plugin=tpu technique=reed_sol_van k=2 m=1",

@@ -70,6 +70,7 @@ class OSDOp(Struct):
     COPY_FROM = 15    # copy another object's content (name = src oid)
     CACHE_FLUSH = 16  # write a dirty cache-tier object back to the base pool
     CACHE_EVICT = 17  # drop a clean object from the cache tier
+    CALL = 18         # object-class method (name = "cls.method", data = input)
 
     FIELDS = [
         ("op", "u8"),

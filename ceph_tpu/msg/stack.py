@@ -85,10 +85,8 @@ class _PipeReader(asyncio.StreamReader):
         self._note_consumed(data)
         return data
 
-    async def readline(self):
-        data = await super().readline()
-        self._note_consumed(data)
-        return data
+    # NOTE: no readline override — StreamReader.readline delegates to
+    # self.readuntil, so overriding both would double-count consumption.
 
     async def readuntil(self, separator: bytes = b"\n"):
         data = await super().readuntil(separator)

@@ -192,6 +192,15 @@ class OSD(Dispatcher):
                 ec_registry().load(name)
             except Exception as e:
                 _dout("osd", 1, f"osd.{self.whoami}: preload {name} failed: {e}")
+        # preload object classes (ClassHandler::open_all_classes via
+        # osd_class_load_list; others load lazily on first CALL)
+        from ..cls.objclass import load_class
+
+        for name in self.conf.get("osd_op_class_load_list").split():
+            try:
+                load_class(name)
+            except Exception as e:
+                _dout("osd", 1, f"osd.{self.whoami}: cls {name} failed: {e}")
         self.store.mount()
         await self.msgr.bind(self._bind_addr)
         self.msgr.add_dispatcher_head(self)

@@ -64,6 +64,23 @@ WRITE_OPS = {
 DIRTY_ATTR = "cache_dirty"
 
 
+def op_is_write(op: OSDOp) -> bool:
+    """Write-class test honoring CALL's per-method RD/WR flags
+    (PrimaryLogPG classifies CALL by the resolved method's flags)."""
+    if op.op == OSDOp.CALL:
+        from ..cls import objclass
+
+        try:
+            cls_name, method = op.name.split(".", 1)
+            flags, _fn = objclass.get_method(cls_name, method)
+        except Exception:
+            # unresolvable: route through the read path, which reports
+            # the precise error (-EOPNOTSUPP)
+            return False
+        return bool(flags & objclass.WR)
+    return op.op in WRITE_OPS
+
+
 class PG(PGListener):
     """One placement group hosted by an OSD (possibly one shard of it)."""
 
@@ -362,6 +379,10 @@ class PG(PGListener):
             # here the character is reserved.
             reply(self._errored(msg, -EINVAL))
             return
+        # Classify once: op_is_write resolves CALL methods (possibly an
+        # import on first use), so the result is shared by the tier gate
+        # and the dispatch decision below.
+        writing = any(op_is_write(op) for op in msg.ops)
         # Cache-tier gate (PrimaryLogPG::maybe_handle_cache): promote on
         # miss, forward deletes to the base, reject writes on readonly.
         # OSD-internal traffic ("osd." clients: promote writes, flush acks)
@@ -370,7 +391,7 @@ class PG(PGListener):
             self.pool.is_cache_tier()
             and msg.reqid.client
             and not msg.reqid.client.startswith("osd.")
-            and not self._tier_gate(msg, reply, conn)
+            and not self._tier_gate(msg, reply, conn, writing)
         ):
             return
         first = msg.ops[0].op if msg.ops else 0
@@ -380,7 +401,7 @@ class PG(PGListener):
         if first == OSDOp.NOTIFY:
             self._do_notify(msg, reply)
             return
-        if any(op.op in WRITE_OPS for op in msg.ops):
+        if writing:
             if self.scrubber.write_blocked(oid):
                 # write_blocked_by_scrub: hold until the chunk completes
                 self.scrubber.waiting_writes.append(
@@ -406,7 +427,8 @@ class PG(PGListener):
         outdata: list[bytes] = [b""] * len(msg.ops)
         size = self._object_size(msg.oid)
         exists = self._object_exists(msg.oid)
-        for op in msg.ops:
+        hctx = None  # object-class context, shared across this op's CALLs
+        for i, op in enumerate(msg.ops):
             if op.op == OSDOp.WRITE:
                 pgt.write(op.off, op.data)
                 size = max(size, op.off + len(op.data))
@@ -465,6 +487,51 @@ class PG(PGListener):
             elif op.op == OSDOp.COPY_FROM:
                 self._start_copy_from(msg, reply, op)
                 return
+            elif op.op == OSDOp.CALL:
+                # WR-class object-class method: runs against the pre-op
+                # state overlaid with everything staged EARLIER in this
+                # op (pgt.attrs), and its mutations fold into the SAME
+                # PGTransaction immediately — so a later plain op
+                # overrides a class write and vice versa, honoring the
+                # client's op ordering (PrimaryLogPG do_osd_ops CALL).
+                from ..cls.objclass import ClsError, get_method
+
+                if hctx is None:
+                    hctx = self._make_hctx(
+                        msg.oid, msg, writable=True, pgt=pgt
+                    )
+                try:
+                    cls_name, method = op.name.split(".", 1)
+                    _flags, fn = get_method(cls_name, method)
+                    outdata[i] = fn(hctx, op.data) or b""
+                except ClsError as e:
+                    # a failing method aborts the WHOLE transaction
+                    # (nothing staged so far may land)
+                    self._inflight_reqids.pop(msg.reqid.key(), None)
+                    reply(self._errored(msg, e.errno))
+                    return
+                except Exception as e:
+                    # a buggy/malformed-input method must not leak the
+                    # exception past the reply (the client would hang on
+                    # its registered reqid); the reference maps method
+                    # faults to an errno the same way
+                    dout("osd", 1, f"cls {op.name} raised {e!r}")
+                    self._inflight_reqids.pop(msg.reqid.key(), None)
+                    reply(self._errored(msg, -EINVAL))
+                    return
+                # fold this method's staged mutations NOW (in op order)
+                staged = hctx.dirty()
+                for k, v in hctx.attrs.items():
+                    pgt.attrs[f"_{k}"] = v
+                hctx.attrs.clear()
+                if hctx.data is not None:
+                    pgt.write(0, hctx.data)
+                    pgt.truncate = len(hctx.data)
+                    size = len(hctx.data)
+                    hctx.folded_data = hctx.data  # later methods' read()
+                    hctx.data = None
+                if staged:
+                    pgt.attrs.setdefault(WHITEOUT_ATTR, None)
             else:
                 self._inflight_reqids.pop(msg.reqid.key(), None)
                 reply(self._errored(msg, -EINVAL))
@@ -572,6 +639,25 @@ class PG(PGListener):
                     result = -ENODATA
                     break
                 outdata[i] = val
+            elif op.op == OSDOp.CALL:
+                # RD-class object-class method (PrimaryLogPG do_osd_ops
+                # CALL case; WR methods classify as writes in do_op)
+                from ..cls.objclass import ClsError, get_method
+
+                hctx = self._make_hctx(target, msg, writable=False)
+                try:
+                    cls_name, method = op.name.split(".", 1)
+                    _flags, fn = get_method(cls_name, method)
+                    outdata[i] = fn(hctx, op.data) or b""
+                except ClsError as e:
+                    result = e.errno
+                    break
+                except Exception as e:
+                    # a buggy/malformed-input method must not leak past
+                    # the reply (the client would hang on its reqid)
+                    dout("osd", 1, f"cls {op.name} raised {e!r}")
+                    result = -EINVAL
+                    break
             elif op.op == OSDOp.PGLS:
                 # PrimaryLogPG::do_pgnls — enumerate this PG's heads
                 # (snap clones are internal, filtered like the reference
@@ -738,18 +824,61 @@ class PG(PGListener):
 
         self.osd.internal_read(self.pool.id, src, src_snap, on_fetched)
 
+    # -- object classes (src/objclass; PrimaryLogPG CALL) ----------------------
+
+    def _make_hctx(self, oid: str, msg: MOSDOp, writable: bool, pgt=None):
+        """cls_method_context_t for `oid`: pre-op state reads + staged
+        overlay.  With `pgt`, attr reads consult the transaction first so
+        a method observes plain SETXATTRs (and earlier folded CALLs) from
+        the same compound op, in order.  Sync DATA reads are unavailable
+        on EC pools (the reference's objects_read_sync answers
+        -EOPNOTSUPP there too) and reflect pre-op bytes plus whole-object
+        class writes — byte-range plain writes earlier in the same
+        compound op are not visible to a later method's read().  Xattr
+        state — what lock/version/refcount/numops key on — is fully
+        ordered on every pool type."""
+        from ..common.errs import EOPNOTSUPP
+        from ..cls.objclass import ClsError, HCtx
+
+        exists = self._object_exists(oid) and not self._getxattr(
+            oid, WHITEOUT_ATTR
+        )
+
+        def read_fn() -> bytes:
+            if self.pool.type == POOL_TYPE_ERASURE:
+                raise ClsError(
+                    EOPNOTSUPP, "sync object read on an EC pool"
+                )
+            coll = shard_coll(self.pgid, -1)
+            return bytes(
+                self.osd.store.read(coll, oid, 0, self._object_size(oid))
+            )
+
+        def getattr_fn(name: str):
+            if pgt is not None and f"_{name}" in pgt.attrs:
+                return pgt.attrs[f"_{name}"]  # None == removed
+            return self._getxattr(oid, f"_{name}")
+
+        return HCtx(
+            exists=exists,
+            read_fn=read_fn,
+            getattr_fn=getattr_fn,
+            entity=msg.reqid.client,
+            writable=writable,
+        )
+
     # -- cache tiering (PrimaryLogPG maybe_handle_cache / TierAgentState) ------
 
-    def _tier_gate(self, msg: MOSDOp, reply, conn) -> bool:
+    def _tier_gate(self, msg: MOSDOp, reply, conn, writing: bool) -> bool:
         """Returns True to continue normal dispatch, False when the op was
         consumed (promotion in flight, forwarded, or rejected).
+        `writing` is do_op's once-computed write classification.
 
         Scope mirrors the reference's writeback/readonly modes with two
         documented simplifications: promotion copies object BYTES (not
         xattrs), and cache pools don't combine with pool snapshots.
         """
         first = msg.ops[0].op if msg.ops else 0
-        writing = any(op.op in WRITE_OPS for op in msg.ops)
         if msg.oid in self._flushing and (
             writing or first in (OSDOp.CACHE_FLUSH, OSDOp.CACHE_EVICT)
         ):

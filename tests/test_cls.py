@@ -1,0 +1,257 @@
+"""Object classes (src/objclass + src/cls): registry/runtime units plus
+live-cluster exec of the in-tree classes (lock, version, numops,
+refcount) — the reference's third plugin family."""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.client import Rados, RadosError
+from ceph_tpu.cls import client as cls_client
+from ceph_tpu.cls.objclass import (
+    RD,
+    WR,
+    ClsError,
+    HCtx,
+    MethodNotFound,
+    cls_method,
+    get_method,
+)
+
+from test_cluster import start_cluster, stop_cluster
+
+
+class TestRuntime:
+    def test_registry_and_lazy_load(self):
+        flags, fn = get_method("numops", "add")  # lazy import
+        assert flags & WR and callable(fn)
+        with pytest.raises(MethodNotFound):
+            get_method("numops", "nope")
+        with pytest.raises(MethodNotFound):
+            get_method("no_such_class", "m")
+
+    def test_hctx_overlay_and_rd_guard(self):
+        ctx = HCtx(
+            exists=True,
+            read_fn=lambda: b"disk",
+            getattr_fn=lambda n: b"old" if n == "a" else None,
+            entity="client.x",
+            writable=True,
+        )
+        assert ctx.read() == b"disk"
+        assert ctx.getxattr("a") == b"old"
+        ctx.setxattr("a", b"new")
+        ctx.write_full(b"staged")
+        # read-your-writes overlay
+        assert ctx.getxattr("a") == b"new"
+        assert ctx.read() == b"staged"
+        ro = HCtx(
+            exists=True, read_fn=lambda: b"", getattr_fn=lambda n: None,
+            writable=False,
+        )
+        with pytest.raises(ClsError):
+            ro.setxattr("x", b"1")
+
+    def test_method_decorator_registers(self):
+        @cls_method("testcls_xyz", "echo", RD)
+        def echo(ctx, indata):
+            return indata[::-1]
+
+        flags, fn = get_method("testcls_xyz", "echo")
+        assert flags == RD and fn(None, b"abc") == b"cba"
+
+
+def _cluster_test(body):
+    async def run():
+        monmap, mons, osds = await start_cluster(1, 3)
+        client = Rados(monmap)
+        await client.connect()
+        await client.pool_create("clsp", "replicated", pg_num=4)
+        io = await client.open_ioctx("clsp")
+        try:
+            await body(client, io)
+        finally:
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+    asyncio.run(run())
+
+
+class TestNumops:
+    def test_server_side_arithmetic(self):
+        async def body(client, io):
+            assert await cls_client.numops_add(io, "counter", "n", 5) == 5
+            assert await cls_client.numops_add(io, "counter", "n", 2.5) == 7.5
+            out = await io.exec(
+                "counter", "numops", "mul",
+                json.dumps({"key": "n", "value": 2}).encode(),
+            )
+            assert float(out.decode()) == 15
+            with pytest.raises(RadosError):
+                await io.exec(
+                    "counter", "numops", "div",
+                    json.dumps({"key": "n", "value": 0}).encode(),
+                )
+            # the stored value is a plain xattr, interoperable
+            assert await io.getxattr("counter", "n") == b"15"
+
+        _cluster_test(body)
+
+
+class TestLock:
+    def test_exclusive_lock_contention_and_break(self):
+        async def body(client, io):
+            await cls_client.lock(io, "obj", "guard", cookie="c1")
+            # renewal by the same (entity, cookie) succeeds
+            await cls_client.lock(io, "obj", "guard", cookie="c1")
+            # a second client contends -> EBUSY
+            other = Rados(client.objecter.monc.monmap, name="client.other")
+            await other.connect()
+            oio = await other.open_ioctx("clsp")
+            with pytest.raises(RadosError):
+                await cls_client.lock(oio, "obj", "guard", cookie="c2")
+            info = await cls_client.get_lock_info(io, "obj", "guard")
+            assert info["type"] == "exclusive" and len(info["holders"]) == 1
+            # break the holder's lock from the other client, then acquire
+            await cls_client.break_lock(
+                oio, "obj", "guard", entity="client.admin", cookie="c1"
+            )
+            await cls_client.lock(oio, "obj", "guard", cookie="c2")
+            await other.shutdown()
+
+        _cluster_test(body)
+
+    def test_shared_locks_coexist(self):
+        async def body(client, io):
+            await cls_client.lock(io, "s", "l", cookie="a", lock_type="shared")
+            other = Rados(client.objecter.monc.monmap, name="client.o2")
+            await other.connect()
+            oio = await other.open_ioctx("clsp")
+            await cls_client.lock(oio, "s", "l", cookie="b", lock_type="shared")
+            info = await cls_client.get_lock_info(io, "s", "l")
+            assert len(info["holders"]) == 2
+            # unlock by non-holder cookie -> ENOENT
+            with pytest.raises(RadosError):
+                await cls_client.unlock(io, "s", "l", cookie="zz")
+            await cls_client.unlock(io, "s", "l", cookie="a")
+            await cls_client.unlock(oio, "s", "l", cookie="b")
+            await other.shutdown()
+
+        _cluster_test(body)
+
+
+class TestVersion:
+    def test_inc_read_check(self):
+        async def body(client, io):
+            assert await cls_client.version_inc(io, "v") == 1
+            assert await cls_client.version_inc(io, "v") == 2
+            assert await cls_client.version_read(io, "v") == 2
+            await cls_client.version_check(io, "v", 2, "eq")
+            await cls_client.version_check(io, "v", 1, "gt")
+            with pytest.raises(RadosError):
+                await cls_client.version_check(io, "v", 3, "eq")
+
+        _cluster_test(body)
+
+
+class TestRefcount:
+    def test_tags_and_last_put(self):
+        async def body(client, io):
+            await io.write_full("shared", b"tail bytes")
+            await cls_client.refcount_get(io, "shared", "u1")
+            await cls_client.refcount_get(io, "shared", "u2")
+            assert await cls_client.refcount_put(io, "shared", "u1") is False
+            assert await cls_client.refcount_put(io, "shared", "u2") is True
+            with pytest.raises(RadosError):
+                await cls_client.refcount_put(io, "shared", "u3")
+
+        _cluster_test(body)
+
+
+class TestErrors:
+    def test_unknown_class_is_eopnotsupp(self):
+        async def body(client, io):
+            with pytest.raises(RadosError) as ei:
+                await io.exec("o", "nonexistent", "m", b"")
+            assert ei.value.errno == -95
+
+        _cluster_test(body)
+
+    def test_failed_method_aborts_whole_transaction(self):
+        async def body(client, io):
+            # numops add on a non-numeric xattr fails -> nothing may land
+            await io.write_full("t", b"x")
+            await io.setxattr("t", "n", b"not a number")
+            with pytest.raises(RadosError):
+                await io.exec(
+                    "t", "numops", "add",
+                    json.dumps({"key": "n", "value": 1}).encode(),
+                )
+            assert await io.getxattr("t", "n") == b"not a number"
+
+        _cluster_test(body)
+
+
+class TestReviewRegressions:
+    def test_malformed_input_errors_instead_of_hanging(self):
+        """A method raising an unexpected exception (KeyError on a
+        malformed request) must map to an errno reply, not a leaked
+        exception that leaves the client waiting forever."""
+
+        async def body(client, io):
+            with pytest.raises(RadosError) as ei:
+                await io.exec("o", "lock", "lock", b"{}")  # missing "name"
+            assert ei.value.errno == -22
+
+        _cluster_test(body)
+
+    def test_shared_to_exclusive_escalation_refused(self):
+        async def body(client, io):
+            await cls_client.lock(io, "e", "l", cookie="a", lock_type="shared")
+            other = Rados(client.objecter.monc.monmap, name="client.e2")
+            await other.connect()
+            oio = await other.open_ioctx("clsp")
+            await cls_client.lock(oio, "e", "l", cookie="b", lock_type="shared")
+            # A cannot escalate while B shares
+            with pytest.raises(RadosError):
+                await cls_client.lock(io, "e", "l", cookie="a",
+                                      lock_type="exclusive")
+            # after B releases, escalation as sole holder succeeds
+            await cls_client.unlock(oio, "e", "l", cookie="b")
+            await cls_client.lock(io, "e", "l", cookie="a",
+                                  lock_type="exclusive")
+            await other.shutdown()
+
+        _cluster_test(body)
+
+    def test_call_and_plain_ops_honor_order(self):
+        """Mutations fold in op order: a plain SETXATTR after a CALL in
+        the same compound op wins, and a CALL reads attrs staged by an
+        earlier CALL."""
+        from ceph_tpu.msg.messages import OSDOp
+
+        async def body(client, io):
+            rep = await io._op(
+                "ord",
+                [
+                    OSDOp(op=OSDOp.CALL, name="version.set",
+                          data=json.dumps({"ver": 5}).encode()),
+                    OSDOp(op=OSDOp.SETXATTR, name="ver", data=b"plain"),
+                ],
+            )
+            assert rep.result == 0
+            assert await io.getxattr("ord", "ver") == b"plain"
+            # and the reverse: CALL after SETXATTR sees + overrides it
+            rep = await io._op(
+                "ord2",
+                [
+                    OSDOp(op=OSDOp.SETXATTR, name="n", data=b"7"),
+                    OSDOp(op=OSDOp.CALL, name="numops.add",
+                          data=json.dumps({"key": "n", "value": 3}).encode()),
+                ],
+            )
+            assert rep.result == 0
+            assert await io.getxattr("ord2", "n") == b"10"
+
+        _cluster_test(body)
