@@ -207,11 +207,16 @@ class IoCtx:
 
     # -- copy-from -------------------------------------------------------------
 
-    async def copy_from(self, oid: str, src_oid: str, src_snap: int = 0) -> None:
+    async def copy_from(
+        self, oid: str, src_oid: str, src_snap: int = 0, snapc=None
+    ) -> None:
         """Server-side object copy (rados_copy_from / CEPH_OSD_OP_COPY_FROM):
-        bytes move OSD->OSD, never through this client."""
+        bytes move OSD->OSD, never through this client.  A write-class op:
+        the snap context rides along so the destination's pre-copy head
+        clones for new snapshots like any other mutation."""
         rep = await self._op(
-            oid, [OSDOp(op=OSDOp.COPY_FROM, name=src_oid, off=src_snap)]
+            oid, [OSDOp(op=OSDOp.COPY_FROM, name=src_oid, off=src_snap)],
+            snapc=snapc,
         )
         _check(rep.result, f"copy_from {src_oid} -> {oid}")
 

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 import json
 
-from ..common.errs import ECANCELED
+from ..common.errs import ECANCELED, EINVAL
 from .objclass import RD, WR, ClsError, HCtx, cls_method
 
 ATTR = "ver"
@@ -49,7 +49,9 @@ def check(ctx: HCtx, indata: bytes) -> bytes:
     op = req.get("cond", "eq")
     ok = {"eq": have == want, "gt": have > want, "ge": have >= want}.get(op)
     if ok is None:
-        raise ClsError(ECANCELED, f"unknown cond {op!r}")
+        # malformed input, NOT a guard mismatch: retry loops keyed on
+        # -ECANCELED must be able to tell the two apart
+        raise ClsError(EINVAL, f"unknown cond {op!r}")
     if not ok:
         raise ClsError(ECANCELED, f"version {have} fails {op} {want}")
     return b""

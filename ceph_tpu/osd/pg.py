@@ -46,6 +46,7 @@ from .peering import PeeringState
 from .pg_backend import PGListener, build_pg_backend, shard_coll
 from .pg_log import Eversion, LogEntry, Missing, PGLog, PgInfo
 from .snaps import SS_ATTR, WHITEOUT_ATTR, SnapSet, clone_oid
+from ..cls.objclass import WR as CLS_WR, ClsError, HCtx as ClsHCtx, get_method as cls_get_method
 
 WRITE_OPS = {
     OSDOp.WRITE,
@@ -68,16 +69,14 @@ def op_is_write(op: OSDOp) -> bool:
     """Write-class test honoring CALL's per-method RD/WR flags
     (PrimaryLogPG classifies CALL by the resolved method's flags)."""
     if op.op == OSDOp.CALL:
-        from ..cls import objclass
-
         try:
             cls_name, method = op.name.split(".", 1)
-            flags, _fn = objclass.get_method(cls_name, method)
+            flags, _fn = cls_get_method(cls_name, method)
         except Exception:
             # unresolvable: route through the read path, which reports
             # the precise error (-EOPNOTSUPP)
             return False
-        return bool(flags & objclass.WR)
+        return bool(flags & CLS_WR)
     return op.op in WRITE_OPS
 
 
@@ -494,15 +493,17 @@ class PG(PGListener):
                 # PGTransaction immediately — so a later plain op
                 # overrides a class write and vice versa, honoring the
                 # client's op ordering (PrimaryLogPG do_osd_ops CALL).
-                from ..cls.objclass import ClsError, get_method
-
                 if hctx is None:
                     hctx = self._make_hctx(
                         msg.oid, msg, writable=True, pgt=pgt
                     )
                 try:
                     cls_name, method = op.name.split(".", 1)
-                    _flags, fn = get_method(cls_name, method)
+                    _flags, fn = cls_get_method(cls_name, method)
+                    # enforce CLS_METHOD_WR per method, not per message:
+                    # an RD method riding a compound write op must still
+                    # be denied mutations
+                    hctx.writable = bool(_flags & CLS_WR)
                     outdata[i] = fn(hctx, op.data) or b""
                 except ClsError as e:
                     # a failing method aborts the WHOLE transaction
@@ -642,12 +643,10 @@ class PG(PGListener):
             elif op.op == OSDOp.CALL:
                 # RD-class object-class method (PrimaryLogPG do_osd_ops
                 # CALL case; WR methods classify as writes in do_op)
-                from ..cls.objclass import ClsError, get_method
-
                 hctx = self._make_hctx(target, msg, writable=False)
                 try:
                     cls_name, method = op.name.split(".", 1)
-                    _flags, fn = get_method(cls_name, method)
+                    _flags, fn = cls_get_method(cls_name, method)
                     outdata[i] = fn(hctx, op.data) or b""
                 except ClsError as e:
                     result = e.errno
@@ -838,7 +837,6 @@ class PG(PGListener):
         state — what lock/version/refcount/numops key on — is fully
         ordered on every pool type."""
         from ..common.errs import EOPNOTSUPP
-        from ..cls.objclass import ClsError, HCtx
 
         exists = self._object_exists(oid) and not self._getxattr(
             oid, WHITEOUT_ATTR
@@ -859,7 +857,7 @@ class PG(PGListener):
                 return pgt.attrs[f"_{name}"]  # None == removed
             return self._getxattr(oid, f"_{name}")
 
-        return HCtx(
+        return ClsHCtx(
             exists=exists,
             read_fn=read_fn,
             getattr_fn=getattr_fn,

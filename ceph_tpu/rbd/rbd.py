@@ -29,6 +29,8 @@ from __future__ import annotations
 import json
 import secrets
 
+from ..client.rados import RadosError
+from ..cls import client as cls_client
 from ..common.errs import EEXIST, EINVAL, ENOENT
 
 DIRECTORY_OID = "rbd_directory"
@@ -124,6 +126,7 @@ class Image:
         self.name = name
         self.id = image_id
         self.header: dict = {}
+        self._lock_cookie: str | None = None  # our exclusive-lock hold
 
     # -- header ----------------------------------------------------------------
 
@@ -137,6 +140,57 @@ class Image:
 
     async def _save_header(self) -> None:
         await self.ioctx.write_full(self._header_oid, json.dumps(self.header).encode())
+
+    # -- exclusive lock (librbd ManagedLock over cls_lock) ---------------------
+
+    LOCK_NAME = "rbd_lock"  # the lock name librbd registers on the header
+
+    async def lock_acquire(self, cookie: str | None = None) -> None:
+        """Acquire the image's exclusive lock (rbd_lock on the header
+        object via the lock object class — the reference's ManagedLock /
+        exclusive_lock feature).  -EBUSY propagates as RbdError when
+        another client owns the image.
+
+        The default cookie is RANDOM per open image (librbd generates
+        unique cookies the same way): cls_lock keys holders on (entity,
+        cookie), and two same-named clients sharing a fixed cookie would
+        both "own" the exclusive lock as renewals of one hold."""
+        if cookie is None:
+            cookie = self._lock_cookie or f"auto {secrets.token_hex(8)}"
+        try:
+            await cls_client.lock(
+                self.ioctx, self._header_oid, self.LOCK_NAME, cookie=cookie,
+                description=f"rbd image {self.name}",
+            )
+        except RadosError as e:
+            raise RbdError(-e.errno, f"image {self.name!r} is locked") from e
+        self._lock_cookie = cookie
+
+    async def lock_release(self, cookie: str | None = None) -> None:
+        await cls_client.unlock(
+            self.ioctx, self._header_oid, self.LOCK_NAME,
+            cookie=cookie if cookie is not None else (self._lock_cookie or ""),
+        )
+        self._lock_cookie = None
+
+    async def lock_owners(self) -> list[dict]:
+        """Current holders (rbd lock ls): [{entity, cookie, description}]."""
+        info = await cls_client.get_lock_info(
+            self.ioctx, self._header_oid, self.LOCK_NAME
+        )
+        return [
+            {"entity": h[0], "cookie": h[1], "description": h[2]}
+            for h in info["holders"]
+        ]
+
+    async def break_lock(self, entity: str, cookie: str) -> None:
+        """Forcibly remove another client's hold (rbd lock rm — the
+        failover path rbd-mirror promotion uses when the old primary's
+        owner died)."""
+        await cls_client.break_lock(
+            self.ioctx, self._header_oid, self.LOCK_NAME, entity,
+            cookie=cookie,
+        )
 
     @property
     def size(self) -> int:

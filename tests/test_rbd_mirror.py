@@ -311,3 +311,47 @@ class TestMirroring:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestExclusiveLock:
+    def test_ownership_contention_and_break(self):
+        """librbd exclusive-lock over the lock object class: a second
+        client cannot acquire an owned image; after the owner dies, the
+        failover path breaks the stale hold and takes over (ManagedLock /
+        `rbd lock rm`)."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.rbd.rbd import RBD, RbdError
+
+            monmap, mons, osds = await start_cluster(1, 3)
+            owner = Rados(monmap, name="client.owner")
+            await owner.connect()
+            await owner.pool_create("rbdl", "replicated", pg_num=4)
+            oio = await owner.open_ioctx("rbdl")
+            rbd = RBD(oio)
+            await rbd.create("disk", 4 << 20)
+            img = await rbd.open("disk")
+            await img.lock_acquire(cookie="c-owner")
+
+            taker = Rados(monmap, name="client.taker")
+            await taker.connect()
+            tio = await taker.open_ioctx("rbdl")
+            timg = await RBD(tio).open("disk")
+            with pytest.raises(RbdError):
+                await timg.lock_acquire(cookie="c-taker")
+            holders = await timg.lock_owners()
+            assert holders == [
+                {"entity": "client.owner", "cookie": "c-owner",
+                 "description": "rbd image disk"}
+            ]
+            # the owner "dies" (no unlock); failover breaks + acquires
+            await owner.shutdown()
+            await timg.break_lock("client.owner", cookie="c-owner")
+            await timg.lock_acquire(cookie="c-taker")
+            assert (await timg.lock_owners())[0]["entity"] == "client.taker"
+            await timg.lock_release(cookie="c-taker")
+            await taker.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
