@@ -31,7 +31,7 @@ import secrets
 
 from ..client.rados import RadosError
 from ..cls import client as cls_client
-from ..common.errs import EEXIST, EINVAL, ENOENT
+from ..common.errs import EBUSY, EEXIST, EINVAL, ENOENT
 
 DIRECTORY_OID = "rbd_directory"
 DEFAULT_ORDER = 22  # 4 MiB objects
@@ -163,21 +163,34 @@ class Image:
                 description=f"rbd image {self.name}",
             )
         except RadosError as e:
-            raise RbdError(-e.errno, f"image {self.name!r} is locked") from e
+            # -EBUSY is contention; anything else (header gone, I/O
+            # error) must not be misreported as "locked"
+            what = (
+                f"image {self.name!r} is locked"
+                if e.errno == -EBUSY
+                else f"image {self.name!r} lock_acquire failed"
+            )
+            raise RbdError(-e.errno, what) from e
         self._lock_cookie = cookie
 
     async def lock_release(self, cookie: str | None = None) -> None:
-        await cls_client.unlock(
-            self.ioctx, self._header_oid, self.LOCK_NAME,
-            cookie=cookie if cookie is not None else (self._lock_cookie or ""),
-        )
+        try:
+            await cls_client.unlock(
+                self.ioctx, self._header_oid, self.LOCK_NAME,
+                cookie=cookie if cookie is not None else (self._lock_cookie or ""),
+            )
+        except RadosError as e:
+            raise RbdError(-e.errno, f"image {self.name!r} unlock failed") from e
         self._lock_cookie = None
 
     async def lock_owners(self) -> list[dict]:
         """Current holders (rbd lock ls): [{entity, cookie, description}]."""
-        info = await cls_client.get_lock_info(
-            self.ioctx, self._header_oid, self.LOCK_NAME
-        )
+        try:
+            info = await cls_client.get_lock_info(
+                self.ioctx, self._header_oid, self.LOCK_NAME
+            )
+        except RadosError as e:
+            raise RbdError(-e.errno, f"image {self.name!r} lock query failed") from e
         return [
             {"entity": h[0], "cookie": h[1], "description": h[2]}
             for h in info["holders"]
@@ -187,10 +200,13 @@ class Image:
         """Forcibly remove another client's hold (rbd lock rm — the
         failover path rbd-mirror promotion uses when the old primary's
         owner died)."""
-        await cls_client.break_lock(
-            self.ioctx, self._header_oid, self.LOCK_NAME, entity,
-            cookie=cookie,
-        )
+        try:
+            await cls_client.break_lock(
+                self.ioctx, self._header_oid, self.LOCK_NAME, entity,
+                cookie=cookie,
+            )
+        except RadosError as e:
+            raise RbdError(-e.errno, f"image {self.name!r} break_lock failed") from e
 
     @property
     def size(self) -> int:

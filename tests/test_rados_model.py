@@ -231,3 +231,62 @@ class TestRadosModel:
     @pytest.mark.parametrize("seed", [3])
     def test_erasure(self, seed):
         _run_model("erasure", seed)
+
+
+class TestThrashModel:
+    """RadosModel + thrasher (qa/tasks/ceph_manager.py thrashers over the
+    rados task): random ops continue while an OSD is killed mid-sequence
+    and revived later; model verification runs degraded AND after
+    recovery converges."""
+
+    def test_replicated_with_osd_thrash(self):
+        async def run():
+            from test_cluster import fast_conf, wait_until
+            from ceph_tpu.osd.osd import OSD
+
+            monmap, mons, osds = await start_cluster(1, 4)
+            client = Rados(monmap)
+            await client.connect()
+            pool = "thrashp"
+            await client.pool_create(pool, "replicated", pg_num=4)
+            io = await client.open_ioctx(pool)
+            rng = random.Random(42)
+            model = Model()
+            oids = [f"t{i}" for i in range(6)]
+
+            for _ in range(30):
+                await _apply_random_op(rng, io, client, model, oids, pool)
+            await _verify(io, model, oids)
+
+            # thrash: kill osd.3, keep operating degraded
+            victim = osds[3]
+            store = victim.store
+            await victim.stop()
+            await wait_until(
+                lambda: not mons[0].osdmon.osdmap.is_up(3),
+                8.0,
+                "mon marking osd.3 down",
+            )
+            for _ in range(30):
+                await _apply_random_op(rng, io, client, model, oids, pool)
+            await _verify(io, model, oids)
+
+            # revive on the old store; recovery must converge, then the
+            # model must still hold (no lost or resurrected state)
+            revived = OSD(3, monmap, conf=fast_conf(3), store=store)
+            await revived.start()
+            await revived.wait_for_up()
+            osds[3] = revived
+
+            await wait_until(
+                lambda: all(o.all_clean() for o in osds if o._running),
+                15.0,
+                "recovery to clean",
+            )
+            for _ in range(20):
+                await _apply_random_op(rng, io, client, model, oids, pool)
+            await _verify(io, model, oids)
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
