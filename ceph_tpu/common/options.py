@@ -116,6 +116,9 @@ OPTIONS: dict[str, Option] = _opts(
            "objects per backfill scan chunk", runtime=True),
     Option("osd_op_num_shards", int, 4, A,
            "op queue shards (OSD.h sharded op queue)"),
+    Option("osd_op_history_size", int, 20, A,
+           "completed ops kept for dump_historic_ops (TrackedOp.h)",
+           runtime=True),
     Option("osd_op_num_threads_per_shard", int, 2, A, ""),
     Option("osd_heartbeat_interval", float, 1.0, A,
            "seconds between OSD->OSD pings (osd.yaml.in, scaled down)"),

@@ -151,6 +151,17 @@ class OSD(Dispatcher):
         # internal (OSD-as-client) reads for COPY_FROM source fetches
         self._internal_tid = 0
         self._internal_reads: dict[int, object] = {}
+        # op tracking (TrackedOp.h OpTracker; dumped via the admin socket)
+        from ..common.op_tracker import OpTracker
+
+        self.op_tracker = OpTracker(
+            history_size=self.conf.get("osd_op_history_size")
+        )
+        # runtime-mutable: resize the history ring on config push
+        self.conf.add_observer(
+            ["osd_op_history_size"],
+            lambda _n, v: self.op_tracker.resize_history(int(v)),
+        )
         # span tracer threaded through the EC data path (common/tracer.py;
         # the reference's ZTracer/jaeger integration, dumped via the admin
         # socket's `dump_tracer`)
@@ -237,11 +248,19 @@ class OSD(Dispatcher):
             "dump collected trace spans (EC data path)",
         )
         sock.register(
+            "dump_historic_ops",
+            lambda cmd: self.op_tracker.dump_historic(),
+            "recently completed ops with events + durations (OpTracker)",
+        )
+        sock.register(
+            "dump_historic_slow_ops",
+            lambda cmd: self.op_tracker.dump_slow(),
+            "slowest completed ops retained (OpTracker)",
+        )
+        sock.register(
             "dump_ops_in_flight",
             lambda cmd: {
-                "num_ops": sum(
-                    len(pg._inflight_reqids) for pg in self.pgs.values()
-                ),
+                **self.op_tracker.dump_in_flight(),
                 "pgs": {
                     repr(pg.pgid): sorted(
                         f"{c}:{t}" for c, t in pg._inflight_reqids
@@ -454,20 +473,32 @@ class OSD(Dispatcher):
         """enqueue_op (OSD.cc:9431): into the QoS scheduler."""
         cost = sum(len(op.data) for op in msg.ops) or 4096
         self.perf.inc("op")
+        # OpTracker registration (OpRequest created at dispatch,
+        # TrackedOp::mark_event through the pipeline)
+        token = self.op_tracker.create(
+            f"osd_op({msg.reqid.client}:{msg.reqid.tid} "
+            f"{msg.pgid.pool}.{msg.pgid.ps} {msg.oid} "
+            f"[{','.join(str(op.op) for op in msg.ops)}])"
+        )
 
         def run() -> None:
-            self._do_dispatch_op(conn, msg)
+            self.op_tracker.mark_event(token, "dequeued")
+            self._do_dispatch_op(conn, msg, token)
 
         self.sched.enqueue(
             WorkItem(run=run, klass=SchedClass.CLIENT, cost=cost)
         )
         self._sched_kick.set()
 
-    def _do_dispatch_op(self, conn: Connection, msg: MOSDOp) -> None:
+    def _do_dispatch_op(
+        self, conn: Connection, msg: MOSDOp, token: int = 0
+    ) -> None:
         """dequeue_op (OSD.cc:9491) → PG::do_op."""
         pg = self._get_pg(msg.pgid)
 
         def reply(rep: MOSDOpReply) -> None:
+            self.op_tracker.finish(token)
+
             async def _send():
                 try:
                     await conn.send_message(rep)
@@ -492,7 +523,13 @@ class OSD(Dispatcher):
         for op in msg.ops:
             if op.data:
                 self.perf.inc("op_in_bytes", len(op.data))
-        pg.do_op(msg, reply, conn)
+        try:
+            pg.do_op(msg, reply, conn)
+        except Exception:
+            # a faulting op handler must not leak its tracker entry (the
+            # reply closure, the only finish() site, will never run)
+            self.op_tracker.finish(token)
+            raise
 
     async def _op_worker(self) -> None:
         """The op worker (the reference's ShardedThreadPool shards,

@@ -307,3 +307,31 @@ class TestAdminSocketIntrospection:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestOpTracking:
+    def test_historic_ops_dumped(self):
+        """The OpTracker surfaces completed client ops (descriptions,
+        events, durations) — dump_historic_ops' data source."""
+
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("trackp", "replicated", pg_num=2)
+            io = await client.open_ioctx("trackp")
+            await io.write_full("tracked", b"x" * 512)
+            assert await io.read("tracked") == b"x" * 512
+            dumps = [o.op_tracker.dump_historic() for o in osds]
+            ops = [op for d in dumps for op in d["ops"]]
+            assert any("tracked" in op["description"] for op in ops)
+            assert all(op["duration"] is not None for op in ops)
+            assert any(
+                e["event"] == "dequeued"
+                for op in ops
+                for e in op["type_data"]["events"]
+            )
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())

@@ -325,3 +325,39 @@ class TestAdminSocket:
         assert result["perf"]["osd"]["ops"] == 5
         assert "perf dump" in result["help"]
         assert "unknown command" in result["err"]
+
+
+class TestOpTracker:
+    def test_inflight_history_and_slow(self):
+        """OpTracker (src/common/TrackedOp.h): in-flight registry, event
+        marks, bounded history, slowest-retained ring."""
+        import time as _time
+
+        from ceph_tpu.common.op_tracker import OpTracker
+
+        t = OpTracker(history_size=3, slow_size=2)
+        a = t.create("osd_op(a)")
+        b = t.create("osd_op(b)")
+        t.mark_event(a, "queued")
+        d = t.dump_in_flight()
+        assert d["num_ops"] == 2
+        assert d["ops"][0]["description"] == "osd_op(a)"
+        assert any(e["event"] == "queued" for e in d["ops"][0]["type_data"]["events"])
+        t.finish(a)
+        _time.sleep(0.01)
+        t.finish(b)  # slower (finished later from same-ish start)
+        assert t.dump_in_flight()["num_ops"] == 0
+        h = t.dump_historic()
+        assert h["num_ops"] == 2
+        assert h["ops"][0]["description"] == "osd_op(b)"  # most recent first
+        assert all(o["duration"] is not None for o in h["ops"])
+        # history ring is bounded
+        for i in range(5):
+            t.finish(t.create(f"osd_op(x{i})"))
+        assert t.dump_historic()["num_ops"] == 3
+        # slow ring keeps the slowest two
+        s = t.dump_slow()
+        assert s["num_ops"] == 2
+        assert s["ops"][0]["duration"] >= s["ops"][1]["duration"]
+        # finishing an unknown token is a no-op
+        t.finish(99999)
