@@ -175,6 +175,11 @@ OPTIONS: dict[str, Option] = _opts(
            "objectstore backend: memstore | filestore | bluestore"),
     Option("osd_data", str, "", A,
            "data directory for persistent stores (empty = in-memory)"),
+    Option("bluestore_compression_algorithm", str, "none", A,
+           "blob compression: none | zlib | zstd "
+           "(src/compressor plugin family; bluestore_compression_algorithm)"),
+    Option("bluestore_compression_required_ratio", float, 0.875, A,
+           "store compressed only when compressed/raw <= this ratio"),
     Option("memstore_device_bytes", int, 1 << 30, A, ""),
     # --- logging (src/log) --------------------------------------------------
     Option("log_file", str, "", B, "empty = stderr"),

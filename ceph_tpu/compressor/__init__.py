@@ -1,0 +1,16 @@
+"""Compressor plugin family — mirror of src/compressor.
+
+The reference's third dlopen plugin family beside erasure-code and the
+object classes: `Compressor::create(type)` resolves a named algorithm
+plugin (zlib/snappy/lz4/zstd/brotli) used by BlueStore blob compression
+and msgr2 on-wire compression.  Same shape here: a registry of named
+compressors (zlib and zstd from the environment, plus passthrough
+"none"), consumed by the BlueStore block path.  The on-wire session
+(msg/crypto.py) deliberately keeps its own zlib with a bounded inflate:
+a deflate bomb from a hostile peer must not OOM the daemon, a guard the
+generic interface doesn't carry.
+"""
+
+from .registry import Compressor, CompressorRegistry, get_compressor
+
+__all__ = ["Compressor", "CompressorRegistry", "get_compressor"]

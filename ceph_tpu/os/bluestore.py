@@ -18,21 +18,29 @@ here to the triad that defines the design):
   *Deferred WAL* — small overwrites of already-allocated blocks ride the
   metadata commit as WAL records (bluestore_deferred_transaction_t) and
   are applied to the block file after commit; mount replays unapplied
-  records (idempotent full-block images).
+  records (idempotent whole-slot images — BLOCK bytes raw, or the
+  block's clen-byte compressed form).
 - **Per-block checksums** (BlueStore csum_type=crc32c, per csum-block):
-  every stored block carries a crc32c in the onode extent map, verified
-  on every read; a flipped bit in the block file surfaces as EIO instead
-  of silent corruption.
+  every stored block carries a crc32c in the onode extent map computed
+  over the STORED form (compressed or raw), verified on every read
+  before any decompression; a flipped bit in the block file surfaces
+  as EIO instead of silent corruption.
+- **Blob compression** (BlueStore _do_alloc_write compression): with
+  bluestore_compression_algorithm set, a block image is stored
+  compressed when it beats bluestore_compression_required_ratio; the
+  onode entry records the stored length.
 - **Metadata in the KV DB** (RocksDB in the reference, FileKV here):
   onodes, collections, and WAL records commit in ONE atomic batch
   (KeyValueDB::Transaction) — the transaction's commit point.
 
-Logical layout: block index `i` of an object maps to one physical block;
-the in-memory map is {block_index: (phys_off, crc)} and serializes as
-runs.  All block writes are full-block read-modify-write images, so WAL
-replay needs no byte-level merging.  Bytes at logical offsets >= the
-object size are undefined-on-disk but never observable: reads clamp to
-size and overlays treat them as zeros (hole semantics).
+Logical layout: block index `i` of an object maps to one physical block
+slot; the in-memory map is {block_index: (phys_off, crc, clen)} — clen 0
+for a raw BLOCK, else the compressed stored length — and serializes as
+runs.  Every write replaces a block's WHOLE stored image (read-modify-
+write at block granularity), so WAL replay needs no byte-level merging.
+Bytes at logical offsets >= the object size are undefined-on-disk but
+never observable: reads clamp to size and overlays treat them as zeros
+(hole semantics).
 """
 
 from __future__ import annotations
@@ -67,21 +75,25 @@ class SimulatedCrash(RuntimeError):
 @dataclass
 class Onode:
     size: int = 0
-    # logical block index -> (physical byte offset, crc32c of stored block)
-    blocks: dict[int, tuple[int, int]] = field(default_factory=dict)
+    # logical block index -> (physical byte offset, crc32c of STORED
+    # bytes, stored length).  clen == 0 means a raw BLOCK; clen > 0 means
+    # the slot holds clen bytes compressed with the store's algorithm
+    # (BlueStore blob compression, scoped to one block per blob).
+    blocks: dict[int, tuple[int, int, int]] = field(default_factory=dict)
     xattrs: dict[str, bytes] = field(default_factory=dict)
     omap: dict[str, bytes] = field(default_factory=dict)
 
     def encode(self) -> bytes:
         runs = []
         for bidx in sorted(self.blocks):
-            poff, crc = self.blocks[bidx]
+            poff, crc, clen = self.blocks[bidx]
             if runs and runs[-1][0] + len(runs[-1][2]) == bidx and runs[-1][1] + len(
                 runs[-1][2]
             ) * BLOCK == poff:
                 runs[-1][2].append(crc)
+                runs[-1][3].append(clen)
             else:
-                runs.append([bidx, poff, [crc]])
+                runs.append([bidx, poff, [crc], [clen]])
         return json.dumps(
             {
                 "size": self.size,
@@ -95,9 +107,11 @@ class Onode:
     def decode(cls, blob: bytes) -> "Onode":
         info = json.loads(blob.decode())
         o = cls(size=info["size"])
-        for bidx, poff, crcs in info["runs"]:
+        for run in info["runs"]:
+            bidx, poff, crcs = run[0], run[1], run[2]
+            clens = run[3] if len(run) > 3 else [0] * len(crcs)
             for i, crc in enumerate(crcs):
-                o.blocks[bidx + i] = (poff + i * BLOCK, crc)
+                o.blocks[bidx + i] = (poff + i * BLOCK, crc, clens[i])
         o.xattrs = {k: bytes.fromhex(v) for k, v in info["xattrs"].items()}
         o.omap = {k: bytes.fromhex(v) for k, v in info["omap"].items()}
         return o
@@ -156,7 +170,13 @@ def make_store(conf) -> ObjectStore:
     kind = conf.get("osd_objectstore")
     data = conf.get("osd_data")
     if kind == "bluestore":
-        return BlueStore(data or None)
+        return BlueStore(
+            data or None,
+            compression=conf.get("bluestore_compression_algorithm"),
+            compression_required_ratio=conf.get(
+                "bluestore_compression_required_ratio"
+            ),
+        )
     if kind == "filestore":
         if not data:
             raise ValueError("filestore requires osd_data")
@@ -167,8 +187,20 @@ def make_store(conf) -> ObjectStore:
 class BlueStore(ObjectStore):
     """dir/ holds `block` (flat data file) and `kv` (FileKV metadata)."""
 
-    def __init__(self, path: str | None = None):
+    def __init__(
+        self,
+        path: str | None = None,
+        compression: str = "none",
+        compression_required_ratio: float = 0.875,
+    ):
+        from ..compressor import get_compressor
+
         self.path = path
+        # blob compression (BlueStore _do_alloc_write compression path):
+        # a block is stored compressed only when it shrinks below the
+        # required ratio; csums always cover the stored form
+        self._compressor = get_compressor(compression or "none")
+        self._required_ratio = compression_required_ratio
         self.db: KeyValueDB = MemKV() if path is None else None  # set at mount
         self._block_f = None
         self.alloc = BitmapAllocator(INITIAL_BLOCKS)
@@ -191,6 +223,18 @@ class BlueStore(ObjectStore):
         # KV records must not resurrect through the db.get fallback
         self._staged_rm: set[tuple[str, str]] = set()
         self._crash_point: str | None = None  # crash-injection test seam
+
+    def _store_form(self, image: bytes) -> tuple[bytes, int]:
+        """(stored bytes, clen) for a full-block image: the compressed
+        form when the algorithm is on AND it beats the required ratio
+        (bluestore_compression_required_ratio), else the raw block
+        (clen 0)."""
+        if self._compressor.name == "none":
+            return image, 0
+        comp = self._compressor.compress(image)
+        if len(comp) <= int(BLOCK * self._required_ratio):
+            return comp, len(comp)
+        return image, 0
 
     # -- mount / umount --------------------------------------------------------
 
@@ -219,7 +263,7 @@ class BlueStore(ObjectStore):
             coll = key.partition("\x00")[0]
             self._obj_count[coll] = self._obj_count.get(coll, 0) + 1
             o = Onode.decode(blob)
-            for poff, _crc in o.blocks.values():
+            for poff, _crc, _cl in o.blocks.values():
                 self.alloc.mark_used(poff // BLOCK)
         # Replay deferred writes that committed but may not have reached
         # the block file (BlueStore::_deferred_replay).  Idempotent: each
@@ -375,16 +419,22 @@ class BlueStore(ObjectStore):
         ent = o.blocks.get(bidx)
         if ent is None:
             return b"\x00" * BLOCK
-        poff, crc = ent
-        staged = self._staged.get(poff)
-        if staged is not None:  # written this txn, not yet in the block file
-            return staged
-        data = self._block_read(poff, BLOCK)
-        if len(data) < BLOCK:
-            data = data + b"\x00" * (BLOCK - len(data))  # lazily-grown file
-        if crc32c(data) != crc:
+        poff, crc, clen = ent
+        stored = self._staged.get(poff)
+        if stored is None:
+            # _block_read returns at most the requested bytes; a short raw
+            # read (lazily-grown file) zero-pads, a short compressed read
+            # is caught by the crc below
+            stored = self._block_read(poff, clen or BLOCK)
+            if not clen and len(stored) < BLOCK:
+                stored = stored + b"\x00" * (BLOCK - len(stored))  # lazy file
+        # csum covers the STORED bytes (compressed or raw), so corruption
+        # is caught before decompression can amplify it
+        if crc32c(stored) != crc:
             raise StoreError(5, f"csum mismatch at block {bidx} (poff {poff})")
-        return data
+        if clen:
+            return self._compressor.decompress(stored)
+        return stored
 
     def _valid_block(self, o: Onode, bidx: int) -> bytes:
         """Block content with bytes at logical offsets >= size zeroed —
@@ -420,11 +470,11 @@ class BlueStore(ObjectStore):
         if all_mapped and len(data) <= DEFERRED_MAX:
             # deferred WAL overwrite in place
             for b, image in images.items():
-                poff, _ = o.blocks[b]
-                image = bytes(image)
-                o.blocks[b] = (poff, crc32c(image))
-                self._deferred.append((poff, image))
-                self._staged[poff] = image
+                poff = o.blocks[b][0]
+                stored, clen = self._store_form(bytes(image))
+                o.blocks[b] = (poff, crc32c(stored), clen)
+                self._deferred.append((poff, stored))
+                self._staged[poff] = stored
         else:
             # COW: fresh blocks for the whole affected range
             newblocks = self._ensure_capacity(len(images))
@@ -432,10 +482,10 @@ class BlueStore(ObjectStore):
                 old = o.blocks.get(b)
                 if old is not None:
                     self._to_release.append(old[0] // BLOCK)
-                image = bytes(image)
-                o.blocks[b] = (nb * BLOCK, crc32c(image))
-                self._direct.append((nb * BLOCK, image))
-                self._staged[nb * BLOCK] = image
+                stored, clen = self._store_form(bytes(image))
+                o.blocks[b] = (nb * BLOCK, crc32c(stored), clen)
+                self._direct.append((nb * BLOCK, stored))
+                self._staged[nb * BLOCK] = stored
         o.size = max(o.size, off + len(data))
 
     def _truncate(self, coll: str, oid: str, size: int) -> None:
@@ -454,9 +504,10 @@ class BlueStore(ObjectStore):
             if tail and b in o.blocks:
                 image = self._logical_block(o, b)[:tail] + b"\x00" * (BLOCK - tail)
                 poff = o.blocks[b][0]
-                o.blocks[b] = (poff, crc32c(image))
-                self._deferred.append((poff, image))
-                self._staged[poff] = image
+                stored, clen = self._store_form(image)
+                o.blocks[b] = (poff, crc32c(stored), clen)
+                self._deferred.append((poff, stored))
+                self._staged[poff] = stored
         o.size = size
 
     def _remove(self, coll: str, oid: str) -> None:
@@ -469,7 +520,7 @@ class BlueStore(ObjectStore):
             o = self._get_onode(coll, oid)
         except StoreError:
             return
-        for poff, _ in o.blocks.values():
+        for poff, _crc, _cl in o.blocks.values():
             self._to_release.append(poff // BLOCK)
         self._onodes.pop(ck, None)
         self._dirty.discard(ck)
@@ -513,7 +564,7 @@ class BlueStore(ObjectStore):
         data = self.read(coll, src, 0, 0)
         # reset target, then write through the normal (COW) path
         d = self._get_onode(coll, dst, create=True)
-        for poff, _ in d.blocks.values():
+        for poff, _crc, _cl in d.blocks.values():
             self._to_release.append(poff // BLOCK)
         d.blocks.clear()
         d.size = 0
