@@ -27,14 +27,14 @@ class Elector:
         n_mons: int,
         send: Callable[[int, MMonElection], None],
         on_win: Callable[[int, list[int]], None],
-        on_lose: Callable[[int, int], None],
+        on_lose: Callable[[int, int, list[int]], None],
         timeout: float = 0.5,
     ):
         self.rank = rank
         self.n_mons = n_mons
         self.send = send
         self.on_win = on_win  # (epoch, quorum ranks)
-        self.on_lose = on_lose  # (epoch, leader rank)
+        self.on_lose = on_lose  # (epoch, leader rank, quorum ranks)
         self.timeout = timeout
         self.epoch = 1  # odd = stable, even = electing
         self.electing = False
@@ -163,7 +163,8 @@ class Elector:
                 self.send(
                     r,
                     MMonElection(
-                        op=MMonElection.OP_VICTORY, epoch=self.epoch, rank=self.rank
+                        op=MMonElection.OP_VICTORY, epoch=self.epoch,
+                        rank=self.rank, quorum=quorum
                     ),
                 )
         self.on_win(self.epoch, quorum)
@@ -177,4 +178,4 @@ class Elector:
         self.leader = msg.rank
         self.deferred = None
         dout("mon", 5, f"mon.{self.rank} defers to leader mon.{msg.rank}")
-        self.on_lose(self.epoch, msg.rank)
+        self.on_lose(self.epoch, msg.rank, list(msg.quorum))

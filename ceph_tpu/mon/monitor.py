@@ -59,7 +59,10 @@ class Monitor(Dispatcher):
         secure: bool = False,
         compress: bool = False,
         stack: str = "posix",  # ms_type (msg/stack.py)
+        admin_socket: str = "",  # unix socket path; empty disables
     ):
+        self._admin_socket_path = admin_socket
+        self.admin_socket = None
         self.name = name
         self.monmap = monmap
         self.rank = monmap.rank_of(name)
@@ -101,13 +104,63 @@ class Monitor(Dispatcher):
         self.msgr.add_dispatcher_head(self)
         self.elector.start()
         self._tick_task = asyncio.create_task(self._tick_loop())
+        await self._start_admin_socket()
         self._started.set()
+
+    async def _start_admin_socket(self) -> None:
+        """Mon admin socket (Monitor::_add_admin_socket_commands):
+        mon_status / quorum_status / paxos introspection."""
+        if not self._admin_socket_path:
+            return
+        from ..common.admin_socket import AdminSocket
+
+        sock = AdminSocket(self._admin_socket_path)
+        sock.register("mon_status", lambda cmd: self.mon_status(),
+                      "this monitor's state")
+        # same payload as the MMonCommand quorum_status handler, so the
+        # two views of the quorum can never drift apart
+        sock.register("quorum_status", lambda cmd: self.quorum_status(),
+                      "current quorum + leader")
+        sock.register("paxosinfo", lambda cmd: {
+            "last_committed": self.paxos.last_committed,
+            "accepted_pn": self.paxos.accepted_pn,
+            "leading": self.paxos.leading,
+            "store_versions": len(self.paxos.store),
+        }, "paxos engine state (Paxos::dump_info)")
+        await sock.start()
+        self.admin_socket = sock
+
+    def quorum_status(self) -> dict:
+        """Shared quorum view (the MMonCommand handler and the admin
+        socket both serve this shape)."""
+        return {
+            "quorum": sorted(self.quorum),
+            "leader": self.leader_rank,
+            "epoch": self.elector.epoch,
+        }
+
+    def mon_status(self) -> dict:
+        """`ceph tell mon.x mon_status` payload."""
+        return {
+            "name": self.name,
+            "rank": self.rank,
+            "state": (
+                "leader" if self.is_leader()
+                else "peon" if self.rank in self.quorum
+                else "electing"
+            ),
+            "quorum": sorted(self.quorum),
+            "monmap": dict(self.monmap.addrs),
+        }
 
     async def stop(self) -> None:
         self.elector.cancel()
         if self._tick_task is not None:
             self._tick_task.cancel()
             self._tick_task = None
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         await self.msgr.shutdown()
 
     async def _tick_loop(self) -> None:
@@ -159,8 +212,13 @@ class Monitor(Dispatcher):
         for svc in (self.mgrmon, self.configmon, self.logmon, self.authmon):
             svc.on_election_changed()
 
-    def _lose_election(self, epoch: int, leader: int) -> None:
-        self.quorum = []
+    def _lose_election(
+        self, epoch: int, leader: int, quorum: list[int] | None = None
+    ) -> None:
+        # Peons DO know the quorum: the victory message carries it
+        # (previously reset to [], which made every healthy peon report
+        # "electing" with an empty quorum through mon_status).
+        self.quorum = list(quorum or [])
         self.leader_rank = leader
         self.paxos.peon_init(leader)
         self.osdmon.on_election_lost()
@@ -322,17 +380,7 @@ class Monitor(Dispatcher):
     def _mon_command_handler(self, prefix: str):
         if prefix == "quorum_status":
             def handler(cmd, reply):
-                reply(
-                    0,
-                    "",
-                    json.dumps(
-                        {
-                            "quorum": self.quorum,
-                            "leader": self.leader_rank,
-                            "epoch": self.elector.epoch,
-                        }
-                    ).encode(),
-                )
+                reply(0, "", json.dumps(self.quorum_status()).encode())
             return handler
         if prefix == "status":
             def handler(cmd, reply):

@@ -349,8 +349,14 @@ class MMonElection(Message):
     OP_ACK = 2
     OP_VICTORY = 3
 
-    FIELDS = [("op", "u8"), ("epoch", "u64"), ("rank", "u32")]
+    # `quorum` rides OP_VICTORY so every member (peons included) learns
+    # the full quorum set, as the reference's victory message does.
+    FIELDS = [("op", "u8"), ("epoch", "u64"), ("rank", "u32"),
+              ("quorum", ("list", "u32"))]
     priority = PRIO_HIGH
+
+    def __init__(self, op=0, epoch=0, rank=0, quorum=None):
+        super().__init__(op=op, epoch=epoch, rank=rank, quorum=quorum or [])
 
 
 # --- peering / recovery ------------------------------------------------------

@@ -243,3 +243,56 @@ class TestMultiMon:
             await stop_mons(mons)
 
         asyncio.run(run())
+
+
+class TestMonAdminSocket:
+    def test_status_and_paxos_dumps(self, tmp_path):
+        """Mon admin socket (Monitor::_add_admin_socket_commands):
+        mon_status / quorum_status / paxosinfo over the unix socket."""
+
+        async def run():
+            from ceph_tpu.common.admin_socket import admin_command
+
+            monmap = MonMap(addrs=free_port_addrs(3))
+            path = str(tmp_path / "mon.a.asok")
+            mons = []
+            for i, name in enumerate(monmap.addrs):
+                mons.append(
+                    Monitor(
+                        name, monmap, election_timeout=0.3,
+                        admin_socket=path if i == 0 else "",
+                    )
+                )
+            for m in mons:
+                await m.start()
+            for m in mons:
+                await m.wait_for_quorum()
+            loop = asyncio.get_event_loop()
+            # Poll until this mon's view settles: peons learn the quorum
+            # from the victory message, so leader AND peons report the
+            # full member list.
+            deadline = loop.time() + 8.0
+            while True:
+                st = await loop.run_in_executor(
+                    None, lambda: admin_command(path, "mon_status")
+                )
+                if st["state"] in ("leader", "peon") and st["quorum"] == [0, 1, 2]:
+                    break
+                assert loop.time() < deadline, f"mon never settled: {st}"
+                await asyncio.sleep(0.05)
+            assert st["name"] == mons[0].name
+            assert st["rank"] in st["quorum"]
+            q = await loop.run_in_executor(
+                None, lambda: admin_command(path, "quorum_status")
+            )
+            # same payload shape as the MMonCommand quorum_status handler
+            assert q["leader"] is not None and q["quorum"] == [0, 1, 2]
+            assert q["epoch"] >= 1
+            p = await loop.run_in_executor(
+                None, lambda: admin_command(path, "paxosinfo")
+            )
+            assert p["last_committed"] >= 0
+            for m in mons:
+                await m.stop()
+
+        asyncio.run(run())
