@@ -247,6 +247,29 @@ class OSD(Dispatcher):
             lambda cmd: {"spans": self.tracer.export()},
             "dump collected trace spans (EC data path)",
         )
+        def _pg_for_cmd(cmd):
+            if "pool" not in cmd or "ps" not in cmd:
+                raise ValueError("command requires args: pool, ps")
+            pg = self.pgs.get((int(cmd["pool"]), int(cmd["ps"])))
+            if pg is None:
+                raise ValueError(f"no pg {cmd.get('pool')}.{cmd.get('ps')} here")
+            return pg
+
+        sock.register(
+            "list_unfound",
+            lambda cmd: {"unfound": _pg_for_cmd(cmd).list_unfound()},
+            "missing objects with no live source (args: pool, ps)",
+        )
+        sock.register(
+            "mark_unfound_lost",
+            lambda cmd: {
+                "lost": _pg_for_cmd(cmd).mark_unfound_lost(
+                    cmd.get("mode", "delete")
+                )
+            },
+            "give up on unfound objects: delete + release waiters "
+            "(args: pool, ps[, mode=delete])",
+        )
         sock.register(
             "dump_historic_ops",
             lambda cmd: self.op_tracker.dump_historic(),

@@ -1385,6 +1385,78 @@ class PG(PGListener):
 
         self.backend.recover_object(oid, missing_on, on_complete)
 
+    # -- lost/unfound (PrimaryLogPG mark_all_unfound_lost; MissingLoc) ---------
+
+    def list_unfound(self) -> list[str]:
+        """Missing objects with NO live source (MissingLoc's unfound set):
+        replicated — no up acting member holds a copy; EC — fewer than k
+        up shards hold theirs.  Recovery of these can never complete and
+        ops touching them wait forever until the operator intervenes
+        (qa/tasks/ec_lost_unfound.py is the reference's coverage)."""
+        p = self.peering
+        if not p.is_primary() or not p.is_active():
+            # conflating "wrong daemon" with "nothing unfound" would
+            # mislead the operator running this against a replica
+            raise ValueError(
+                f"pg {self.pgid}: not the active primary here"
+            )
+        up_acting = [
+            o
+            for o in self._acting
+            if o != PG_NONE and self.osd.osdmap.is_up(o)
+        ]
+        need = self.backend.k if self.pool.type == POOL_TYPE_ERASURE else 1
+        out = []
+        for oid in p.all_missing_oids():
+            # a backfill target whose cursor hasn't passed `oid` holds at
+            # best a STALE copy — it is not a source (same union
+            # get_shard_missing applies on the read path)
+            missing_on = p.osds_missing(oid) | p.backfill_pending_osds(oid)
+            holders = [o for o in up_acting if o not in missing_on]
+            if len(holders) < need:
+                out.append(oid)
+        return out
+
+    def mark_unfound_lost(self, mode: str = "delete") -> list[str]:
+        """`ceph pg <pgid> mark_unfound_lost delete` — give up on unfound
+        objects: drop them from every missing set, delete surviving
+        remnant shards through the normal transaction fan-out, and
+        release ops queued behind their recovery (they re-run and answer
+        ENOENT).  Only the reference's `delete` mode is offered: `revert`
+        requires prior-version data this framework's log doesn't retain.
+        """
+        if mode != "delete":
+            raise ValueError(
+                "only mode='delete' is supported (revert needs rollback data)"
+            )
+        lost = self.list_unfound()
+        for oid in lost:
+            self.peering.missing.rm(oid)
+            for m in self.peering.peer_missing.values():
+                m.rm(oid)
+            self.recovering.discard(oid)
+            self._tier_tid += 1
+            pgt = PGTransaction(oid=oid, delete=True)
+            try:
+                self.backend.submit_transaction(
+                    pgt,
+                    ReqId(
+                        client=f"osd.{self.osd.whoami}.lost",
+                        tid=self._tier_tid,
+                    ),
+                    lambda: None,
+                )
+            except Exception as e:
+                # remnant cleanup is best-effort: the object is already
+                # struck from the missing sets either way
+                dout("osd", 5, f"pg {self.pgid}: lost-delete of {oid}: {e!r}")
+            self.clog_error(
+                f"pg {self.pgid} marking unfound object {oid} lost (deleted)"
+            )
+            for cb in self.waiting_for_degraded.pop(oid, []):
+                cb()
+        return lost
+
     # -- backfill driver -------------------------------------------------------
     #
     # PeeringState's WaitLocalBackfillReserved → WaitRemoteBackfillReserved

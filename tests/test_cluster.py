@@ -335,3 +335,76 @@ class TestOpTracking:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestLostUnfound:
+    def test_mark_unfound_lost_releases_blocked_ops(self):
+        """The lost/unfound escape hatch (PrimaryLogPG
+        mark_all_unfound_lost; qa ec_lost_unfound analog): an object
+        missing with NO live source blocks every op touching it; the
+        operator's mark_unfound_lost strikes it from the missing sets,
+        deletes remnants, and blocked ops re-run to ENOENT.
+
+        The unfound condition is FORGED on the primary (missing entries
+        injected on every acting member) — producing it organically needs
+        a multi-failure choreography the thrash tier doesn't model; the
+        machinery under test (predicate, command, waiter release, delete
+        fan-out) is the real path either way."""
+
+        async def run():
+            from ceph_tpu.osd.pg_log import Eversion
+
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("lostp", "replicated", pg_num=2)
+            io = await client.open_ioctx("lostp")
+            await io.write_full("doomed", b"gone soon")
+            assert await io.read("doomed") == b"gone soon"
+
+            # find the primary PG and forge "missing everywhere"
+            pool_id = client.objecter.osdmap.get_pool("lostp").id
+            primary_pg = None
+            for o in osds:
+                for (pid, ps), pg in o.pgs.items():
+                    if pid == pool_id and pg.peering.is_primary() and (
+                        pg._object_exists("doomed")
+                    ):
+                        primary_pg = pg
+                        break
+            assert primary_pg is not None
+            # destroy every replica's bytes UNDER the op path, then mark
+            # the object missing everywhere: recovery now has no source
+            from ceph_tpu.os.transaction import Transaction as StoreTxn
+            from ceph_tpu.osd.pg_backend import shard_coll
+
+            coll = shard_coll(primary_pg.pgid, -1)
+            for o in osds:
+                if o.store.exists(coll, "doomed"):
+                    o.store.queue_transaction(StoreTxn().remove(coll, "doomed"))
+            need = Eversion(1, 999)
+            primary_pg.peering.missing.add("doomed", need)
+            for m in primary_pg.peering.peer_missing.values():
+                m.add("doomed", need)
+            assert primary_pg.list_unfound() == ["doomed"]
+
+            # ops on the object now queue behind (never-completing) recovery
+            read_task = asyncio.get_event_loop().create_task(
+                io.read("doomed")
+            )
+            await asyncio.sleep(0.3)
+            assert not read_task.done(), "op should block on the unfound object"
+
+            lost = primary_pg.mark_unfound_lost("delete")
+            assert lost == ["doomed"]
+            with pytest.raises(RadosError) as ei:
+                await read_task
+            assert ei.value.errno == -2  # ENOENT after the lost-delete
+            assert primary_pg.list_unfound() == []
+            # revert mode is explicitly unsupported
+            with pytest.raises(ValueError):
+                primary_pg.mark_unfound_lost("revert")
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
