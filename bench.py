@@ -40,6 +40,9 @@ import time
 NORTH_STAR_GBPS = 40.0
 
 # Bounded deadlines so an axon backend-init hang cannot eat the whole round.
+# Deadline covers backend init + one remote compile per tuned batch depth
+# (first compiles are ~20-40 s each through the remote-compile helper).
+# Kept under the 300 s wrapper the verify recipe uses around bench.py.
 TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_TIMEOUT", "240"))
 CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_TIMEOUT", "300"))
 TPU_RETRIES = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
@@ -87,11 +90,12 @@ def run_child(platform: str) -> None:
     k, m = 8, 3
     chunk = 128 * 1024  # 1 MiB object / 8 data chunks
     on_tpu = got == "tpu"
-    # 256 MiB of object data per launch: the codec's deep-batching design
-    # point.  Measured on-chip, launch overhead through the axon tunnel is
-    # ~2-3 ms regardless of size, so 64 MiB launches cap at ~21 GB/s while
-    # 256 MiB launches run at the kernel's ~53 GB/s bandwidth-bound rate.
-    batch = 256 if on_tpu else 2
+    # Deep batching is the codec's design point: launch overhead through
+    # the axon tunnel is ~2-3 ms regardless of size, so 64 MiB launches
+    # cap at ~21 GB/s while 256 MiB launches run at the kernel's ~53 GB/s
+    # bandwidth-bound rate.  The launch depth is TUNED below (a short
+    # probe per candidate) and the best one measured fully.
+    batch_candidates = (256, 512) if on_tpu else (2,)
     iters = 40 if on_tpu else 3
 
     # The SHIPPING path: the registered `tpu` plugin's device encode — the
@@ -113,10 +117,6 @@ def run_child(platform: str) -> None:
         clog("PARITY MISMATCH vs host oracle")
         sys.exit(4)
 
-    data = jnp.asarray(
-        rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8), dtype=jnp.uint8
-    )
-
     # Serial-chain methodology: each launch's input depends on the previous
     # launch's parity (a 128-byte patch, updated in place via donation), so
     # runtime-level caching/elision of repeated identical launches cannot
@@ -127,29 +127,53 @@ def run_child(platform: str) -> None:
         d2 = jax.lax.dynamic_update_slice(d, patch, (0, 0, 0))
         return d2, encode_fn(d2)
 
-    clog("compiling + warming")
-    p = encode_fn(data)
-    data, p = step(data, p)  # compile + warm
-    jax.block_until_ready((data, p))
+    def run_chain(batch: int, n: int) -> float:
+        """GB/s (input bytes) over n chained launches at this depth.  A
+        tiny device->host readback closes the timing window honestly: on
+        the axon backend, block_until_ready alone has been observed to
+        return before queued launches finish; materializing bytes cannot.
+        """
+        data = jnp.asarray(
+            rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8),
+            dtype=jnp.uint8,
+        )
+        # zeros seed: step only reads 128 bytes of p for the patch, and
+        # the warm call below regenerates real parity — seeding through
+        # encode_fn would cost a second remote compile per depth
+        p = jnp.zeros((batch, m, chunk), jnp.uint8)
+        data, p = step(data, p)  # compile + warm
+        jax.block_until_ready((data, p))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            data, p = step(data, p)
+        jax.block_until_ready((data, p))
+        _ = np.asarray(p[0, 0, :8])
+        elapsed = time.perf_counter() - t0
+        del data, p
+        return batch * k * chunk * n / elapsed / 1e9
+
+    batch = batch_candidates[0]
+    if len(batch_candidates) > 1:
+        probes = {}
+        for cand in batch_candidates:
+            clog(f"tuning: probing batch={cand}")
+            try:
+                probes[cand] = run_chain(cand, 6)
+            except Exception as e:
+                # a failing depth (OOM, compile error) must not cost the
+                # TPU headline: keep whatever candidates survive
+                clog(f"tuning: batch={cand} FAILED: {e!r}")
+                continue
+            clog(f"tuning: batch={cand} -> {probes[cand]:.2f} GB/s")
+        if probes:
+            batch = max(probes, key=probes.get)
 
     clog(f"measuring: batch={batch} iters={iters}")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        data, p = step(data, p)
-    jax.block_until_ready((data, p))
-    # A tiny device->host readback of the final parity closes the timing
-    # window honestly: on the axon backend, block_until_ready alone has
-    # been observed to return before queued launches finish; materializing
-    # bytes cannot.  8 bytes amortized over `iters` launches is noise.
-    _ = np.asarray(p[0, 0, :8])
-    elapsed = time.perf_counter() - t0
-
-    total_bytes = batch * k * chunk * iters  # input object bytes, harness semantics
-    gbps = total_bytes / elapsed / 1e9
-    clog(f"done: elapsed={elapsed:.4f}s -> {gbps:.3f} GB/s")
+    gbps = run_chain(batch, iters)
+    clog(f"done: {gbps:.3f} GB/s at batch={batch}")
     print(
         json.dumps(
-            {"platform": got, "gbps": gbps, "elapsed_s": elapsed, "parity_ok": True}
+            {"platform": got, "gbps": gbps, "batch": batch, "parity_ok": True}
         )
     )
 
