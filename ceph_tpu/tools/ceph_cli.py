@@ -85,6 +85,39 @@ def build_cmd(words: list[str]) -> dict:
     return {"prefix": joined}
 
 
+def _run_tell(args) -> int:
+    """`ceph tell <daemon> <cmd> [k=v ...]` — route a command straight to
+    a daemon's admin socket (ceph.in's tell path; the daemon must have
+    been started with admin sockets, e.g. vstart --asok-dir)."""
+    from ..common.admin_socket import admin_command
+
+    daemon, words = args.words[1], args.words[2:]
+    with open(args.cluster_file) as f:
+        info = json.load(f)
+    socks = info.get("admin_sockets", {})
+    path = socks.get(daemon)
+    if path is None:
+        print(
+            f"no admin socket for {daemon!r} (have: {sorted(socks)})",
+            file=sys.stderr,
+        )
+        return 1
+    prefix_words = [w for w in words if "=" not in w]
+    kwargs = dict(w.split("=", 1) for w in words if "=" in w)
+    kwargs.pop("timeout", None)  # reserved: the CLI's --timeout flag wins
+    try:
+        result = admin_command(
+            path, " ".join(prefix_words), timeout=args.timeout, **kwargs
+        )
+    except Exception as e:
+        # daemon down, stale socket, unknown command, hook error — all
+        # surface as one clean line, not a traceback
+        print(f"tell {daemon} failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(result, indent=2))
+    return 0
+
+
 async def _run(args) -> int:
     monmap = load_monmap(args.cluster_file)
     client = MonClient("client.ceph-cli", monmap)
@@ -108,7 +141,14 @@ def main() -> None:
     p.add_argument("--cluster-file", default=CLUSTER_FILE)
     p.add_argument("--timeout", type=float, default=10.0)
     p.add_argument("words", nargs="+")
-    sys.exit(asyncio.run(_run(p.parse_args())))
+    args = p.parse_args()
+    if args.words[0] == "tell":
+        if len(args.words) < 3:
+            print("usage: ceph tell <daemon> <command> [k=v ...]",
+                  file=sys.stderr)
+            sys.exit(1)
+        sys.exit(_run_tell(args))
+    sys.exit(asyncio.run(_run(args)))
 
 
 if __name__ == "__main__":

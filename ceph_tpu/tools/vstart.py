@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import socket
 
 from ..common.config import Config
@@ -49,7 +50,9 @@ class DevCluster:
         with_mgr: bool = True,
         with_mds: bool = False,
         conf_overrides: dict | None = None,
+        asok_dir: str = "",  # enable daemon admin sockets under this dir
     ):
+        self.asok_dir = asok_dir
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.with_mgr = with_mgr
@@ -69,6 +72,8 @@ class DevCluster:
 
         raw = self.conf_overrides.get("ms_type", "async+posix")
         stack = self._stack = _ALIASES.get(raw, raw)
+        if self.asok_dir:
+            os.makedirs(self.asok_dir, exist_ok=True)
         if stack == "inproc":
             self.monmap = MonMap(
                 addrs={
@@ -79,7 +84,10 @@ class DevCluster:
         else:
             self.monmap = MonMap(addrs=_free_port_addrs(self.n_mons))
         self.mons = [
-            Monitor(name, self.monmap, election_timeout=0.3, stack=stack)
+            Monitor(
+                name, self.monmap, election_timeout=0.3, stack=stack,
+                admin_socket=self._asok(f"mon.{name}"),
+            )
             for name in self.monmap.addrs
         ]
         for m in self.mons:
@@ -88,7 +96,16 @@ class DevCluster:
             await m.wait_for_quorum()
         for i in range(self.n_osds):
             conf = Config(
-                {"name": f"osd.{i}", **self.conf_overrides}, env=False
+                {
+                    "name": f"osd.{i}",
+                    **(
+                        {"admin_socket": self._asok(f"osd.{i}")}
+                        if self.asok_dir
+                        else {}
+                    ),
+                    **self.conf_overrides,
+                },
+                env=False,
             )
             osd = OSD(i, self.monmap, conf=conf)
             await osd.start()
@@ -138,6 +155,10 @@ class DevCluster:
             await self.mds.start()
         return self.monmap
 
+    def _asok(self, daemon: str) -> str:
+        """Admin socket path for a daemon ('' when sockets are disabled)."""
+        return f"{self.asok_dir}/{daemon}.asok" if self.asok_dir else ""
+
     async def stop(self) -> None:
         if self.mds is not None:
             await self.mds.stop()
@@ -155,6 +176,23 @@ class DevCluster:
     def write_cluster_file(self, path: str = CLUSTER_FILE) -> None:
         """Connection info for out-of-process CLIs."""
         info = {"mon_addrs": self.monmap.addrs}
+        # `ceph tell <daemon> <cmd>` resolves admin sockets from here —
+        # recorded from what each daemon ACTUALLY bound (a conf override
+        # can point an OSD elsewhere than the asok_dir convention)
+        socks = {
+            **{
+                f"mon.{m.name}": m._admin_socket_path
+                for m in self.mons
+                if m._admin_socket_path
+            },
+            **{
+                f"osd.{o.whoami}": o.conf.get("admin_socket")
+                for o in self.osds
+                if o.conf.get("admin_socket")
+            },
+        }
+        if socks:
+            info["admin_sockets"] = socks
         if self.mds is not None:
             info["mds_addr"] = self.mds.addr
         with open(path, "w") as f:
@@ -169,7 +207,8 @@ def load_monmap(path: str = CLUSTER_FILE) -> MonMap:
 
 async def _main(args) -> None:
     cluster = DevCluster(
-        args.mons, args.osds, with_mgr=not args.no_mgr, with_mds=args.mds
+        args.mons, args.osds, with_mgr=not args.no_mgr, with_mds=args.mds,
+        asok_dir=args.asok_dir,
     )
     await cluster.start()
     cluster.write_cluster_file(args.cluster_file)
@@ -196,6 +235,8 @@ def main() -> None:
     p.add_argument("--mds", action="store_true",
                    help="boot an MDS with cephfs_metadata/cephfs_data pools")
     p.add_argument("--cluster-file", default=CLUSTER_FILE)
+    p.add_argument("--asok-dir", default="dev-asok",
+                   help="daemon admin sockets dir (ceph tell); '' disables")
     args = p.parse_args()
     try:
         asyncio.run(_main(args))
