@@ -385,11 +385,35 @@ class Monitor(Dispatcher):
         if prefix == "status":
             def handler(cmd, reply):
                 m = self.osdmon.osdmap
+                # mon-side health summary (`ceph -s` HEALTH line): down
+                # OSDs and missing quorum members are the checks the mon
+                # can see on its own; mgr modules add theirs via the
+                # dashboard's /api/health
+                checks = {}
+                # only IN osds count: a decommissioned (out) osd being
+                # down is healthy by design, as in the reference's
+                # OSD_DOWN check
+                down = [o for o, i in m.osds.items() if i.in_ and not i.up]
+                if down:
+                    checks["OSD_DOWN"] = (
+                        f"{len(down)} osds down: "
+                        + ", ".join(f"osd.{o}" for o in sorted(down))
+                    )
+                if len(self.quorum) < self.monmap.size():
+                    out = self.monmap.size() - len(self.quorum)
+                    checks["MON_DOWN"] = f"{out} monitor(s) out of quorum"
                 reply(
                     0,
                     "",
                     json.dumps(
                         {
+                            "health": {
+                                "status": (
+                                    "HEALTH_WARN" if checks else "HEALTH_OK"
+                                ),
+                                "checks": checks,
+                            },
+                            "quorum": sorted(self.quorum),
                             "osdmap_epoch": m.epoch,
                             "num_osds": len(m.osds),
                             "num_up_osds": m.num_up_osds(),

@@ -296,3 +296,34 @@ class TestMonAdminSocket:
                 await m.stop()
 
         asyncio.run(run())
+
+
+class TestStatusHealth:
+    def test_health_summary_reflects_down_osds(self):
+        """`ceph status` carries a mon-side health line: HEALTH_OK with
+        everything up, HEALTH_WARN naming down OSDs after a failure."""
+
+        async def run():
+            from test_cluster import start_cluster, stop_cluster, wait_until
+            from ceph_tpu.client import Rados
+
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            rv, _, out = await client.mon_command({"prefix": "status"})
+            assert rv == 0
+            st = json.loads(out.decode())
+            assert st["health"]["status"] == "HEALTH_OK"
+            assert st["quorum"] == [0]
+            await osds[2].stop()
+            await wait_until(
+                lambda: not mons[0].osdmon.osdmap.is_up(2), 8.0, "mark down"
+            )
+            rv, _, out = await client.mon_command({"prefix": "status"})
+            st = json.loads(out.decode())
+            assert st["health"]["status"] == "HEALTH_WARN"
+            assert "osd.2" in st["health"]["checks"]["OSD_DOWN"]
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
