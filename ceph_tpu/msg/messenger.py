@@ -345,7 +345,14 @@ class Messenger:
         self._accepted.clear()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            try:
+                # belt-and-braces bound: accept handlers are themselves
+                # time-bounded now, but a shutdown must never hang on a
+                # straggler — abandoning it is benign once close() has
+                # stopped new accepts
+                await asyncio.wait_for(self._server.wait_closed(), 15.0)
+            except asyncio.TimeoutError:
+                dout("msgr", 1, f"{self.name}: listener straggler at shutdown")
             self._server = None
         # Let cancelled read-loop tasks and closed transports unwind.
         await asyncio.sleep(0)
@@ -378,7 +385,15 @@ class Messenger:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         try:
-            frame = await read_frame(reader)
+            # Bounded hello: a peer that connects and never speaks (e.g. a
+            # daemon dying mid-teardown) must not pin this handler open —
+            # Python 3.12's Server.wait_closed() waits on every handler,
+            # so an unbounded await here deadlocks messenger shutdown.
+            try:
+                frame = await asyncio.wait_for(read_frame(reader), 10.0)
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+                writer.close()
+                return
             if frame.tag != TAG_HELLO:
                 writer.close()
                 return
