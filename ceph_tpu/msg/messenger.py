@@ -136,7 +136,10 @@ class Connection:
         writer.write(hello.pack(self.msgr.crc_data))
         await writer.drain()
         try:
-            frame = await read_frame(reader)
+            # bounded like the accept side: a peer that accepted the
+            # connection but died before replying must not wedge this
+            # connection (send_message holds the send lock meanwhile)
+            frame = await asyncio.wait_for(read_frame(reader), 10.0)
             if frame.tag != TAG_HELLO:
                 raise FrameError(f"expected hello, got tag {frame.tag}")
             self.peer_name = frame.segments[0].decode()
