@@ -256,6 +256,18 @@ class OSD(Dispatcher):
             return pg
 
         sock.register(
+            "dump_blocked_ops",
+            lambda cmd: {
+                "pgs": {
+                    repr(pg.pgid): blocked
+                    for pg in self.pgs.values()
+                    if (blocked := pg.blocked_ops_summary())
+                }
+            },
+            "ops queued behind recovery / promotion / flush, per PG "
+            "(pairs with list_unfound for stuck-op diagnosis)",
+        )
+        sock.register(
             "list_unfound",
             lambda cmd: {"unfound": _pg_for_cmd(cmd).list_unfound()},
             "missing objects with no live source (args: pool, ps)",

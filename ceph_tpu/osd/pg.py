@@ -1385,6 +1385,24 @@ class PG(PGListener):
 
         self.backend.recover_object(oid, missing_on, on_complete)
 
+    def blocked_ops_summary(self) -> dict:
+        """What's queued and why (OpTracker's dump_blocked_ops view):
+        degraded-wait, promotion-wait, and flush-wait queues by object."""
+        out = {}
+        if self.waiting_for_degraded:
+            out["waiting_for_degraded"] = {
+                oid: len(cbs) for oid, cbs in self.waiting_for_degraded.items()
+            }
+        if self._promoting:
+            out["waiting_for_promote"] = {
+                oid: len(w) for oid, w in self._promoting.items()
+            }
+        if self._flushing:
+            out["waiting_for_flush"] = {
+                oid: len(w) for oid, w in self._flushing.items()
+            }
+        return out
+
     # -- lost/unfound (PrimaryLogPG mark_all_unfound_lost; MissingLoc) ---------
 
     def list_unfound(self) -> list[str]:

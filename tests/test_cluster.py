@@ -395,6 +395,9 @@ class TestLostUnfound:
             await asyncio.sleep(0.3)
             assert not read_task.done(), "op should block on the unfound object"
 
+            # blocked-op introspection names the stuck object + queue
+            blocked = primary_pg.blocked_ops_summary()
+            assert blocked.get("waiting_for_degraded", {}).get("doomed") == 1
             lost = primary_pg.mark_unfound_lost("delete")
             assert lost == ["doomed"]
             with pytest.raises(RadosError) as ei:
