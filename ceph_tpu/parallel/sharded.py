@@ -121,6 +121,20 @@ _PLAN_EXEC_CAPACITY = 256
 _plan_execs: "OrderedDict[tuple, object]" = OrderedDict()
 
 
+def _cached_exec(key: tuple, build):
+    """One LRU for every shard_map executable: get-or-build with
+    move-to-front and bounded eviction."""
+    exe = _plan_execs.get(key)
+    if exe is not None:
+        _plan_execs.move_to_end(key)
+        return exe
+    exe = build()
+    _plan_execs[key] = exe
+    while len(_plan_execs) > _PLAN_EXEC_CAPACITY:
+        _plan_execs.popitem(last=False)
+    return exe
+
+
 def _plan_encode_executable(mesh: Mesh, plan: CodingPlan):
     """shard_map wrapper: the fused Pallas kernel on each device's tile.
 
@@ -128,22 +142,19 @@ def _plan_encode_executable(mesh: Mesh, plan: CodingPlan):
     geometry (128-aligned); CodingPlan itself falls back to the jnp matmul
     for tiles that don't, so this is total either way.
     """
-    key = (mesh, plan.sched, plan.m, plan.k, plan.interpret)
-    exe = _plan_execs.get(key)
-    if exe is not None:
-        _plan_execs.move_to_end(key)
-        return exe
     spec = _stripe_spec(mesh)
-    # check_vma=False: the body is a pallas_call, which can't declare its
-    # varying-mesh-axes; every operand/result is explicitly sharded by spec.
-    local = jax.shard_map(
-        plan, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+
+    def build():
+        # check_vma=False: the body is a pallas_call, which can't declare
+        # its varying-mesh-axes; operands/results are explicitly sharded.
+        local = jax.shard_map(
+            plan, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        )
+        return jax.jit(local)
+
+    return _cached_exec(
+        (mesh, plan.sched, plan.m, plan.k, plan.interpret), build
     )
-    exe = jax.jit(local)
-    _plan_execs[key] = exe
-    while len(_plan_execs) > _PLAN_EXEC_CAPACITY:
-        _plan_execs.popitem(last=False)
-    return exe
 
 
 def sharded_plan_encode(plan: CodingPlan, data: jax.Array, mesh: Mesh) -> jax.Array:
@@ -186,6 +197,56 @@ def _scrub_executable(mesh: Mesh, k: int):
             NamedSharding(mesh, P(_stripe_axes(mesh))),
         ),
     )
+
+
+def _plan_scrub_executable(mesh: Mesh, plan: CodingPlan, k: int):
+    # k is a real key component (the closure slices with it); it must
+    # also agree with the plan's geometry or the compiled executable
+    # would be poisoned for later correct calls
+    assert k == plan.k, (k, plan.k)
+    spec = _stripe_spec(mesh)
+
+    def local(chunks):
+        data = chunks[:, :k, :]
+        stored_parity = chunks[:, k:, :]
+        recomputed = plan(data)  # the production Pallas kernel, per tile
+        local_mismatch = jnp.any(recomputed != stored_parity, axis=(1, 2))
+        # lane shards each hold a byte-range verdict: OR across the lane
+        # axis; the total count sums across every stripe shard (the only
+        # cross-pod traffic on a DCN mesh)
+        mismatch = jax.lax.pmax(
+            local_mismatch.astype(jnp.int32), LANE_AXIS
+        ).astype(jnp.bool_)
+        # after the lane pmax every lane shard holds identical verdicts,
+        # so summing across stripe shards only (no lane sum) counts each
+        # stripe exactly once
+        count = jax.lax.psum(
+            jnp.sum(mismatch.astype(jnp.int32)), _stripe_axes(mesh)
+        )
+        return count, mismatch
+
+    def build():
+        local_sm = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=(P(), P(_stripe_axes(mesh))),
+            check_vma=False,
+        )
+        return jax.jit(local_sm)
+
+    return _cached_exec(
+        ("scrub", mesh, plan.sched, plan.m, plan.k, k, plan.interpret), build
+    )
+
+
+def plan_scrub_step(
+    plan: CodingPlan, chunks: jax.Array, k: int, mesh: Mesh
+) -> tuple[jax.Array, jax.Array]:
+    """scrub_step with the recompute running the production Pallas kernel
+    on each device's tile (shard_map) — the multi-chip scrub ships the
+    same kernel as encode_chunks."""
+    return _plan_scrub_executable(mesh, plan, k)(chunks)
 
 
 def scrub_step(

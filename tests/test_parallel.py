@@ -340,3 +340,28 @@ def test_plan_executable_cache_content_keyed():
     e1 = sharded._plan_encode_executable(mesh, p1)
     e2 = sharded._plan_encode_executable(mesh, p2)
     assert e1 is e2
+
+
+class TestPlanScrub:
+    """Multi-chip scrub with the production Pallas kernel recompute."""
+
+    @pytest.mark.parametrize("pods", [1, 2])
+    def test_plan_scrub_detects_corruption(self, pods):
+        from ceph_tpu.ops.pallas_gf import CodingPlan
+        from ceph_tpu.parallel.sharded import plan_scrub_step
+
+        k, m = 4, 2
+        mesh = make_mesh(8, pods=pods)
+        plan = CodingPlan(isa_rs_vandermonde_matrix(k, m)[k:], interpret=True)
+        data = _batch(8, k, 1024, seed=11)
+        chunks = np.concatenate([data, _host_parity(k, m, data)], axis=1)
+        placed = shard_batch(jnp.asarray(chunks), mesh)
+        count, mask = plan_scrub_step(plan, placed, k, mesh)
+        assert int(count) == 0 and not np.asarray(mask).any()
+        # corrupt one byte in a parity chunk AND one in a data chunk
+        chunks[2, k, 77] ^= 0x5A
+        chunks[6, 1, 900] ^= 0x01
+        placed = shard_batch(jnp.asarray(chunks), mesh)
+        count, mask = plan_scrub_step(plan, placed, k, mesh)
+        assert int(count) == 2
+        assert np.asarray(mask)[2] and np.asarray(mask)[6]
