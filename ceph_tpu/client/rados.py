@@ -182,6 +182,10 @@ class IoCtx:
         )
         _check(rep.result, f"setxattr {oid}:{name}")
 
+    async def rmxattr(self, oid: str, name: str) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.RMXATTR, name=name)])
+        _check(rep.result, f"rmxattr {oid}:{name}")
+
     # -- snapshots -------------------------------------------------------------
 
     async def rollback(self, oid: str, snap_id: int, snapc=None) -> None:

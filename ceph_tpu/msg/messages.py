@@ -71,6 +71,8 @@ class OSDOp(Struct):
     CACHE_FLUSH = 16  # write a dirty cache-tier object back to the base pool
     CACHE_EVICT = 17  # drop a clean object from the cache tier
     CALL = 18         # object-class method (name = "cls.method", data = input)
+    GETXATTRS = 19    # bulk-dump all client xattrs (copy-get attr leg)
+    RMXATTR = 20      # remove one client xattr (CEPH_OSD_OP_RMXATTR)
 
     FIELDS = [
         ("op", "u8"),

@@ -98,6 +98,7 @@ class _PipeWriter:
     """StreamWriter contract over a peer's _PipeReader buffer."""
 
     HIGH_WATER = 4 << 20  # drain() backpressure threshold (bytes buffered)
+    DRAIN_DEADLINE = 10.0  # max seconds stuck above high-water before fault
 
     def __init__(self, peer_reader: _PipeReader):
         self._peer = peer_reader
@@ -113,9 +114,18 @@ class _PipeWriter:
     async def drain(self) -> None:
         # Backpressure analog of TCP's: park until the peer has consumed
         # down to the high-water mark, so a fast sender can't grow the
-        # peer's buffer without bound.  The timeout bounds a peer that
-        # stops reading entirely (its read-loop death closes the pipe).
+        # peer's buffer without bound.  A peer that is alive but wedged
+        # (not reading, not faulting) must not livelock senders forever:
+        # after DRAIN_DEADLINE above high-water the connection faults,
+        # matching the 10 s bounds on the TCP handshake paths.
+        deadline = asyncio.get_event_loop().time() + self.DRAIN_DEADLINE
         while not self._closed and self._peer.pending > self.HIGH_WATER:
+            if asyncio.get_event_loop().time() >= deadline:
+                self.close()
+                raise ConnectionResetError(
+                    "in-process peer stalled above high-water for "
+                    f"{self.DRAIN_DEADLINE}s"
+                )
             self._peer.drained.clear()
             try:
                 await asyncio.wait_for(self._peer.drained.wait(), 0.1)

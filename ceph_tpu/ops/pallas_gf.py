@@ -76,9 +76,11 @@ def pick_geometry(L: int) -> tuple[int, int] | None:
         if L % cols:
             continue
         rows_total = L // cols
-        r = min(_MAX_ROWS, rows_total)
+        # scan only multiples of 4 (start rounded down, else e.g.
+        # rows_total=66 never lands on one and skips this cols entirely)
+        r = min(_MAX_ROWS, rows_total - rows_total % 4)
         while r >= 4:
-            if rows_total % r == 0 and r % 4 == 0:
+            if rows_total % r == 0:
                 return r, cols
             r -= 4
     return None

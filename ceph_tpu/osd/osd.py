@@ -462,10 +462,13 @@ class OSD(Dispatcher):
             return
         if isinstance(msg, MOSDOpReply):
             # reply to an internal op (COPY_FROM source fetch)
-            cb = self._internal_reads.pop(msg.reqid.tid, None)
-            if cb is not None:
-                data = msg.outdata[0] if msg.outdata else b""
-                cb(msg.result, data)
+            entry = self._internal_reads.pop(msg.reqid.tid, None)
+            if entry is not None:
+                cb, multi = entry
+                if multi:
+                    cb(msg.result, list(msg.outdata))
+                else:
+                    cb(msg.result, msg.outdata[0] if msg.outdata else b"")
             return
         pg = self._get_pg(msg.pgid)
         if pg is None:
@@ -591,27 +594,31 @@ class OSD(Dispatcher):
         cb,
         snap_id: int = 0,
         timeout: float = 5.0,
+        multi: bool = False,
     ) -> None:
         """One op with this OSD acting as a RADOS client toward the
         object's primary — the objecter leg of COPY_FROM and of the cache
         tier's promote/flush (PrimaryLogPG::do_copy_from / agent_work →
-        Objecter).  cb(err, data); -EAGAIN on timeout or unplaceable
-        target so the calling op retries."""
+        Objecter).  cb(err, data); with multi=True, cb(err, outdata_list)
+        receives every sub-op's outdata (the copy-get data+attrs legs).
+        -EAGAIN on timeout or unplaceable target so the calling op
+        retries."""
         from ..common.errs import EAGAIN
 
+        empty: object = [] if multi else b""
         _pool, ps = self.osdmap.object_to_pg(pool_id, oid)
         _u, _up, _a, primary = self.osdmap.pg_to_up_acting_osds(pool_id, ps)
         if primary == PG_NONE:
-            cb(-EAGAIN, b"")
+            cb(-EAGAIN, empty)
             return
         self._internal_tid += 1
         tid = self._internal_tid
-        self._internal_reads[tid] = cb
+        self._internal_reads[tid] = (cb, multi)
 
         def expire() -> None:
             stale = self._internal_reads.pop(tid, None)
             if stale is not None:
-                stale(-EAGAIN, b"")
+                stale[0](-EAGAIN, empty)
 
         asyncio.get_event_loop().call_later(timeout, expire)
         self.send_cluster(

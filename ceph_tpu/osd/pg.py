@@ -55,6 +55,7 @@ WRITE_OPS = {
     OSDOp.TRUNCATE,
     OSDOp.APPEND,
     OSDOp.SETXATTR,
+    OSDOp.RMXATTR,
     OSDOp.ROLLBACK,
     OSDOp.COPY_FROM,
 }
@@ -63,6 +64,25 @@ WRITE_OPS = {
 # writes on a writeback cache PG, cleared by flush; rides the write
 # transaction so replicas agree.
 DIRTY_ATTR = "cache_dirty"
+
+
+def encode_attrs(attrs: dict[str, bytes]) -> bytes:
+    """Wire blob for a GETXATTRS dump (the copy-get attrs map,
+    /root/reference/src/osd/PrimaryLogPG.cc do_copy_get)."""
+    from ..common.encoding import Encoder
+
+    e = Encoder()
+    e.map_(attrs, lambda enc, k: enc.string(k), lambda enc, v: enc.bytes_(v))
+    return e.tobytes()
+
+
+def decode_attrs(blob: bytes) -> dict[str, bytes]:
+    from ..common.encoding import Decoder
+
+    if not blob:
+        return {}
+    d = Decoder(blob)
+    return d.map_(lambda dec: dec.string(), lambda dec: dec.bytes_())
 
 
 def op_is_write(op: OSDOp) -> bool:
@@ -480,6 +500,8 @@ class PG(PGListener):
             elif op.op == OSDOp.SETXATTR:
                 pgt.attrs[f"_{op.name}"] = op.data
                 pgt.attrs.setdefault(WHITEOUT_ATTR, None)
+            elif op.op == OSDOp.RMXATTR:
+                pgt.attrs[f"_{op.name}"] = None  # staged removal
             elif op.op == OSDOp.ROLLBACK:
                 self._start_rollback(msg, reply, int(op.off))
                 return
@@ -640,6 +662,11 @@ class PG(PGListener):
                     result = -ENODATA
                     break
                 outdata[i] = val
+            elif op.op == OSDOp.GETXATTRS:
+                # Bulk client-xattr dump — the attrs leg of copy-get
+                # (PrimaryLogPG::do_copy_get), consumed by COPY_FROM and
+                # cache-tier promotion so metadata survives the trip.
+                outdata[i] = encode_attrs(self._client_attrs(target))
             elif op.op == OSDOp.CALL:
                 # RD-class object-class method (PrimaryLogPG do_osd_ops
                 # CALL case; WR methods classify as writes in do_op)
@@ -812,16 +839,36 @@ class PG(PGListener):
         pipeline as a full write."""
         src, src_snap = op.name, int(op.off)
 
-        def on_fetched(err: int, data: bytes) -> None:
+        def on_fetched(err: int, outs: list[bytes]) -> None:
             if err:
                 self._finish_write(
                     msg, reply, self._errored(msg, -abs(err)), remember=False
                 )
                 return
-            msg.ops[:] = [OSDOp(op=OSDOp.WRITEFULL, data=data)]
+            data = outs[0] if outs else b""
+            attrs = decode_attrs(outs[1]) if len(outs) > 1 else {}
+            # copy-get carries the attr map too (PrimaryLogPG do_copy_get):
+            # the copy REPLACES the destination — its old client xattrs
+            # go, the source's come
+            stale = set(self._client_attrs(msg.oid)) - set(attrs)
+            msg.ops[:] = (
+                [OSDOp(op=OSDOp.WRITEFULL, data=data)]
+                + [OSDOp(op=OSDOp.RMXATTR, name=k) for k in sorted(stale)]
+                + [
+                    OSDOp(op=OSDOp.SETXATTR, name=k, data=v)
+                    for k, v in sorted(attrs.items())
+                ]
+            )
             self._do_write(msg, reply)
 
-        self.osd.internal_read(self.pool.id, src, src_snap, on_fetched)
+        self.osd.internal_op(
+            self.pool.id,
+            src,
+            [OSDOp(op=OSDOp.READ), OSDOp(op=OSDOp.GETXATTRS)],
+            on_fetched,
+            snap_id=src_snap,
+            multi=True,
+        )
 
     # -- object classes (src/objclass; PrimaryLogPG CALL) ----------------------
 
@@ -872,9 +919,10 @@ class PG(PGListener):
         consumed (promotion in flight, forwarded, or rejected).
         `writing` is do_op's once-computed write classification.
 
-        Scope mirrors the reference's writeback/readonly modes with two
-        documented simplifications: promotion copies object BYTES (not
-        xattrs), and cache pools don't combine with pool snapshots.
+        Scope mirrors the reference's writeback/readonly modes with one
+        documented simplification: cache pools don't combine with pool
+        snapshots.  Promotion and flush carry client xattrs (cls state)
+        alongside bytes, as the reference's copy-get does.
         """
         first = msg.ops[0].op if msg.ops else 0
         if msg.oid in self._flushing and (
@@ -950,10 +998,20 @@ class PG(PGListener):
             return
         self._promoting[oid] = [entry]
 
-        def on_fetched(err: int, data: bytes) -> None:
-            self._tier_promoted(oid, err, data)
+        def on_fetched(err: int, outs: list[bytes]) -> None:
+            data = outs[0] if outs else b""
+            attrs = decode_attrs(outs[1]) if len(outs) > 1 else {}
+            self._tier_promoted(oid, err, data, attrs)
 
-        self.osd.internal_read(self.pool.tier_of, oid, 0, on_fetched)
+        # copy-get: data + the client-xattr map in one fetch, so cls
+        # state (locks, versions, refcounts) survives promotion
+        self.osd.internal_op(
+            self.pool.tier_of,
+            oid,
+            [OSDOp(op=OSDOp.READ), OSDOp(op=OSDOp.GETXATTRS)],
+            on_fetched,
+            multi=True,
+        )
 
     def _tier_drain(self, oid: str) -> None:
         """Re-dispatch ops queued behind a promotion; each gets a one-shot
@@ -966,7 +1024,9 @@ class PG(PGListener):
             finally:
                 self._tier_pass.discard(k)
 
-    def _tier_promoted(self, oid: str, err: int, data: bytes) -> None:
+    def _tier_promoted(
+        self, oid: str, err: int, data: bytes, attrs: dict[str, bytes] | None = None
+    ) -> None:
         if err == -ENOENT:
             # Base has nothing: reads answer ENOENT, writes create fresh.
             self._tier_drain(oid)
@@ -976,13 +1036,19 @@ class PG(PGListener):
                 r(self._errored(m, -EAGAIN if err == -EAGAIN else err))
             return
         # Write the promoted copy through the replicated pipeline as an
-        # internal (clean, non-dirty) object, then release the waiters.
+        # internal (clean, non-dirty) object — bytes AND client xattrs,
+        # so flush→evict→promote round-trips cls state — then release
+        # the waiters.
         self._tier_tid += 1
         pm = MOSDOp(
             reqid=ReqId(client=f"osd.{self.osd.whoami}.promote", tid=self._tier_tid),
             pgid=PgId(self.pool.id, self.pgid.ps, -1),
             oid=oid,
-            ops=[OSDOp(op=OSDOp.WRITEFULL, data=data)],
+            ops=[OSDOp(op=OSDOp.WRITEFULL, data=data)]
+            + [
+                OSDOp(op=OSDOp.SETXATTR, name=k, data=v)
+                for k, v in sorted((attrs or {}).items())
+            ],
             epoch=self._epoch,
         )
 
@@ -1032,11 +1098,14 @@ class PG(PGListener):
         self._tier_writeback(oid, done)
 
     def _tier_writeback(self, oid: str, done) -> None:
-        """The write-back leg of a flush: copy bytes to the base pool, then
-        clear the dirty marker.  Writers on `oid` queue in _flushing."""
+        """The write-back leg of a flush: copy bytes AND client xattrs
+        (cls locks/versions/refcounts included — the reference's copy-get
+        carries the attr map) to the base pool, then clear the dirty
+        marker.  Writers on `oid` queue in _flushing."""
         self._flushing[oid] = []
         coll = shard_coll(self.pgid, -1)
         data = self.osd.store.read(coll, oid, 0, self._object_size(oid))
+        attrs = self._client_attrs(oid)
 
         def finish(err: int) -> None:
             waiters = self._flushing.pop(oid, [])
@@ -1058,7 +1127,13 @@ class PG(PGListener):
             )
 
         self.osd.internal_op(
-            self.pool.tier_of, oid, [OSDOp(op=OSDOp.WRITEFULL, data=bytes(data))],
+            self.pool.tier_of,
+            oid,
+            [OSDOp(op=OSDOp.WRITEFULL, data=bytes(data))]
+            + [
+                OSDOp(op=OSDOp.SETXATTR, name=k, data=v)
+                for k, v in sorted(attrs.items())
+            ],
             on_ack,
         )
 
@@ -1078,14 +1153,27 @@ class PG(PGListener):
         if self._is_dirty(oid):
             done(-EBUSY)
             return
+        if oid in self._flushing:
+            done(-EBUSY)  # a flush (or another evict) holds the object
+            return
+        # Block writes on the oid for the whole evict (the reference's
+        # object-context write lock): a write acked while the base STAT
+        # is in flight must not be deleted out from under the client.
+        self._flushing[oid] = []
+
+        def finish(err: int) -> None:
+            waiters = self._flushing.pop(oid, [])
+            done(err)
+            for m, r, c in waiters:
+                self.do_op(m, r, c)
 
         def on_base_stat(err: int, _data: bytes) -> None:
             if err:
                 # base copy unverifiable (absent or unreachable): refuse
-                done(-EBUSY)
+                finish(-EBUSY)
                 return
             if self._is_dirty(oid):  # re-dirtied while we checked
-                done(-EBUSY)
+                finish(-EBUSY)
                 return
             pgt = PGTransaction(oid=oid, delete=True)
             self._tier_tid += 1
@@ -1093,7 +1181,7 @@ class PG(PGListener):
             self.backend.submit_transaction(
                 pgt,
                 ReqId(client=f"osd.{self.osd.whoami}.evict", tid=self._tier_tid),
-                lambda: done(0),
+                lambda: finish(0),
             )
 
         self.osd.internal_op(
@@ -1348,6 +1436,17 @@ class PG(PGListener):
             return self.osd.store.getattr(coll, oid, name)
         except Exception:
             return None
+
+    def _client_attrs(self, oid: str) -> dict[str, bytes]:
+        """All client-visible xattrs (the `_`-prefixed store attrs: plain
+        SETXATTRs plus object-class state — cls_lock holders, cls_version,
+        refcounts), keyed by their client names."""
+        coll = shard_coll(self.pgid, self.whoami_shard())
+        try:
+            raw = self.osd.store.getattrs(coll, oid)
+        except Exception:
+            return {}
+        return {k[1:]: v for k, v in raw.items() if k.startswith("_")}
 
     # -- recovery driver -------------------------------------------------------
 

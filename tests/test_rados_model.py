@@ -149,6 +149,9 @@ async def _apply_random_op(rng, io, client, model: Model, oids, pool):
         model.pre_write_clone(o)
         await io.copy_from(oid, src, snapc=snapc)
         o.head = s.head
+        # the copy replaces the destination wholesale: client xattrs
+        # come from the source (do_copy_get carries the attr map)
+        o.xattrs = dict(s.xattrs)
     elif op == "setxattr":
         if o.head is None:
             return  # xattr on missing object would create it

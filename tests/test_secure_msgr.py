@@ -35,7 +35,11 @@ class TestOnWireSession:
         a, b = self._pair()
         for payload in (b"x", b"frame bytes " * 100):
             rec = a.wrap(payload)
-            assert payload not in rec  # actually encrypted
+            if len(payload) >= 8:
+                # A short payload (1 byte) can appear in random ciphertext by
+                # chance (~10%/run for 1 byte in ~29 random bytes); only the
+                # long payload is a meaningful non-containment probe.
+                assert payload not in rec  # actually encrypted
             body = rec[8:]
             assert b.unwrap(body) == payload
         empty = a.wrap(b"")  # zero-length frames still authenticate

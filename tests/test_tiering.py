@@ -212,6 +212,63 @@ class TestWriteback:
 
         asyncio.run(run())
 
+    def test_xattrs_and_cls_state_survive_flush_evict_promote(self):
+        """Client xattrs AND object-class state (cls_lock holders — what
+        RBD exclusive locking keys on) must ride writeback and promotion;
+        a flush+evict cycle must not destroy acknowledged metadata."""
+
+        async def run():
+            monmap, mons, osds, client = await _tiered_cluster()
+            base_io = await client.open_ioctx("base")
+            hot_io = await client.open_ioctx("hot")
+            await base_io.write_full("meta", b"payload")
+            await base_io.setxattr("meta", "user.tag", b"v1")
+            # cls state: take an exclusive lock (stored as a cls xattr)
+            import json
+
+            await base_io.exec(
+                "meta",
+                "lock",
+                "lock",
+                json.dumps(
+                    {"name": "l1", "type": "exclusive", "cookie": "c1"}
+                ).encode(),
+            )
+            # flush + evict: only the base copy remains
+            await hot_io.cache_flush("meta")
+            await hot_io.cache_evict("meta")
+            assert "meta" not in await hot_io.list_objects()
+            # promote on miss: bytes AND metadata must come back
+            assert await base_io.read("meta") == b"payload"
+            assert await base_io.getxattr("meta", "user.tag") == b"v1"
+            info = json.loads(
+                await base_io.exec(
+                    "meta", "lock", "get_info", json.dumps({"name": "l1"}).encode()
+                )
+            )
+            assert info["holders"], "cls_lock state lost across flush/evict/promote"
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_copy_from_carries_xattrs(self):
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("p", "replicated", pg_num=4)
+            io = await client.open_ioctx("p")
+            await io.write_full("src", b"bytes")
+            await io.setxattr("src", "color", b"blue")
+            await io.copy_from("dst", "src")
+            assert await io.read("dst") == b"bytes"
+            assert await io.getxattr("dst", "color") == b"blue"
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
     def test_readonly_mode_rejects_writes(self):
         async def run():
             monmap, mons, osds, client = await _tiered_cluster(
