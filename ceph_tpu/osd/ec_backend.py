@@ -27,6 +27,7 @@ asyncio messenger or an in-process test harness.
 
 from __future__ import annotations
 
+import asyncio
 import json
 from dataclasses import dataclass, field
 from typing import Callable, Mapping
@@ -61,8 +62,9 @@ from .ec_transaction import (
     PGTransaction,
     WritePlan,
     _merge_ranges,
-    generate_transactions,
+    finish_transactions,
     get_write_plan,
+    launch_encode,
 )
 from .pg_log import Eversion, LogEntry, LOG_DELETE, LOG_MODIFY
 
@@ -83,6 +85,10 @@ class Op:
     pending_commits: set[int] = field(default_factory=set)  # shard ids
     pin: object | None = None
     encoded: bool = False
+    # LAUNCHED device encode awaiting dispatch (EncodeStage); the encode
+    # pipeline reaps these FIFO so sub-writes fan out in tid order
+    encode_stage: object | None = None
+    drain_polls: int = 0
     # ec:write span (ECBackend::Op::trace); null span unless a tracer is on
     trace: object = field(default_factory=lambda: null_span())
 
@@ -164,6 +170,12 @@ class ECBackend(PGBackend):
         # unstable_hashinfo_registry + projected object contexts): later ops
         # submitted before earlier ones commit must see pending size/hinfo.
         self._projected: dict[str, dict] = {}  # oid -> {size, hinfo, refs}
+        # Encode pipeline: ops whose device encode is LAUNCHED but whose
+        # sub-writes have not fanned out yet.  Reaped strictly FIFO so
+        # log entries reach replicas in version order; bounded by
+        # encode_depth (the AIO queue-depth analog).
+        self._encode_pipe: list[Op] = []
+        self.encode_depth = 8
 
     # -- helpers -------------------------------------------------------------
 
@@ -375,37 +387,101 @@ class ECBackend(PGBackend):
         self.objects_read_and_reconstruct(need, _on_read, parent_span=op.trace)
 
     def _encode_and_dispatch(self, op: Op) -> None:
-        """try_reads_to_commit (ECBackend.cc:1982): encode, pin, fan out."""
+        """try_reads_to_commit (ECBackend.cc:1982): LAUNCH the device
+        encode, pin the merged bytes, and queue the op on the encode
+        pipeline.  The launch returns while the chip works; sub-writes fan
+        out when the pipeline reaps the op (FIFO), so the next op's RMW
+        reads overlap this op's device encode — the overlap the reference
+        gets from queued AIO in front of ec_encode_data."""
+        op.encode_stage = launch_encode(
+            op.pgt,
+            op.plan,
+            self.sinfo,
+            self.ec,
+            op.obj_size,
+            op.read_results,
+        )
+        op.encoded = True
+        op.trace.event("encode launched")
+        # Pin exactly the bytes that were encoded (host-side, available at
+        # launch) so overlapping writes pipeline (ExtentCache
+        # reserve_extents_for_rmw): a later same-object op's RMW reads see
+        # THESE bytes, not the not-yet-applied shard stores.
+        pin = self.extent_cache.prepare_pin()
+        for off, buf in op.encode_stage.merged.items():
+            self.extent_cache.pin_extent(pin, op.pgt.oid, off, buf)
+        op.pin = pin
+        self._encode_pipe.append(op)
+        # Backpressure: past the queue depth, reap the head now (blocking).
+        while len(self._encode_pipe) > self.encode_depth:
+            self._dispatch_encoded(self._encode_pipe.pop(0))
+        self._schedule_drain()
+        # Unblock same-object writers that were waiting on our encode; their
+        # RMW inputs come from the pin.
+        self._kick_waiting_reads()
+
+    def _schedule_drain(self) -> None:
+        """Reap finished encodes from a running event loop; without one
+        (synchronous harnesses) the caller drains via flush_encodes()."""
+        if not self._encode_pipe:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        loop.call_soon(self._drain_encode_pipe)
+
+    def _drain_encode_pipe(self) -> None:
+        """Dispatch every op whose launch finished, strictly FIFO.  A head
+        still computing is re-polled a few times, then reaped blocking —
+        bounded staleness beats an unbounded poll loop."""
+        while self._encode_pipe:
+            op = self._encode_pipe[0]
+            if not op.encode_stage.ready() and op.drain_polls < 50:
+                op.drain_polls += 1
+                try:
+                    asyncio.get_running_loop().call_later(
+                        0.002, self._drain_encode_pipe
+                    )
+                except RuntimeError:
+                    pass
+                return
+            self._dispatch_encoded(self._encode_pipe.pop(0))
+
+    def flush_encodes(self) -> None:
+        """Drain the whole encode pipeline (the barrier before commit
+        checks in synchronous harnesses; EncodePipeline.flush analog)."""
+        while self._encode_pipe:
+            self._dispatch_encoded(self._encode_pipe.pop(0))
+
+    def _dispatch_encoded(self, op: Op) -> None:
+        """Reap one launched encode and fan out its sub-writes
+        (the completion half of try_reads_to_commit)."""
         proj = self._projected.get(op.pgt.oid)
-        # hinfo resolves at encode time: the projected (pending) chain if an
-        # earlier op already produced one, else the on-disk xattr.  None is
-        # ambiguous in proj["hinfo"], hence the separate known flag.
+        # hinfo resolves at completion time, in tid order: the projected
+        # (pending) chain if an earlier op already produced one, else the
+        # on-disk xattr.  None is ambiguous in proj["hinfo"], hence the
+        # separate known flag.
         if proj is not None and proj["hinfo_known"]:
             hinfo = proj["hinfo"]
         else:
             hinfo = self.get_hash_info(op.pgt.oid)
-        txns, new_hinfo, merged = generate_transactions(
+        txns, new_hinfo, merged = finish_transactions(
+            op.encode_stage,
             op.pgt,
             op.plan,
             self.sinfo,
             self.ec,
             self._shard_colls(),
             op.obj_size,
-            op.read_results,
             hinfo,
             op.version.version,
         )
-        op.encoded = True
+        op.encode_stage = None
         op.trace.event("encoded")
         if proj is not None:
             proj["hinfo"] = new_hinfo
             proj["hinfo_known"] = True
-        # Pin exactly the bytes that were encoded so overlapping writes
-        # pipeline (ExtentCache reserve_extents_for_rmw).
-        pin = self.extent_cache.prepare_pin()
-        for off, buf in merged.items():
-            self.extent_cache.pin_extent(pin, op.pgt.oid, off, buf)
-        op.pin = pin
 
         entry = LogEntry(
             op=LOG_DELETE if op.pgt.delete else LOG_MODIFY,

@@ -162,45 +162,36 @@ def get_write_plan(
     return plan
 
 
-def generate_transactions(
+@dataclass
+class EncodeStage:
+    """A write's LAUNCHED encode: merged logical bytes (host-side, ready at
+    launch — what the extent cache pins) plus one PendingEncode per
+    contiguous region whose device work may still be in flight.  The
+    launch/finish split is the AIO hand-off of the reference's RMW
+    pipeline (ECBackend.h:536-555): the next op's reads overlap this op's
+    device encode."""
+
+    merged: dict[int, bytearray]
+    pending: dict[int, "stripe_mod.PendingEncode"]
+
+    def ready(self) -> bool:
+        return all(p.ready() for p in self.pending.values())
+
+
+def launch_encode(
     pgt: PGTransaction,
     plan: WritePlan,
     sinfo: StripeInfo,
     ec: ErasureCodeInterface,
-    shard_colls: dict[int, str],
     obj_size: int,
     read_data: dict[int, bytes],
-    hinfo: HashInfo | None,
-    version: int,
-) -> tuple[dict[int, Transaction], HashInfo | None, dict[int, bytes]]:
-    """Build one Transaction per shard (ECTransaction::generate_transactions,
-    ECTransaction.cc:109).  `read_data` maps stripe-aligned offsets from
-    plan.to_read to their current logical bytes (RMW input).
-
-    Returns (shard -> Transaction, updated hinfo or None when dropped,
-    merged logical bytes per will_write range — what the extent cache pins
-    so overlapping writes see exactly what was encoded)."""
-    n = ec.get_chunk_count()
-    txns = {s: Transaction() for s in range(n)}
-    sw = sinfo.stripe_width
-
-    if pgt.pre_clone is not None:
-        # Clone each shard's pre-write state (data + attrs incl. hinfo)
-        # in the same transaction as the write — the EC shape of
-        # make_writeable's clone (per-shard objects clone per-shard).
-        for s, txn in txns.items():
-            txn.clone(shard_colls[s], pgt.oid, pgt.pre_clone)
-    for extra in pgt.also_delete:
-        for s, txn in txns.items():
-            txn.remove(shard_colls[s], extra)
-
-    if pgt.delete:
-        for s, txn in txns.items():
-            txn.remove(shard_colls[s], pgt.oid)
-        return txns, None, {}
-
-    # Assemble the new bytes for every will_write range.
+) -> EncodeStage:
+    """Merge RMW inputs with the new bytes and LAUNCH the device encodes
+    (one batched launch per contiguous region) without materializing
+    parity — phase one of generate_transactions."""
     merged: dict[int, bytearray] = {}
+    if pgt.delete:
+        return EncodeStage(merged=merged, pending={})
     for off, ln in plan.will_write:
         buf = bytearray(ln)
         # old bytes (RMW) first
@@ -220,15 +211,54 @@ def generate_transactions(
         for off, buf in merged.items():
             if off <= t < off + len(buf):
                 buf[t - off :] = b"\x00" * (off + len(buf) - t)
+    pending = {
+        off: stripe_mod.encode_launch(sinfo, ec, bytes(merged[off]))
+        for off in sorted(merged)
+    }
+    return EncodeStage(merged=merged, pending=pending)
 
+
+def finish_transactions(
+    stage: EncodeStage,
+    pgt: PGTransaction,
+    plan: WritePlan,
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    shard_colls: dict[int, str],
+    obj_size: int,
+    hinfo: HashInfo | None,
+    version: int,
+) -> tuple[dict[int, Transaction], HashInfo | None, dict[int, bytes]]:
+    """Phase two: materialize the launched encodes (blocking only until
+    THIS op's launches finish) and build the per-shard Transactions +
+    hinfo chain.  Must run in submit (tid) order per object — the hinfo
+    chain consumes the materialized parity bytes."""
+    n = ec.get_chunk_count()
+    txns = {s: Transaction() for s in range(n)}
+
+    if pgt.pre_clone is not None:
+        # Clone each shard's pre-write state (data + attrs incl. hinfo)
+        # in the same transaction as the write — the EC shape of
+        # make_writeable's clone (per-shard objects clone per-shard).
+        for s, txn in txns.items():
+            txn.clone(shard_colls[s], pgt.oid, pgt.pre_clone)
+    for extra in pgt.also_delete:
+        for s, txn in txns.items():
+            txn.remove(shard_colls[s], extra)
+
+    if pgt.delete:
+        for s, txn in txns.items():
+            txn.remove(shard_colls[s], pgt.oid)
+        return txns, None, {}
+
+    merged = stage.merged
     old_padded = sinfo.logical_to_next_stripe_offset(obj_size)
 
-    # Encode each contiguous region in ONE batched launch and emit per-shard
-    # chunk writes at the mapped chunk offset (ECTransaction.cc:74-93).
+    # Emit per-shard chunk writes at the mapped chunk offset
+    # (ECTransaction.cc:74-93), reaping each region's launch.
     region_appends: dict[int, dict[int, bytes]] = {}
     for off in sorted(merged):
-        buf = merged[off]
-        shards = stripe_mod.encode(sinfo, ec, bytes(buf))
+        shards = stage.pending[off].result()
         chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off)
         region_appends[off] = {}
         for s in range(n):
@@ -271,3 +301,28 @@ def generate_transactions(
             else:
                 txn.setattr(shard_colls[s], pgt.oid, name, val)
     return txns, new_hinfo, {off: bytes(buf) for off, buf in merged.items()}
+
+
+def generate_transactions(
+    pgt: PGTransaction,
+    plan: WritePlan,
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    shard_colls: dict[int, str],
+    obj_size: int,
+    read_data: dict[int, bytes],
+    hinfo: HashInfo | None,
+    version: int,
+) -> tuple[dict[int, Transaction], HashInfo | None, dict[int, bytes]]:
+    """Build one Transaction per shard (ECTransaction::generate_transactions,
+    ECTransaction.cc:109) — the synchronous launch+finish composition.
+    `read_data` maps stripe-aligned offsets from plan.to_read to their
+    current logical bytes (RMW input).
+
+    Returns (shard -> Transaction, updated hinfo or None when dropped,
+    merged logical bytes per will_write range — what the extent cache pins
+    so overlapping writes see exactly what was encoded)."""
+    stage = launch_encode(pgt, plan, sinfo, ec, obj_size, read_data)
+    return finish_transactions(
+        stage, pgt, plan, sinfo, ec, shard_colls, obj_size, hinfo, version
+    )

@@ -142,6 +142,10 @@ class PGBackend(abc.ABC):
     ) -> None:
         ...
 
+    def flush_encodes(self) -> None:
+        """Drain any launched-but-undispatched device encodes (EC encode
+        pipeline); a no-op for backends without one."""
+
     def _apply_pushes(self, coll: str, pushes: list[PushOp]) -> list[str]:
         """Write pushed objects + attrs locally (shared by EC shard pushes
         and replicated whole-object pushes); returns the recovered oids."""

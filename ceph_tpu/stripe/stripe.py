@@ -71,6 +71,78 @@ class StripeInfo:
         return stripe, chunk, off
 
 
+class PendingEncode:
+    """A LAUNCHED stripe encode whose device work may still be running.
+
+    On the matrix fast path the parity is a live device array (JAX dispatch
+    is asynchronous — the launch returned while the chip works); `ready()`
+    polls completion without blocking and `result()` materializes the
+    per-shard chunk dict, blocking only until this launch finishes.  This
+    is the device-side half of the AIO-style encode pipeline the reference
+    gets from queued librados AIO in front of `ec_encode_data`
+    (ECBackend.h:536-555 pipeline invariants)."""
+
+    def __init__(self, shaped: np.ndarray, parity, k: int, m: int, want: set[int]):
+        self._shaped = shaped
+        self._parity = parity  # device array (fast path) or host ndarray
+        self._k, self._m = k, m
+        self._want = want
+        self._result: dict[int, np.ndarray] | None = None
+
+    def ready(self) -> bool:
+        if self._result is not None:
+            return True
+        is_ready = getattr(self._parity, "is_ready", None)
+        return True if is_ready is None else bool(is_ready())
+
+    def result(self) -> dict[int, np.ndarray]:
+        if self._result is None:
+            parity = np.asarray(self._parity)  # blocks until launch done
+            out: dict[int, np.ndarray] = {}
+            for i in range(self._k):
+                out[i] = np.ascontiguousarray(self._shaped[:, i, :]).reshape(-1)
+            for i in range(self._m):
+                out[self._k + i] = np.ascontiguousarray(parity[:, i, :]).reshape(-1)
+            self._result = {i: out[i] for i in self._want}
+            self._parity = self._shaped = None
+        return self._result
+
+
+def encode_launch(
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    data: bytes | np.ndarray,
+    want: set[int] | None = None,
+) -> PendingEncode:
+    """Launch a batched stripe encode WITHOUT materializing the parity.
+
+    Matrix codecs dispatch one device launch and return immediately with a
+    live handle; layered/array codecs (lrc, clay) compute eagerly (their
+    chunk-level interfaces materialize internally) and the PendingEncode is
+    born ready."""
+    raw = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8).ravel()
+    if raw.size % sinfo.stripe_width:
+        raise EcError(EINVAL, f"length {raw.size} not stripe aligned")
+    k = ec.get_data_chunk_count()
+    n = ec.get_chunk_count()
+    m = n - k
+    assert k == sinfo.k
+    stripes = raw.size // sinfo.stripe_width
+    shaped = raw.reshape(stripes, k, sinfo.chunk_size)
+    if want is None:
+        want = set(range(n))
+    if _matrix_fast_path(ec) and m > 0:
+        return PendingEncode(shaped, ec.encode_array(shaped), k, m, want)
+    shards = [np.empty((stripes, sinfo.chunk_size), dtype=np.uint8) for _ in range(n)]
+    for s in range(stripes):
+        chunks = ec.encode(set(range(n)), shaped[s].reshape(-1))
+        for i in range(n):
+            shards[i][s] = chunks[i]
+    pend = PendingEncode(shaped, None, 0, 0, want)
+    pend._result = {i: shards[i].reshape(-1) for i in want}
+    return pend
+
+
 def encode(
     sinfo: StripeInfo,
     ec: ErasureCodeInterface,
@@ -85,33 +157,7 @@ def encode(
     per-stripe encode_chunks, still one python loop over stripes but device
     work batched inside each codec.
     """
-    raw = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8).ravel()
-    if raw.size % sinfo.stripe_width:
-        raise EcError(EINVAL, f"length {raw.size} not stripe aligned")
-    k = ec.get_data_chunk_count()
-    n = ec.get_chunk_count()
-    m = n - k
-    assert k == sinfo.k
-    stripes = raw.size // sinfo.stripe_width
-    shaped = raw.reshape(stripes, k, sinfo.chunk_size)
-    if want is None:
-        want = set(range(n))
-    out: dict[int, np.ndarray] = {}
-    if _matrix_fast_path(ec) and m > 0:
-        parity = np.asarray(ec.encode_array(shaped))  # one launch
-        for i in range(k):
-            out[i] = np.ascontiguousarray(shaped[:, i, :]).reshape(-1)
-        for i in range(m):
-            out[k + i] = np.ascontiguousarray(parity[:, i, :]).reshape(-1)
-    else:
-        shards = [np.empty((stripes, sinfo.chunk_size), dtype=np.uint8) for _ in range(n)]
-        for s in range(stripes):
-            chunks = ec.encode(set(range(n)), shaped[s].reshape(-1))
-            for i in range(n):
-                shards[i][s] = chunks[i]
-        for i in range(n):
-            out[i] = shards[i].reshape(-1)
-    return {i: out[i] for i in want}
+    return encode_launch(sinfo, ec, data, want).result()
 
 
 def decode_concat(
