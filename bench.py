@@ -93,9 +93,14 @@ def run_child(platform: str) -> None:
     # Deep batching is the codec's design point: launch overhead through
     # the axon tunnel is ~2-3 ms regardless of size, so 64 MiB launches
     # cap at ~21 GB/s while 256 MiB launches run at the kernel's ~53 GB/s
-    # bandwidth-bound rate.  The launch depth is TUNED below (a short
-    # probe per candidate) and the best one measured fully.
-    batch_candidates = (256, 512) if on_tpu else (2,)
+    # bandwidth-bound rate.  256 MiB is the measured sweet spot AND the
+    # safe ceiling: 512 MiB chained launches are what wedged the tunnel in
+    # round 4 (benchmarks/diag/ONCHIP_NOTES_r4.md), and a single candidate
+    # saves one ~30 s remote compile inside the driver's child deadline.
+    env_batch = os.environ.get("BENCH_TPU_BATCH")
+    batch_candidates = (
+        (int(env_batch),) if env_batch else (256,)
+    ) if on_tpu else (2,)
     iters = 40 if on_tpu else 3
 
     # The SHIPPING path: the registered `tpu` plugin's device encode — the

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-5 TPU recovery runner.  The axon tunnel has been wedged since
+# round 4 (~05:15 UTC; /tmp/tpu_probe.log has 147+ failed probes).  This
+# loop probes gently (one small client every 3 min) and, the moment the
+# tunnel answers, produces every TPU artifact of the round in order of
+# value:
+#   1. bench.py                    -> /tmp/bench_tpu_r5.json (headline GB/s)
+#   2. five-config BASELINE sweep  -> benchmarks/BASELINE_SWEEP_tpu_r5.jsonl
+#   3. on-chip correctness tier    -> /tmp/onchip_tier_r5.log (pytest tests_tpu)
+# Probe rc is checked DIRECTLY on the timeout command (the round-5 probe
+# bug: `rc=$?` after a pipeline reads tail's status, always 0).
+cd /root/repo || exit 1
+LOG=/tmp/tpu_autorun_r5.log
+for i in $(seq 1 400); do
+  if timeout 120 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; assert int(jnp.arange(4).sum())==6" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) TPU RECOVERED (try $i)" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) bench.py" >> "$LOG"
+    timeout 900 python bench.py > /tmp/bench_tpu_r5.json 2>/tmp/bench_tpu_r5.log
+    echo "$(date -u +%H:%M:%S) bench rc=$? $(cat /tmp/bench_tpu_r5.json)" >> "$LOG"
+    if grep -q '"platform": "tpu"' /tmp/bench_tpu_r5.json 2>/dev/null; then
+      cp /tmp/bench_tpu_r5.json benchmarks/diag/BENCH_tpu_r5_auto.json
+    fi
+    echo "$(date -u +%H:%M:%S) baseline sweep" >> "$LOG"
+    rm -f benchmarks/BASELINE_SWEEP_tpu_r5.jsonl
+    timeout 2400 python -m ceph_tpu.tools.bench_sweep --baseline --iterations 8 \
+      --out benchmarks/BASELINE_SWEEP_tpu_r5.jsonl > /tmp/sweep_tpu_r5.log 2>&1
+    echo "$(date -u +%H:%M:%S) sweep rc=$? lines=$(wc -l < benchmarks/BASELINE_SWEEP_tpu_r5.jsonl 2>/dev/null)" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) on-chip tier" >> "$LOG"
+    ONCHIP=1 timeout 1800 python -m pytest tests_tpu/ -v > /tmp/onchip_tier_r5.log 2>&1
+    echo "$(date -u +%H:%M:%S) tier rc=$? $(tail -1 /tmp/onchip_tier_r5.log)" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) ALL DONE" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) try $i: still wedged" >> "$LOG"
+  sleep 180
+done

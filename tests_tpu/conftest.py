@@ -1,0 +1,38 @@
+"""On-TPU correctness tier harness (VERDICT r4 item 3).
+
+Unlike tests/conftest.py (which forces a virtual CPU mesh and pops the
+axon gate variable), this tier runs against the REAL chip: the Pallas
+SWAR kernel non-interpret for every tile geometry, decode matrices from
+the signature LRU, CLAY coupling transforms, and a 1-device shard_map of
+the production sharded entry point — bytes compared against the host GF
+oracle (the exhaustive-erasure gtest pattern,
+/root/reference/src/test/erasure-code/TestErasureCodeIsa.cc:51-90).
+
+Gating: the whole tier SKIPS unless ONCHIP=1 is exported, because merely
+importing jax with the axon gate variable set hangs every process while
+the tunnel is wedged.  The recovery runner (benchmarks/diag/
+tpu_autorun_r5.sh) sets ONCHIP=1 once the tunnel answers a probe.
+"""
+
+import os
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("ONCHIP") == "1":
+        return
+    skip = pytest.mark.skip(reason="ONCHIP!=1: no verified TPU tunnel")
+    for item in items:
+        item.add_marker(skip)
+
+
+@pytest.fixture(scope="session")
+def tpu():
+    """The real TPU device, or skip when the backend resolves elsewhere."""
+    import jax
+
+    devs = jax.devices()
+    if devs[0].platform != "tpu":
+        pytest.skip(f"backend is {devs[0].platform}, not tpu")
+    return devs[0]

@@ -1,0 +1,111 @@
+"""On-chip byte-parity tier: the production kernels vs the host GF oracle.
+
+Round-4 verdict weak item 5: the only hardware byte check was bench.py's
+preflight on the RS(8,3) encode geometry; decode-matrix kernels, the
+smaller tile geometries, CLAY/LRC paths, and the sharded entry point had
+never run on a real chip.  Each test here is deliberately tiny (a few
+stripes) — the cost is one remote compile per kernel shape, not data.
+
+Reference pattern: the exhaustive-erasure loop of
+/root/reference/src/test/erasure-code/TestErasureCodeIsa.cc:51-90 (encode,
+erase every combination, decode, byte-compare).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codec.registry import instance
+from ceph_tpu.gf import gf_matmul, isa_rs_vandermonde_matrix
+from ceph_tpu.ops.pallas_gf import pick_geometry
+
+RNG = np.random.default_rng(0xC3F)
+
+
+def _oracle_parity(ec, data):
+    """Host-side GF parity for a (S, k, L) batch via the codec's matrix."""
+    mat = np.asarray(ec.distribution_matrix())[ec.k :]
+    return np.stack([gf_matmul(mat, data[s]) for s in range(data.shape[0])])
+
+
+@pytest.mark.parametrize(
+    "L,geom",
+    [
+        (128 * 1024, (128, 256)),  # full-size lane tiles (the bench shape)
+        (512, (4, 128)),
+        (256, (4, 64)),
+        (128, (4, 32)),
+    ],
+)
+def test_swar_encode_every_geometry(tpu, L, geom):
+    """The SWAR kernel non-interpret at every tile geometry in
+    pallas_gf pick_geometry (cols 256/128/64/32)."""
+    assert pick_geometry(L) == geom
+    k, m = 8, 3
+    ec = instance().factory("tpu", {"k": str(k), "m": str(m)})
+    data = RNG.integers(0, 256, (2, k, L), dtype=np.uint8)
+    got = np.asarray(ec.encode_array(data))
+    assert np.array_equal(got, _oracle_parity(ec, data))
+
+
+def test_decode_matrices_from_lru(tpu):
+    """Decode-matrix kernels (signature-keyed LRU) on-chip for every
+    single- and double-erasure pattern class of RS(8,3)."""
+    k, m = 8, 3
+    ec = instance().factory("tpu", {"k": str(k), "m": str(m)})
+    L = 512
+    data = RNG.integers(0, 256, (2, k, L), dtype=np.uint8)
+    parity = _oracle_parity(ec, data)
+    full = np.concatenate([data, parity], axis=1)
+    # data-only, parity-only, and mixed erasures (distinct decode matrices)
+    for erasures in ([0], [9], [0, 1], [0, 9], [9, 10], [0, 5, 10]):
+        idx = ec.decode_index(erasures)
+        rebuilt = np.asarray(ec.decode_array(erasures, full[:, idx, :]))
+        assert np.array_equal(rebuilt, full[:, erasures, :]), erasures
+
+
+def test_clay_subchunk_repair(tpu):
+    """CLAY coupling transforms on-chip: single-shard repair reads q^t
+    sub-chunks and reconstructs bit-exactly."""
+    ec = instance().factory("clay", {"k": "4", "m": "2"})
+    size = ec.get_chunk_size(4 * 8192) * 4
+    data = RNG.integers(0, 256, size, dtype=np.uint8).tobytes()
+    chunks = ec.encode(set(range(6)), data)
+    lost = 2
+    have = {i: v for i, v in chunks.items() if i != lost}
+    out = ec.decode({lost}, have, chunk_size=len(chunks[lost]))
+    assert np.array_equal(
+        np.asarray(out[lost]), np.asarray(chunks[lost])
+    )
+
+
+def test_lrc_local_repair(tpu):
+    """LRC layered decode on-chip: a single failure repairs from its
+    locality group."""
+    ec = instance().factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = ec.get_chunk_count()
+    size = ec.get_chunk_size(4 * 4096) * 4
+    data = RNG.integers(0, 256, size, dtype=np.uint8).tobytes()
+    chunks = ec.encode(set(range(n)), data)
+    have = {i: v for i, v in chunks.items() if i != 1}
+    out = ec.decode({1}, have, chunk_size=len(chunks[1]))
+    assert np.array_equal(np.asarray(out[1]), np.asarray(chunks[1]))
+
+
+def test_shardmap_1dev_plan_encode(tpu):
+    """The production sharded entry point (shard_map of the Pallas plan)
+    compiles and runs on hardware with a 1-device mesh — the minimum
+    hardware proof of the multi-chip path (VERDICT r4 weak item 6)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ceph_tpu.ops.pallas_gf import CodingPlan
+    from ceph_tpu.parallel.sharded import sharded_plan_encode
+
+    k, m = 8, 3
+    mat = isa_rs_vandermonde_matrix(k, m)[k:]
+    plan = CodingPlan(mat)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1), ("pod", "stripe", "lane"))
+    data = RNG.integers(0, 256, (4, k, 512), dtype=np.uint8)
+    out = np.asarray(sharded_plan_encode(plan, jax.numpy.asarray(data), mesh))
+    for s in range(4):
+        assert np.array_equal(out[s], gf_matmul(mat, data[s]))
