@@ -97,10 +97,14 @@ def run_child(platform: str) -> None:
     # safe ceiling: 512 MiB chained launches are what wedged the tunnel in
     # round 4 (benchmarks/diag/ONCHIP_NOTES_r4.md), and a single candidate
     # saves one ~30 s remote compile inside the driver's child deadline.
-    env_batch = os.environ.get("BENCH_TPU_BATCH")
-    batch_candidates = (
-        (int(env_batch),) if env_batch else (256,)
-    ) if on_tpu else (2,)
+    try:
+        env_batch = int(os.environ.get("BENCH_TPU_BATCH", "256"))
+    except ValueError:
+        clog("ignoring malformed BENCH_TPU_BATCH")
+        env_batch = 256
+    if env_batch <= 0:
+        env_batch = 256
+    batch_candidates = (env_batch,) if on_tpu else (2,)
     iters = 40 if on_tpu else 3
 
     # The SHIPPING path: the registered `tpu` plugin's device encode — the

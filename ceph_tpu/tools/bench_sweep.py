@@ -90,7 +90,7 @@ def run_bulk(ec, size: int, batch: int, iters: int) -> tuple[float, int]:
     k = ec.get_data_chunk_count()
     chunk = ec.get_chunk_size(size)
     sub = min(batch, 4096)
-    rounds = max(1, batch // sub)
+    rounds = -(-batch // sub)  # ceil: never measure fewer stripes than asked
     data = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, (sub, k, chunk), dtype=np.uint8)
     )
