@@ -16,7 +16,7 @@ import asyncio
 import json
 
 from ..common.errs import EAGAIN, EEXIST
-from ..msg.messages import MClientCaps, MClientReply, MClientRequest
+from ..msg.messages import MClientCaps, MClientReply, MClientRequest, MMDSMap
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..striper import StripedObject, StripePolicy
 
@@ -130,26 +130,64 @@ class FileHandle:
 
 
 class CephFSClient(Dispatcher):
-    """libcephfs-like handle to one MDS + a data pool."""
+    """libcephfs-like handle to the fs: active MDS + a data pool.
+
+    Two addressing modes: a fixed `mds_addr` (embedded/single-MDS use),
+    or `monmap=` — the client subscribes to the mdsmap, resolves rank 0
+    from the FSMap, and RE-resolves on failover, retrying the op against
+    the promoted standby (Client::handle_mds_map + request resend)."""
 
     def __init__(
-        self, mds_addr: str, data_ioctx, name: str = "client.fs",
-        stack: str = "posix",
+        self, mds_addr: str = "", data_ioctx=None, name: str = "client.fs",
+        stack: str = "posix", monmap=None,
     ):
         self.mds_addr = mds_addr
         self.data = data_ioctx
+        self.monmap = monmap
+        self.monc = None
+        self._mdsmap_epoch = 0
+        self._mds_changed = asyncio.Event()
         self.msgr = Messenger(name, stack=stack)
         self.msgr.add_dispatcher_head(self)
         self._tid = 0
         self._replies: dict[int, asyncio.Future] = {}
         self._handles: dict[int, list[FileHandle]] = {}  # ino -> open fhs
 
+    async def connect(self, timeout: float = 10.0) -> None:
+        """Mon mode: subscribe to the mdsmap and wait for an active MDS."""
+        if self.monmap is None:
+            return
+        from ..mon.client import MonClient
+
+        self.monc = MonClient(self.msgr.name + ".monc", self.monmap)
+        self.monc.msgr.add_dispatcher_tail(self)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not self.mds_addr:
+            await self.monc.subscribe("mdsmap")
+            if asyncio.get_event_loop().time() > deadline:
+                raise FsClientError(EAGAIN, "no active MDS in the fsmap")
+            try:
+                await asyncio.wait_for(self._mds_changed.wait(), 0.5)
+            except asyncio.TimeoutError:
+                pass
+            self._mds_changed.clear()
+
     async def shutdown(self) -> None:
+        if self.monc is not None:
+            await self.monc.msgr.shutdown()
+            self.monc = None
         await self.msgr.shutdown()
 
     # -- dispatch --------------------------------------------------------------
 
     def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMDSMap):
+            if msg.epoch > self._mdsmap_epoch:
+                self._mdsmap_epoch = msg.epoch
+                if msg.active_addr != self.mds_addr:
+                    self.mds_addr = msg.active_addr
+                    self._mds_changed.set()
+            return True
         if isinstance(msg, MClientReply):
             fut = self._replies.pop(msg.tid, None)
             if fut is not None and not fut.done():
@@ -181,19 +219,46 @@ class CephFSClient(Dispatcher):
         return False
 
     async def _request(self, op: str, args: dict, timeout: float = 10.0) -> dict:
-        self._tid += 1
-        tid = self._tid
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._replies[tid] = fut
-        msg = MClientRequest(tid=tid, op=op, args=json.dumps(args).encode())
-        await self.msgr.send_to(self.mds_addr, msg)
-        try:
-            reply: MClientReply = await asyncio.wait_for(fut, timeout)
-        finally:
-            self._replies.pop(tid, None)
-        if reply.result < 0:
-            raise FsClientError(reply.result, f"{op} {args}")
-        return json.loads(reply.payload.decode() or "{}")
+        """One metadata op with failover retry in mon mode: a dead or
+        not-yet-active MDS (-EAGAIN / connection loss / reply timeout)
+        re-resolves rank 0 from the mdsmap and resends (Client request
+        resend on mds_map, Client.cc)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        attempt = 0
+        while True:
+            self._tid += 1
+            tid = self._tid
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._replies[tid] = fut
+            msg = MClientRequest(tid=tid, op=op, args=json.dumps(args).encode())
+            reply: MClientReply | None = None
+            try:
+                await self.msgr.send_to(self.mds_addr, msg)
+                step = 10.0 if self.monc is None else 1.0
+                left = deadline - asyncio.get_event_loop().time()
+                reply = await asyncio.wait_for(fut, max(min(step, left), 0.05))
+            except (ConnectionError, asyncio.TimeoutError, OSError):
+                reply = None
+            finally:
+                self._replies.pop(tid, None)
+            if reply is not None and reply.result != -EAGAIN:
+                if reply.result < 0:
+                    raise FsClientError(reply.result, f"{op} {args}")
+                return json.loads(reply.payload.decode() or "{}")
+            if self.monc is None or asyncio.get_event_loop().time() > deadline:
+                err = reply.result if reply is not None else EAGAIN
+                raise FsClientError(err, f"{op} {args}: mds unavailable")
+            # wait for a newer fsmap (or just retry after a beat)
+            attempt += 1
+            try:
+                await self.monc.subscribe("mdsmap", self._mdsmap_epoch + 1)
+            except ConnectionError:
+                pass
+            try:
+                await asyncio.wait_for(self._mds_changed.wait(), 0.5)
+            except asyncio.TimeoutError:
+                pass
+            self._mds_changed.clear()
 
     async def _release_caps(self, ino: int) -> None:
         rel = MClientCaps(op=MClientCaps.RELEASE, ino=ino, caps="", tid=0)

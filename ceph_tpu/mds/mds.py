@@ -28,8 +28,14 @@ in the loop.  This daemon keeps that architecture:
 - **Sessions**: one per client connection; a reset drops its caps and
   unblocks waiters (Server::handle_client_session teardown).
 
-Single-active-MDS scope (rank 0); multi-MDS subtree partitioning
-(MDCache migrator) is out of scope and documented as such.
+Rank scope: one ACTIVE rank (0) at a time; multi-MDS subtree
+partitioning (MDCache migrator) is out of scope and documented as such.
+**Standby/failover is mon-managed** (round-5): given a `monmap`, the
+daemon boots as a STANDBY, beacons MMDSBeacon to the mons, and only
+activates — load + journal REPLAY + serve — when the committed FSMap
+(MMDSMap) names it rank 0 (MDSDaemon::handle_mds_map state machine,
+boot → standby → replay → active).  Without a monmap it activates
+immediately (library/embedded use).
 """
 
 from __future__ import annotations
@@ -41,7 +47,13 @@ import time
 from ..common.errs import EAGAIN as EAGAIN_
 from ..common.errs import EEXIST, EINVAL, ENOENT, ENOTDIR, ENOTEMPTY
 from ..common.log import dout
-from ..msg.messages import MClientCaps, MClientReply, MClientRequest
+from ..msg.messages import (
+    MClientCaps,
+    MClientReply,
+    MClientRequest,
+    MMDSBeacon,
+    MMDSMap,
+)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 
 ROOT_INO = 1  # MDS_INO_ROOT
@@ -51,20 +63,29 @@ JOURNAL_HEAD_OID = "mds_journal_head"
 FLUSH_INTERVAL = 0.5
 JOURNAL_FLUSH_BYTES = 1 << 20
 REVOKE_TIMEOUT = 3.0  # mds_session_timeout scaled down
+BEACON_INTERVAL = 1.0  # mds_beacon_interval (scaled down)
 
 
 class MDS(Dispatcher):
-    """One active metadata server (rank 0)."""
+    """One metadata server daemon (standby until the FSMap says active)."""
 
     def __init__(self, meta_ioctx, data_ioctx, addr: str = "127.0.0.1:0",
-                 layout: dict | None = None, stack: str = "posix"):
+                 layout: dict | None = None, stack: str = "posix",
+                 name: str = "0", monmap=None):
         self.meta = meta_ioctx
         self.data = data_ioctx
+        self.name = name
+        self.monmap = monmap
+        self.monc = None
+        self.state = "boot"  # boot -> standby -> replay -> active
+        self.mdsmap_epoch = 0
+        self._beacon_task: asyncio.Task | None = None
+        self._activate_task: asyncio.Task | None = None
         self.layout = layout or {
             "stripe_unit": 64 * 1024, "stripe_count": 2, "object_size": 1 << 20
         }
         self._bind_addr = addr
-        self.msgr = Messenger("mds.0", stack=stack)
+        self.msgr = Messenger(f"mds.{name}", stack=stack)
         self.msgr.add_dispatcher_head(self)
         # dirfrag cache: ino -> {name: entry dict}; which are dirty
         self._dirs: dict[int, dict] = {}
@@ -92,19 +113,96 @@ class MDS(Dispatcher):
     # -- lifecycle -------------------------------------------------------------
 
     async def start(self) -> None:
-        await self._load_or_mkfs()
-        await self._replay_journal()
         await self.msgr.bind(self._bind_addr)
         self.addr = self.msgr.addr
-        self._running = True
-        self._flush_task = asyncio.create_task(self._flush_loop())
+        if self.monmap is None:
+            # embedded/library use: no mon control plane, activate now
+            await self._activate()
+            return
+        # Mon-managed: beacon as a standby; the FSMap decides who is
+        # rank 0 (MDSDaemon boot → standby in handle_mds_map).
+        from ..mon.client import MonClient
 
-    async def stop(self) -> None:
+        self.state = "standby"
+        self.monc = MonClient(f"mds.{self.name}", self.monmap)
+        self.monc.msgr.add_dispatcher_tail(self)  # MMDSMap arrives here
+        await self.monc.subscribe("mdsmap")
+        self._beacon_task = asyncio.create_task(self._beacon_loop())
+
+    async def _activate(self) -> None:
+        """standby → replay → active (MDSDaemon::boot_start / replay_done):
+        load the on-pool state, replay the journal, start serving."""
+        self.state = "replay"
+        await self._load_or_mkfs()
+        await self._replay_journal()
+        self._running = True
+        self.state = "active"
+        self._flush_task = asyncio.create_task(self._flush_loop())
+        dout("mds", 1, f"mds.{self.name}: now active (rank 0)")
+
+    def _demote(self) -> None:
+        """active → standby (fs removed / rank reassigned): stop serving
+        and drop volatile state; the on-pool journal stays authoritative."""
         self._running = False
         if self._flush_task is not None:
             self._flush_task.cancel()
             self._flush_task = None
-        await self._flush()
+        self._dirs.clear()
+        self._dirty.clear()
+        self._ino_dirty = False
+        self.caps.clear()
+        self._revoke_waiters.clear()
+        self._ino_loc.clear()
+        self.state = "standby"
+        dout("mds", 1, f"mds.{self.name}: demoted to standby")
+
+    async def _beacon_loop(self) -> None:
+        while True:
+            beacon = MMDSBeacon(
+                name=self.name, addr=self.msgr.addr, state=self.state
+            )
+            for mon_name in self.monmap.ranks:
+                try:
+                    await self.monc.msgr.send_to(
+                        self.monmap.addrs[mon_name], beacon
+                    )
+                except ConnectionError:
+                    continue
+            try:
+                await self.monc.resubscribe()
+            except ConnectionError:
+                pass
+            await asyncio.sleep(BEACON_INTERVAL)
+
+    def _handle_mds_map(self, msg: MMDSMap) -> None:
+        if msg.epoch <= self.mdsmap_epoch:
+            return
+        self.mdsmap_epoch = msg.epoch
+        am_active = msg.active_name == self.name
+        if am_active and self.state == "standby" and self._activate_task is None:
+            task = asyncio.create_task(self._activate())
+            task.add_done_callback(lambda _t: setattr(self, "_activate_task", None))
+            self._activate_task = task
+        elif not am_active and self.state in ("replay", "active"):
+            if self._activate_task is not None:
+                self._activate_task.cancel()
+                self._activate_task = None
+            self._demote()
+
+    async def stop(self, flush: bool = True) -> None:
+        """flush=False models a CRASH: dirty dirfrags are abandoned and
+        the journal must make the next active whole (replay test hook)."""
+        was_active = self._running
+        self._running = False
+        for t in (self._flush_task, self._beacon_task, self._activate_task):
+            if t is not None:
+                t.cancel()
+        self._flush_task = self._beacon_task = self._activate_task = None
+        if was_active and flush:
+            await self._flush()
+        if self.monc is not None:
+            await self.monc.msgr.shutdown()
+            self.monc = None
         await self.msgr.shutdown()
 
     async def _load_or_mkfs(self) -> None:
@@ -278,7 +376,26 @@ class MDS(Dispatcher):
     # -- dispatch --------------------------------------------------------------
 
     def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMDSMap):
+            self._handle_mds_map(msg)
+            return True
         if isinstance(msg, MClientRequest):
+            if self.state not in ("active",) and self.monmap is not None:
+                # not rank 0 (standby, or mid-replay): clients must
+                # re-resolve the active from the mdsmap and retry
+                # (the reference returns CEPH_MDS_STATE-gated ESTALE)
+                async def _reject() -> None:
+                    try:
+                        await conn.send_message(
+                            MClientReply(
+                                tid=msg.tid, result=-EAGAIN_, payload=b"{}"
+                            )
+                        )
+                    except ConnectionError:
+                        pass
+
+                asyncio.get_event_loop().create_task(_reject())
+                return True
             asyncio.get_event_loop().create_task(self._handle(conn, msg))
             return True
         if isinstance(msg, MClientCaps):

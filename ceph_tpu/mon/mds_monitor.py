@@ -1,0 +1,254 @@
+"""MDSMonitor — the PaxosService owning the FSMap (src/mon/MDSMonitor.cc,
+src/mds/FSMap.h).
+
+Mirrored behaviors:
+- MDS daemons announce themselves with beacons (MMDSBeacon →
+  MDSMonitor::prepare_beacon); once a filesystem exists (`fs new`), the
+  first daemon takes **rank 0 (active)** and later ones queue as
+  **standbys** (FSMap::promote / assign_standby_replay essence).
+- A missed beacon window fails the active rank over to a standby
+  (`mds_beacon_grace`, MDSMonitor::tick → maybe_replace_gid), bumping the
+  map epoch; the promoted standby sees itself active in the next MMDSMap
+  and runs journal replay before serving.
+- The map publishes to "mdsmap" subscribers (clients resolving the
+  active MDS; standbys learning of promotion) — check_sub.
+- Commands: `fs new <name> <meta> <data>`, `fs rm <name>`, `fs status`
+  (MDSMonitor's command surface, trimmed to the single-fs scope the MDS
+  daemon implements).
+
+Single-filesystem, single-active-rank scope matching ceph_tpu.mds (rank
+0 only; multi-rank subtree partitioning is out of scope there and
+therefore here).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..common.log import dout
+from ..msg.messages import MMDSBeacon, MMDSMap
+from .paxos_service import ProposalQueue
+
+BEACON_GRACE = 6.0  # mds_beacon_grace (scaled down like mgr's)
+
+
+class FSMap:
+    """The one-filesystem FSMap: rank-0 holder + standbys."""
+
+    def __init__(self) -> None:
+        self.epoch = 0
+        self.fs_name = ""  # empty until `fs new`
+        self.meta_pool = ""
+        self.data_pool = ""
+        self.active_name = ""
+        self.active_addr = ""
+        self.standbys: dict[str, str] = {}  # name -> addr
+
+    def to_msg(self) -> MMDSMap:
+        return MMDSMap(
+            epoch=self.epoch,
+            fs_name=self.fs_name,
+            active_name=self.active_name,
+            active_addr=self.active_addr,
+            standbys=sorted(self.standbys),
+        )
+
+    def status(self) -> dict:
+        """`ceph fs status` / `ceph status` fsmap line."""
+        if not self.fs_name:
+            return {"epoch": self.epoch, "filesystems": []}
+        return {
+            "epoch": self.epoch,
+            "filesystems": [
+                {
+                    "name": self.fs_name,
+                    "metadata_pool": self.meta_pool,
+                    "data_pool": self.data_pool,
+                    "rank0": self.active_name or None,
+                    "standbys": sorted(self.standbys),
+                    "state": "up:active" if self.active_name else "down",
+                }
+            ],
+        }
+
+
+class MDSMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.map = FSMap()
+        self._last_beacon: dict[str, float] = {}
+        self._props = ProposalQueue(mon, "mds")
+
+    def on_election_changed(self) -> None:
+        self._props.reset()
+        # Re-baseline beacons: a fresh leader judging against 0.0 would
+        # instantly fail a healthy active (same as MgrMonitor).
+        now = time.monotonic()
+        for name in [self.map.active_name, *self.map.standbys]:
+            if name:
+                self._last_beacon[name] = now
+
+    # -- beacons ---------------------------------------------------------------
+
+    def prepare_beacon(self, msg: MMDSBeacon) -> None:
+        """Leader-only (MDSMonitor::prepare_beacon)."""
+        self._last_beacon[msg.name] = time.monotonic()
+
+        def mutate(m: FSMap):
+            if not m.fs_name:
+                # No filesystem yet: everyone waits as a standby so
+                # `fs new` can promote instantly (MDSMonitor holds boot
+                # beacons in standby until a filesystem wants a rank).
+                if m.standbys.get(msg.name) != msg.addr:
+                    standbys = dict(m.standbys)
+                    standbys[msg.name] = msg.addr
+                    return ("", "", standbys)
+                return None
+            if m.active_name == msg.name:
+                if m.active_addr != msg.addr:
+                    return (msg.name, msg.addr, m.standbys)
+                return None
+            if not m.active_name:
+                standbys = dict(m.standbys)
+                standbys.pop(msg.name, None)
+                return (msg.name, msg.addr, standbys)
+            if m.standbys.get(msg.name) != msg.addr:
+                standbys = dict(m.standbys)
+                standbys[msg.name] = msg.addr
+                return (m.active_name, m.active_addr, standbys)
+            return None
+
+        self._queue(mutate)
+
+    def tick(self) -> None:
+        """Fail rank 0 over when its beacons stop (MDSMonitor::tick →
+        maybe_replace_gid; driven by the monitor's periodic tick)."""
+        if not self.mon.is_leader() or not self.map.active_name:
+            return
+        last = self._last_beacon.get(self.map.active_name, 0.0)
+        if time.monotonic() - last <= BEACON_GRACE:
+            return
+        failed = self.map.active_name
+        self._last_beacon.pop(failed, None)
+
+        def mutate(m: FSMap):
+            if m.active_name != failed:
+                return None  # already replaced
+            standbys = dict(m.standbys)
+            if standbys:
+                name = sorted(standbys)[0]
+                addr = standbys.pop(name)
+                dout("mon", 1, f"mds {failed} failed; promoting {name} to rank 0")
+                return (name, addr, standbys)
+            dout("mon", 1, f"mds {failed} failed; no standby — fs degraded")
+            return ("", "", {})
+
+        self._queue(mutate)
+
+    # -- commands --------------------------------------------------------------
+
+    def command_handler(self, prefix: str):
+        if prefix == "fs new":
+            def handler(cmd, reply):
+                name = cmd.get("fs_name", "")
+                meta, data = cmd.get("metadata", ""), cmd.get("data", "")
+                if not name or not meta or not data:
+                    reply(-22, "usage: fs new <fs_name> <metadata> <data>")
+                    return
+                osdmap = self.mon.osdmon.osdmap
+                pools = {p.name for p in osdmap.pools.values()}
+                for pool in (meta, data):
+                    if pool not in pools:
+                        reply(-2, f"pool {pool!r} does not exist")
+                        return
+
+                def mutate(m: FSMap):
+                    if m.fs_name:
+                        return None  # single-fs scope: already created
+                    # promote the first waiting standby to rank 0
+                    standbys = dict(m.standbys)
+                    active_name = active_addr = ""
+                    if standbys:
+                        active_name = sorted(standbys)[0]
+                        active_addr = standbys.pop(active_name)
+                    return (active_name, active_addr, standbys, name, meta, data)
+
+                def on_committed(version: int) -> None:
+                    if version < 0 and self.map.fs_name != name:
+                        reply(-17, f"filesystem {self.map.fs_name!r} already exists")
+                    else:
+                        reply(0, f"new fs with metadata pool {meta} and data pool {data}")
+
+                self._queue(mutate, on_committed)
+
+            handler.mutating = True
+            return handler
+        if prefix == "fs rm":
+            def handler(cmd, reply):
+                def mutate(m: FSMap):
+                    if not m.fs_name:
+                        return None
+                    return ("", "", dict(m.standbys), "", "", "")
+
+                self._queue(mutate, lambda v: reply(0, "fs removed"))
+
+            handler.mutating = True
+            return handler
+        if prefix == "fs status":
+            def handler(cmd, reply):
+                reply(0, "", json.dumps(self.map.status()).encode())
+
+            return handler
+        return None
+
+    # -- paxos -----------------------------------------------------------------
+
+    def _queue(self, mutate, on_committed=None) -> None:
+        def make_blob():
+            result = mutate(self.map)
+            if result is None:
+                return None
+            if len(result) == 3:
+                active_name, active_addr, standbys = result
+                fs = (self.map.fs_name, self.map.meta_pool, self.map.data_pool)
+            else:
+                active_name, active_addr, standbys, *fs = result
+            return json.dumps(
+                {
+                    "epoch": self.map.epoch + 1,
+                    "fs_name": fs[0],
+                    "meta_pool": fs[1],
+                    "data_pool": fs[2],
+                    "active_name": active_name,
+                    "active_addr": active_addr,
+                    "standbys": standbys,
+                }
+            ).encode()
+
+        self._props.queue(make_blob, on_committed)
+
+    def apply_commit(self, blob: bytes) -> None:
+        info = json.loads(blob.decode())
+        m = self.map
+        m.epoch = info["epoch"]
+        m.fs_name = info["fs_name"]
+        m.meta_pool = info["meta_pool"]
+        m.data_pool = info["data_pool"]
+        m.active_name = info["active_name"]
+        m.active_addr = info["active_addr"]
+        m.standbys = dict(info["standbys"])
+        dout(
+            "mon", 10,
+            f"fsmap e{m.epoch}: fs={m.fs_name or '(none)'} "
+            f"rank0={m.active_name or '(none)'} standbys={sorted(m.standbys)}",
+        )
+        self.mon.publish_mdsmap()
+
+    # -- subscriptions ---------------------------------------------------------
+
+    def check_sub(self, conn, subs: dict[str, int]) -> None:
+        if self.map.epoch == 0 or subs.get("mdsmap", 0) > self.map.epoch:
+            return
+        subs["mdsmap"] = self.map.epoch + 1
+        self.mon.send_to_conn(conn, self.map.to_msg())

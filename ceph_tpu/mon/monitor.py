@@ -27,6 +27,7 @@ import json
 from ..common.log import dout
 from ..msg.messages import (
     MLog,
+    MMDSBeacon,
     MMgrBeacon,
     MMonCommand,
     MMonCommandAck,
@@ -43,6 +44,7 @@ from .config_monitor import ConfigMonitor
 from .elector import Elector
 from .log_monitor import LogMonitor
 from .monmap import MonMap
+from .mds_monitor import MDSMonitor
 from .mgr_monitor import MgrMonitor
 from .osd_monitor import OSDMonitor
 from .paxos import Paxos
@@ -89,6 +91,7 @@ class Monitor(Dispatcher):
         self.leader_rank: int | None = None
         self.osdmon = OSDMonitor(self)
         self.mgrmon = MgrMonitor(self)
+        self.mdsmon = MDSMonitor(self)
         self.configmon = ConfigMonitor(self)
         self.logmon = LogMonitor(self)
         self.authmon = AuthMonitor(self)
@@ -170,6 +173,7 @@ class Monitor(Dispatcher):
             await asyncio.sleep(1.0)
             if self.is_leader():
                 self.mgrmon.tick()
+                self.mdsmon.tick()
 
     async def wait_for_quorum(self, timeout: float = 5.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -209,7 +213,8 @@ class Monitor(Dispatcher):
         self.leader_rank = self.rank
         self.paxos.leader_init(quorum)
         self.osdmon.on_active()
-        for svc in (self.mgrmon, self.configmon, self.logmon, self.authmon):
+        for svc in (self.mgrmon, self.mdsmon, self.configmon, self.logmon,
+                    self.authmon):
             svc.on_election_changed()
 
     def _lose_election(
@@ -222,7 +227,8 @@ class Monitor(Dispatcher):
         self.leader_rank = leader
         self.paxos.peon_init(leader)
         self.osdmon.on_election_lost()
-        for svc in (self.mgrmon, self.configmon, self.logmon, self.authmon):
+        for svc in (self.mgrmon, self.mdsmon, self.configmon, self.logmon,
+                    self.authmon):
             svc.on_election_changed()
 
     # -- commit application ----------------------------------------------------
@@ -235,6 +241,8 @@ class Monitor(Dispatcher):
             self.osdmon.apply_commit(blob)
         elif service == b"mgr":
             self.mgrmon.apply_commit(blob)
+        elif service == b"mds":
+            self.mdsmon.apply_commit(blob)
         elif service == b"config":
             self.configmon.apply_commit(blob)
         elif service == b"logm":
@@ -265,6 +273,9 @@ class Monitor(Dispatcher):
         elif isinstance(msg, MMgrBeacon):
             if self.is_leader():
                 self.mgrmon.prepare_beacon(msg)
+        elif isinstance(msg, MMDSBeacon):
+            if self.is_leader():
+                self.mdsmon.prepare_beacon(msg)
         elif isinstance(msg, MLog):
             # Daemon clog entries: the leader proposes them; a peon forwards
             # to the leader (Monitor::forward_request_leader).
@@ -293,6 +304,8 @@ class Monitor(Dispatcher):
                 self.osdmon.check_sub(conn, subs)
             elif what == "mgrmap":
                 self.mgrmon.check_sub(conn, subs)
+            elif what == "mdsmap":
+                self.mdsmon.check_sub(conn, subs)
             elif what == "config":
                 self.configmon.check_sub(conn, subs)
             elif what == "log":
@@ -308,6 +321,11 @@ class Monitor(Dispatcher):
         for conn, subs in list(self.subs.items()):
             if "mgrmap" in subs:
                 self.mgrmon.check_sub(conn, subs)
+
+    def publish_mdsmap(self) -> None:
+        for conn, subs in list(self.subs.items()):
+            if "mdsmap" in subs:
+                self.mdsmon.check_sub(conn, subs)
 
     def publish_config(self) -> None:
         for conn, subs in list(self.subs.items()):
@@ -340,7 +358,8 @@ class Monitor(Dispatcher):
             return
         prefix = cmd.get("prefix", "")
         handler = None
-        for svc in (self.osdmon, self.configmon, self.logmon, self.authmon):
+        for svc in (self.osdmon, self.mdsmon, self.configmon, self.logmon,
+                    self.authmon):
             handler = svc.command_handler(prefix)
             if handler is not None:
                 break
@@ -418,6 +437,7 @@ class Monitor(Dispatcher):
                             "num_osds": len(m.osds),
                             "num_up_osds": m.num_up_osds(),
                             "pools": [p.name for p in m.pools.values()],
+                            "fsmap": self.mdsmon.map.status(),
                         }
                     ).encode(),
                 )

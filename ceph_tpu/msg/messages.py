@@ -615,3 +615,28 @@ class MClientCaps(Message):
     REVOKE, ACK, RELEASE = 0, 1, 2
 
     FIELDS = [("op", "u8"), ("ino", "u64"), ("caps", "str"), ("tid", "u64")]
+
+
+@message_type(39)
+class MMDSBeacon(Message):
+    """MDS -> mon availability beacon (src/messages/MMDSBeacon.h): drives
+    MDSMonitor's rank assignment and failover.  `state` is the daemon's
+    self-reported state (boot / standby / active)."""
+
+    FIELDS = [("name", "str"), ("addr", "str"), ("state", "str")]
+
+
+@message_type(40)
+class MMDSMap(Message):
+    """Mon -> subscribers: the FSMap (src/messages/MMDSMap.h + FSMap):
+    which daemon holds rank 0 (active) for the one filesystem, plus the
+    standby pool.  Clients resolve the active MDS from this; standby
+    daemons learn here that they have been promoted."""
+
+    FIELDS = [
+        ("epoch", "u32"),
+        ("fs_name", "str"),
+        ("active_name", "str"),
+        ("active_addr", "str"),
+        ("standbys", ("list", "str")),
+    ]

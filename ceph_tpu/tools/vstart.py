@@ -49,6 +49,7 @@ class DevCluster:
         n_osds: int = 3,
         with_mgr: bool = True,
         with_mds: bool = False,
+        n_mds: int = 2,  # daemons to boot when with_mds (rank 0 + standby)
         conf_overrides: dict | None = None,
         asok_dir: str = "",  # enable daemon admin sockets under this dir
     ):
@@ -57,12 +58,14 @@ class DevCluster:
         self.n_osds = n_osds
         self.with_mgr = with_mgr
         self.with_mds = with_mds
+        self.n_mds = n_mds
         self.conf_overrides = conf_overrides or {}
         self.monmap: MonMap | None = None
         self.mons: list[Monitor] = []
         self.osds: list[OSD] = []
         self.mgr: Mgr | None = None
-        self.mds = None
+        self.mds = None  # the active MDS (rank 0)
+        self.mds_daemons: list = []
         self._mds_rados = None
 
     async def start(self) -> MonMap:
@@ -133,8 +136,10 @@ class DevCluster:
             ):
                 self.mgr.register_module(module)
         if self.with_mds:
-            # `ceph fs new`-style bootstrap: metadata + data pools, then
-            # the metadata server (vstart.sh's MDS=1 default topology)
+            # `ceph fs new` bootstrap: metadata + data pools, the fs map,
+            # then the metadata servers — vstart.sh's MDS topology; 2
+            # daemons give rank 0 + one standby for mon-driven failover
+            # (MDSMonitor/FSMap, mon/mds_monitor.py)
             from ..client import Rados
             from ..mds import MDS
 
@@ -149,10 +154,29 @@ class DevCluster:
             await self._mds_rados.pool_create(
                 "cephfs_data", "replicated", size=size, pg_num=8
             )
-            meta = await self._mds_rados.open_ioctx("cephfs_metadata")
-            data = await self._mds_rados.open_ioctx("cephfs_data")
-            self.mds = MDS(meta, data, stack=self._stack)
-            await self.mds.start()
+            rv, rs, _ = await self._mds_rados.mon_command(
+                {"prefix": "fs new", "fs_name": "cephfs",
+                 "metadata": "cephfs_metadata", "data": "cephfs_data"}
+            )
+            assert rv == 0, f"fs new failed: {rs}"
+            for name in ("a", "b")[: max(1, self.n_mds)]:
+                meta = await self._mds_rados.open_ioctx("cephfs_metadata")
+                data = await self._mds_rados.open_ioctx("cephfs_data")
+                d = MDS(
+                    meta, data, stack=self._stack, name=name,
+                    monmap=self.monmap,
+                )
+                await d.start()
+                self.mds_daemons.append(d)
+            # rank 0 comes up once the fsmap names it
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while not any(d.state == "active" for d in self.mds_daemons):
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError("no MDS became active")
+                await asyncio.sleep(0.05)
+            self.mds = next(
+                d for d in self.mds_daemons if d.state == "active"
+            )
         return self.monmap
 
     def _asok(self, daemon: str) -> str:
@@ -160,8 +184,10 @@ class DevCluster:
         return f"{self.asok_dir}/{daemon}.asok" if self.asok_dir else ""
 
     async def stop(self) -> None:
-        if self.mds is not None:
-            await self.mds.stop()
+        for d in self.mds_daemons:
+            await d.stop()
+        self.mds_daemons.clear()
+        self.mds = None
         if self._mds_rados is not None:
             await self._mds_rados.shutdown()
         if self.mgr is not None:

@@ -1,0 +1,111 @@
+"""MDSMonitor / FSMap: mon-managed MDS ranks, standby failover, replay.
+
+Models the reference's MDSMonitor coverage (src/mon/MDSMonitor.cc beacon
+→ rank assignment, mds_beacon_grace failover; qa/tasks/cephfs
+test_failover.py): two daemons boot via vstart, the fsmap names rank 0,
+killing the active promotes the standby, and the promoted daemon's
+journal REPLAY makes every acknowledged mutation visible again.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import Rados
+from ceph_tpu.mds.client import CephFSClient
+from ceph_tpu.mon.mds_monitor import BEACON_GRACE
+from ceph_tpu.tools.vstart import DevCluster
+
+from test_cluster import wait_until
+
+
+def test_fs_new_requires_pools():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=False)
+        await cluster.start()
+        client = Rados(cluster.monmap)
+        await client.connect()
+        rv, rs, _ = await client.mon_command(
+            {"prefix": "fs new", "fs_name": "x", "metadata": "nope",
+             "data": "nope2"}
+        )
+        assert rv != 0 and "does not exist" in rs
+        await client.shutdown()
+        await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_fsmap_ranks_and_status():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=False, with_mds=True)
+        await cluster.start()
+        assert len(cluster.mds_daemons) == 2
+        states = sorted(d.state for d in cluster.mds_daemons)
+        assert states == ["active", "standby"]
+        client = Rados(cluster.monmap)
+        await client.connect()
+        rv, _, out = await client.mon_command({"prefix": "fs status"})
+        assert rv == 0
+        import json
+
+        st = json.loads(out)
+        fs = st["filesystems"][0]
+        assert fs["name"] == "cephfs"
+        assert fs["rank0"] == cluster.mds.name
+        assert len(fs["standbys"]) == 1
+        assert fs["state"] == "up:active"
+        # `ceph status` carries the fsmap line
+        rv, _, out = await client.mon_command({"prefix": "status"})
+        assert rv == 0
+        assert json.loads(out)["fsmap"]["filesystems"][0]["name"] == "cephfs"
+        await client.shutdown()
+        await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_active_mds_failover_with_journal_replay():
+    """Kill rank 0 WITHOUT flushing (a crash): the mon fails it over on
+    beacon timeout, the standby replays the journal, and a monmap-driven
+    client re-resolves and reads every acknowledged file back."""
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=False, with_mds=True)
+        await cluster.start()
+        rados = Rados(cluster.monmap)
+        await rados.connect()
+        data_io = await rados.open_ioctx("cephfs_data")
+        fsc = CephFSClient(data_ioctx=data_io, monmap=cluster.monmap)
+        await fsc.connect()
+        await fsc.mkdir("/dir")
+        for i in range(3):
+            await fsc.write_file(f"/dir/f{i}", f"payload {i}".encode() * 20)
+        old_active = cluster.mds
+        standby = next(d for d in cluster.mds_daemons if d is not old_active)
+        # crash the active: no flush — the journal must carry the state
+        await old_active.stop(flush=False)
+        await wait_until(
+            lambda: standby.state == "active",
+            BEACON_GRACE + 10.0,
+            "standby promoted to rank 0",
+        )
+        # acknowledged namespace + data survive via journal replay
+        assert sorted(await fsc.listdir("/dir")) == ["f0", "f1", "f2"]
+        for i in range(3):
+            got = await fsc.read_file(f"/dir/f{i}")
+            assert got == f"payload {i}".encode() * 20
+        # and the fs keeps working on the new active
+        await fsc.write_file("/dir/after", b"post-failover")
+        assert await fsc.read_file("/dir/after") == b"post-failover"
+        rv, _, out = await rados.mon_command({"prefix": "fs status"})
+        import json
+
+        assert json.loads(out)["filesystems"][0]["rank0"] == standby.name
+        cluster.mds_daemons.remove(old_active)
+        cluster.mds = standby
+        await fsc.shutdown()
+        await rados.shutdown()
+        await cluster.stop()
+
+    asyncio.run(run())
