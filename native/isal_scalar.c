@@ -1,0 +1,165 @@
+/* isal_scalar — compiled foreign golden-vector generator.
+ *
+ * Clean-room C implementation of ISA-L's PUBLISHED scalar erasure-code
+ * base semantics (isa-l ec_base.c: gf_mul / gf_inv / gf_gen_rs_matrix /
+ * gf_gen_cauchy1_matrix / gf_invert_matrix / ec_encode_data), written
+ * from the algorithm spec — the reference checkout vendors no isa-l
+ * sources to copy (/root/reference/src/erasure-code/isa/README:1 merely
+ * documents the library dependency; ErasureCodeIsa.cc:119-131 calls it).
+ *
+ * Purpose (round-5 verdict item 7): the byte-identity claim of the tpu
+ * plugin vs the `isa` plugin must rest on COMPILED foreign code, not
+ * only on the Python re-derivation in tests/isal_reference.py.  This
+ * file uses log/antilog tables over the 0x11d field — ISA-L ec_base's
+ * own mechanism, and a third mechanism overall (the Python oracle uses
+ * peasant multiplies; production ceph_tpu.gf uses numpy mul tables), so
+ * all three agreeing is a genuine cross-check.
+ *
+ * Protocol (stdout, binary):
+ *   argv: k m technique(rs|cauchy) chunk_size seed
+ *   emits: (k+m)*k matrix bytes, then k data chunks (the LCG input
+ *   split), then m parity chunks from ec_encode_data — chunk_size each.
+ * tests/test_isal_golden.py builds this via native/Makefile and
+ * byte-compares the production plugin's chunks against the output.
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define GF_POLY 0x11d /* x^8+x^4+x^3+x^2+1, the ec_base field */
+
+static uint8_t gflog[256];
+static uint8_t gfexp[256 * 2]; /* doubled so mul skips one mod-255 */
+
+static void gf_tables_init(void) {
+    /* generator 2 walks the whole multiplicative group in this field */
+    unsigned v = 1;
+    for (int i = 0; i < 255; i++) {
+        gfexp[i] = (uint8_t)v;
+        gfexp[i + 255] = (uint8_t)v;
+        gflog[v] = (uint8_t)i;
+        v <<= 1;
+        if (v & 0x100)
+            v ^= GF_POLY;
+    }
+    gflog[0] = 0; /* unused: mul/inv guard zero explicitly */
+}
+
+static uint8_t gf_mul(uint8_t a, uint8_t b) {
+    if (a == 0 || b == 0)
+        return 0;
+    return gfexp[gflog[a] + gflog[b]];
+}
+
+static uint8_t gf_inv(uint8_t a) {
+    if (a == 0) {
+        fprintf(stderr, "gf_inv(0)\n");
+        exit(3);
+    }
+    return gfexp[255 - gflog[a]];
+}
+
+/* gf_gen_rs_matrix: identity atop geometric rows of gen = 2^i (parity
+ * row 0 all-ones). */
+static void gen_rs_matrix(uint8_t *a, int k, int m) {
+    memset(a, 0, (size_t)(k + m) * k);
+    for (int i = 0; i < k; i++)
+        a[i * k + i] = 1;
+    uint8_t gen = 1;
+    for (int i = 0; i < m; i++) {
+        uint8_t p = 1;
+        for (int j = 0; j < k; j++) {
+            a[(k + i) * k + j] = p;
+            p = gf_mul(p, gen);
+        }
+        gen = gf_mul(gen, 2);
+    }
+}
+
+/* gf_gen_cauchy1_matrix: parity[i][j] = 1 / ((k+i) ^ j). */
+static void gen_cauchy1_matrix(uint8_t *a, int k, int m) {
+    memset(a, 0, (size_t)(k + m) * k);
+    for (int i = 0; i < k; i++)
+        a[i * k + i] = 1;
+    for (int i = k; i < k + m; i++)
+        for (int j = 0; j < k; j++)
+            a[i * k + j] = gf_inv((uint8_t)(i ^ j));
+}
+
+/* ec_encode_data, scalar base: parity[p][x] = XOR_j c[p][j] * d[j][x]. */
+static void encode(const uint8_t *coding, int m, int k, long len,
+                   uint8_t *const *data, uint8_t *const *parity) {
+    for (int p = 0; p < m; p++) {
+        memset(parity[p], 0, (size_t)len);
+        for (int j = 0; j < k; j++) {
+            uint8_t c = coding[p * k + j];
+            if (c == 0)
+                continue;
+            const uint8_t *d = data[j];
+            uint8_t *out = parity[p];
+            if (c == 1) {
+                for (long x = 0; x < len; x++)
+                    out[x] ^= d[x];
+            } else {
+                const uint8_t *row = &gfexp[gflog[c]];
+                for (long x = 0; x < len; x++)
+                    if (d[x])
+                        out[x] ^= row[gflog[d[x]]];
+            }
+        }
+    }
+}
+
+/* Deterministic input: the SAME musl LCG as tests/isal_reference.py
+ * lcg_bytes, so Python and C generate identical data streams. */
+static void lcg_fill(uint8_t *buf, long n, uint32_t seed) {
+    uint32_t state = seed;
+    for (long i = 0; i < n; i++) {
+        state = state * 1103515245u + 12345u;
+        buf[i] = (uint8_t)(state >> 16);
+    }
+}
+
+int main(int argc, char **argv) {
+    if (argc != 6) {
+        fprintf(stderr,
+                "usage: %s k m rs|cauchy chunk_size seed\n", argv[0]);
+        return 2;
+    }
+    int k = atoi(argv[1]);
+    int m = atoi(argv[2]);
+    const char *tech = argv[3];
+    long chunk = atol(argv[4]);
+    uint32_t seed = (uint32_t)strtoul(argv[5], NULL, 0);
+    if (k <= 0 || m <= 0 || k + m > 255 || chunk <= 0) {
+        fprintf(stderr, "bad geometry\n");
+        return 2;
+    }
+    gf_tables_init();
+
+    uint8_t *matrix = malloc((size_t)(k + m) * k);
+    if (strcmp(tech, "cauchy") == 0)
+        gen_cauchy1_matrix(matrix, k, m);
+    else
+        gen_rs_matrix(matrix, k, m);
+
+    uint8_t *raw = malloc((size_t)k * chunk);
+    lcg_fill(raw, (long)k * chunk, seed);
+    uint8_t **data = malloc(sizeof(uint8_t *) * k);
+    for (int j = 0; j < k; j++)
+        data[j] = raw + (size_t)j * chunk;
+    uint8_t **parity = malloc(sizeof(uint8_t *) * m);
+    for (int p = 0; p < m; p++)
+        parity[p] = malloc((size_t)chunk);
+
+    encode(matrix + (size_t)k * k, m, k, chunk, data, parity);
+
+    fwrite(matrix, 1, (size_t)(k + m) * k, stdout);
+    fwrite(raw, 1, (size_t)k * chunk, stdout);
+    for (int p = 0; p < m; p++)
+        fwrite(parity[p], 1, (size_t)chunk, stdout);
+    fflush(stdout);
+    return 0;
+}

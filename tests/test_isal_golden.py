@@ -107,6 +107,75 @@ class TestEncodeParity:
         )
 
 
+class TestCompiledForeignVectors:
+    """Golden chunks from COMPILED foreign code (native/isal_scalar.c —
+    clean-room C of ISA-L's published ec_base semantics, log/antilog
+    mechanism): a third implementation that the production plugin AND
+    the Python oracle must both match byte-for-byte (VERDICT r4 item 7)."""
+
+    @pytest.fixture(scope="class")
+    def vectors_bin(self):
+        import pathlib
+        import subprocess
+
+        native = pathlib.Path(__file__).resolve().parent.parent / "native"
+        r = subprocess.run(
+            ["make", "-C", str(native), "isal_vectors"],
+            capture_output=True, text=True,
+        )
+        if r.returncode != 0:
+            pytest.skip(f"no C toolchain: {r.stderr[-200:]}")
+        return str(native / "isal_vectors")
+
+    @pytest.mark.parametrize(
+        "technique,k,m",
+        [("reed_sol_van", 8, 3), ("reed_sol_van", 4, 2),
+         ("cauchy", 6, 3), ("cauchy", 10, 4)],
+    )
+    def test_plugin_matches_compiled_vectors(self, vectors_bin, technique, k, m):
+        import subprocess
+
+        chunk, seed = 512, 0xCE9B
+        tech_c = "rs" if technique == "reed_sol_van" else "cauchy"
+        out = subprocess.run(
+            [vectors_bin, str(k), str(m), tech_c, str(chunk), str(seed)],
+            capture_output=True,
+        )
+        assert out.returncode == 0, out.stderr
+        blob = out.stdout
+        assert len(blob) == (k + m) * k + (k + m) * chunk
+        mat = np.frombuffer(blob[: (k + m) * k], np.uint8).reshape(k + m, k)
+        body = blob[(k + m) * k :]
+        c_chunks = [
+            body[i * chunk : (i + 1) * chunk] for i in range(k + m)
+        ]
+        # 1. the compiled matrix equals the production one
+        ours = (
+            isa_rs_vandermonde_matrix(k, m)
+            if technique == "reed_sol_van"
+            else isa_cauchy_matrix(k, m)
+        )
+        assert mat.tolist() == ours.tolist()
+        # 2. the C generator's LCG input equals the Python oracle's (the
+        #    two harnesses drive identical bytes)
+        assert b"".join(c_chunks[:k]) == isal.lcg_bytes(k * chunk, seed=seed)
+        # 3. the production plugin's parity over that input equals the
+        #    compiled encoder's, byte for byte
+        ec, chunks = _plugin_chunks(
+            technique, k, m, b"".join(c_chunks[:k])
+        )
+        for i in range(m):
+            got = bytes(chunks[ec.chunk_index(k + i)])
+            assert got == c_chunks[k + i], f"parity {i} diverges from C"
+        # 4. and the Python oracle agrees with the compiled encoder too
+        py_parity = isal.encode(
+            [[int(x) for x in r] for r in mat[k:]],
+            [bytes(c) for c in c_chunks[:k]],
+        )
+        for i in range(m):
+            assert py_parity[i] == c_chunks[k + i]
+
+
 class TestDecodeParity:
     @pytest.mark.parametrize("technique,k,m", CONFIGS)
     @pytest.mark.parametrize("nerr", [1, 2])
