@@ -102,18 +102,24 @@ class S3Server:
         finally:
             writer.close()
 
+    # sentinel: request carried bad credentials (vs None = anonymous)
+    _BAD_AUTH = object()
+
     async def _authenticate(
         self, method: str, path: str, headers: dict, body: bytes
-    ) -> bool:
-        if not self.require_auth:
-            return True
+    ):
+        """Returns the authenticated uid, None for anonymous, or
+        _BAD_AUTH when credentials were presented and failed
+        (rgw_auth_s3.cc authorize; SignatureDoesNotMatch)."""
         auth = headers.get("authorization", "")
+        if not auth:
+            return self._BAD_AUTH if self.require_auth else None
         if not auth.startswith("AWS "):
-            return False
+            return self._BAD_AUTH
         try:
             access_key, signature = auth[4:].split(":", 1)
         except ValueError:
-            return False
+            return self._BAD_AUTH
         date = headers.get("date", "")
         amz_date = headers.get("x-amz-date", "")
         if amz_date:
@@ -122,9 +128,9 @@ class S3Server:
             # the amz header instead (rgw accepts either).
             date = ""
             if not self._date_fresh(amz_date):
-                return False
+                return self._BAD_AUTH
         elif not self._date_fresh(date):
-            return False
+            return self._BAD_AUTH
         # The signature covers Content-MD5; when the client sends it, the
         # body must actually hash to it, or an attacker could replay a
         # captured signature with a different body attached.  (v2 treats
@@ -135,10 +141,10 @@ class S3Server:
         if content_md5:
             actual = base64.b64encode(hashlib.md5(body).digest()).decode()
             if not hmac.compare_digest(content_md5, actual):
-                return False
+                return self._BAD_AUTH
         user = await self.gw.user_by_access_key(access_key)
         if user is None:
-            return False
+            return self._BAD_AUTH
         expect = sign_v2(
             user["secret_key"],
             method,
@@ -148,7 +154,9 @@ class S3Server:
             content_type=headers.get("content-type", ""),
             amz_date=amz_date,
         )
-        return hmac.compare_digest(signature, expect)
+        if not hmac.compare_digest(signature, expect):
+            return self._BAD_AUTH
+        return user["uid"]
 
     @staticmethod
     def _date_fresh(date: str) -> bool:
@@ -169,15 +177,18 @@ class S3Server:
         url = urlparse(target)
         path = unquote(url.path)
         query = parse_qs(url.query, keep_blank_values=True)
-        if not await self._authenticate(method, path, headers, body):
+        actor = await self._authenticate(method, path, headers, body)
+        if actor is self._BAD_AUTH:
             return "403 Forbidden", {}, _error_xml("AccessDenied")
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         try:
-            if not bucket:  # service level: list buckets
+            if not bucket:  # service level: list the caller's buckets
                 if method == "GET":
-                    names = await self.gw.list_buckets()
+                    names = await self.gw.list_buckets(
+                        owner=actor if actor else None
+                    )
                     xml = "".join(f"<Bucket><Name>{_x(n)}</Name></Bucket>" for n in names)
                     return (
                         "200 OK",
@@ -187,25 +198,74 @@ class S3Server:
                     )
                 return "405 Method Not Allowed", {}, b""
             if not key:
-                return await self._bucket_op(method, bucket, query)
-            return await self._object_op(method, bucket, key, body)
+                return await self._bucket_op(method, bucket, query, headers, body, actor)
+            return await self._object_op(method, bucket, key, body, query, headers, actor)
         except RgwError as e:
             status = {
                 "NoSuchBucket": "404 Not Found",
                 "NoSuchKey": "404 Not Found",
+                "NoSuchVersion": "404 Not Found",
                 "NoSuchUpload": "404 Not Found",
                 "NoSuchUser": "404 Not Found",
+                "AccessDenied": "403 Forbidden",
+                "MethodNotAllowed": "405 Method Not Allowed",
                 "BucketAlreadyExists": "409 Conflict",
                 "BucketNotEmpty": "409 Conflict",
                 "UserAlreadyExists": "409 Conflict",
             }.get(e.code, "400 Bad Request")
             return status, {"Content-Type": "application/xml"}, _error_xml(e.code)
 
-    async def _bucket_op(self, method: str, bucket: str, query: dict):
+    @staticmethod
+    def _canned_grants(headers: dict) -> dict:
+        """x-amz-acl canned ACL -> grant map (rgw_acl_s3.cc canned
+        policies; private is the empty grant set — owner only)."""
+        canned = headers.get("x-amz-acl", "private")
+        if canned == "public-read":
+            return {"*": "READ"}
+        if canned == "public-read-write":
+            return {"*": "WRITE"}
+        return {}
+
+    async def _bucket_op(
+        self, method: str, bucket: str, query: dict, headers: dict,
+        body: bytes, actor,
+    ):
+        if "acl" in query:
+            return await self._acl_op(method, bucket, headers, actor)
+        if "versioning" in query:
+            return await self._versioning_op(method, bucket, body, actor)
+        if "versions" in query and method == "GET":
+            versions = await self.gw.list_object_versions(
+                bucket, prefix=query.get("prefix", [""])[0], actor=actor
+            )
+            rows = "".join(
+                (
+                    f"<DeleteMarker><Key>{_x(v['key'])}</Key>"
+                    f"<VersionId>{_x(v.get('version_id', 'null'))}</VersionId>"
+                    f"<IsLatest>{str(v['is_latest']).lower()}</IsLatest>"
+                    f"</DeleteMarker>"
+                    if v.get("delete_marker")
+                    else f"<Version><Key>{_x(v['key'])}</Key>"
+                    f"<VersionId>{_x(v.get('version_id', 'null'))}</VersionId>"
+                    f"<IsLatest>{str(v['is_latest']).lower()}</IsLatest>"
+                    f"<Size>{v.get('size', 0)}</Size>"
+                    f"<ETag>&quot;{v.get('etag', '')}&quot;</ETag></Version>"
+                )
+                for v in versions
+            )
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<ListVersionsResult><Name>{_x(bucket)}</Name>{rows}"
+                f"</ListVersionsResult>".encode(),
+            )
         if method == "PUT":
-            await self.gw.create_bucket(bucket)
+            await self.gw.create_bucket(
+                bucket, owner=actor or "", grants=self._canned_grants(headers)
+            )
             return "200 OK", {}, b""
         if method == "DELETE":
+            await self.gw._require_access(bucket, actor, "FULL_CONTROL")
             await self.gw.delete_bucket(bucket)
             return "204 No Content", {}, b""
         if method == "GET":
@@ -215,6 +275,7 @@ class S3Server:
                 delimiter=query.get("delimiter", [""])[0],
                 marker=query.get("marker", [""])[0],
                 max_keys=_int_arg(query.get("max-keys", ["1000"])[0]),
+                actor=actor,
             )
             contents = "".join(
                 f"<Contents><Key>{_x(c['key'])}</Key><Size>{c['size']}</Size>"
@@ -235,31 +296,93 @@ class S3Server:
             )
         return "405 Method Not Allowed", {}, b""
 
-    async def _object_op(self, method: str, bucket: str, key: str, body: bytes):
-        if method == "PUT":
-            etag = await self.gw.put_object(bucket, key, body)
-            return "200 OK", {"ETag": f'"{etag}"'}, b""
+    async def _acl_op(self, method: str, bucket: str, headers: dict, actor):
+        """?acl subresource: GET dumps the policy, PUT applies a canned
+        ACL (x-amz-acl), both owner-gated (RGWGetACLs / RGWPutACLs)."""
         if method == "GET":
-            data = await self.gw.get_object(bucket, key)
-            meta = await self.gw.head_object(bucket, key)
+            acl = await self.gw.get_bucket_acl(bucket, actor=actor)
+            grants = "".join(
+                f"<Grant><Grantee>{_x(g)}</Grantee>"
+                f"<Permission>{_x(p)}</Permission></Grant>"
+                for g, p in sorted(acl["grants"].items())
+            )
             return (
                 "200 OK",
-                {
-                    "ETag": f'"{meta["etag"]}"',
-                    "Content-Type": "application/octet-stream",
-                },
-                data,
+                {"Content-Type": "application/xml"},
+                f"<AccessControlPolicy><Owner><ID>{_x(acl['owner'])}</ID>"
+                f"</Owner><AccessControlList>{grants}</AccessControlList>"
+                f"</AccessControlPolicy>".encode(),
             )
+        if method == "PUT":
+            await self.gw.set_bucket_acl(
+                bucket, self._canned_grants(headers), actor=actor
+            )
+            return "200 OK", {}, b""
+        return "405 Method Not Allowed", {}, b""
+
+    async def _versioning_op(self, method: str, bucket: str, body: bytes, actor):
+        if method == "GET":
+            status = await self.gw.get_versioning(bucket, actor=actor)
+            inner = f"<Status>{_x(status)}</Status>" if status else ""
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<VersioningConfiguration>{inner}"
+                f"</VersioningConfiguration>".encode(),
+            )
+        if method == "PUT":
+            import re
+
+            m = re.search(rb"<Status>\s*(\w+)\s*</Status>", body)
+            status = m.group(1).decode() if m else ""
+            await self.gw.set_versioning(bucket, status, actor=actor)
+            return "200 OK", {}, b""
+        return "405 Method Not Allowed", {}, b""
+
+    async def _object_op(
+        self, method: str, bucket: str, key: str, body: bytes, query: dict,
+        headers: dict, actor,
+    ):
+        version_id = query.get("versionId", [""])[0]
+        if method == "PUT":
+            etag, vid = await self.gw.put_object(bucket, key, body, actor=actor)
+            hdrs = {"ETag": f'"{etag}"'}
+            if vid:
+                hdrs["x-amz-version-id"] = vid
+            return "200 OK", hdrs, b""
+        if method == "GET":
+            data = await self.gw.get_object(
+                bucket, key, actor=actor, version_id=version_id
+            )
+            meta = await self.gw.head_object(
+                bucket, key, actor=actor, version_id=version_id
+            )
+            hdrs = {
+                "ETag": f'"{meta["etag"]}"',
+                "Content-Type": "application/octet-stream",
+            }
+            if meta.get("version_id"):
+                hdrs["x-amz-version-id"] = meta["version_id"]
+            return "200 OK", hdrs, data
         if method == "HEAD":
-            meta = await self.gw.head_object(bucket, key)
+            meta = await self.gw.head_object(
+                bucket, key, actor=actor, version_id=version_id
+            )
             return (
                 "200 OK",
                 {"ETag": f'"{meta["etag"]}"', "Content-Length": str(meta["size"])},
                 b"",
             )
         if method == "DELETE":
-            await self.gw.delete_object(bucket, key)
-            return "204 No Content", {}, b""
+            vid = await self.gw.delete_object(
+                bucket, key, actor=actor, version_id=version_id
+            )
+            hdrs = {}
+            if vid:
+                hdrs["x-amz-version-id"] = vid
+                if not version_id:
+                    hdrs["x-amz-delete-marker"] = "true"
+            return "204 No Content", hdrs, b""
         return "405 Method Not Allowed", {}, b""
 
 

@@ -28,11 +28,18 @@ import json
 import secrets
 import time
 
-from ..common.errs import EEXIST, EINVAL, ENOENT
+from ..common.errs import EEXIST, EINVAL, ENOENT, EPERM
 from ..striper import StripedObject, StripePolicy
 
 USERS_OID = "rgw.users"
 BUCKETS_OID = "rgw.buckets"
+
+# ACL permissions (rgw_acl.h RGW_PERM_*), simplified to a hierarchy:
+# FULL_CONTROL > WRITE > READ (the reference treats them as independent
+# bits; the containment ordering is the common-case subset and is
+# documented as the delta).
+PERM_ORDER = {"READ": 1, "WRITE": 2, "FULL_CONTROL": 3}
+ALL_USERS = "*"  # the AllUsers group grantee (anonymous included)
 
 
 class RgwError(Exception):
@@ -102,13 +109,81 @@ class ObjectGateway:
     def _index_oid(self, bucket: str) -> str:
         return f"rgw.bucket.index.{bucket}"
 
-    async def create_bucket(self, bucket: str, owner: str = "") -> None:
+    async def create_bucket(
+        self, bucket: str, owner: str = "", grants: dict | None = None
+    ) -> None:
+        """`grants` maps grantee (uid or "*" AllUsers) -> permission —
+        the RGWAccessControlPolicy essence (rgw_acl.cc); canned-ACL
+        translation lives in the REST layer."""
         buckets = await self._load(BUCKETS_OID)
         if bucket in buckets:
             raise RgwError(EEXIST, "BucketAlreadyExists", bucket)
-        buckets[bucket] = {"owner": owner, "created": time.time()}
+        buckets[bucket] = {
+            "owner": owner,
+            "created": time.time(),
+            "grants": dict(grants or {}),
+            "versioning": "",
+        }
         await self._store(BUCKETS_OID, buckets)
         await self._store(self._index_oid(bucket), {})
+
+    # -- ACLs (RGWAccessControlPolicy; verify_bucket_permission) ---------------
+
+    @staticmethod
+    def _allowed(info: dict, actor: str | None, need: str) -> bool:
+        owner = info.get("owner", "")
+        if not owner:
+            return True  # legacy/open bucket (no owner recorded)
+        if actor == owner:
+            return True  # owner always has FULL_CONTROL
+        grants = info.get("grants", {})
+        need_rank = PERM_ORDER[need]
+        for grantee, perm in grants.items():
+            if grantee == ALL_USERS or grantee == actor:
+                if PERM_ORDER.get(perm, 0) >= need_rank:
+                    return True
+        return False
+
+    async def _require_access(
+        self, bucket: str, actor: str | None, need: str
+    ) -> dict:
+        """Bucket record if `actor` holds `need`, else AccessDenied
+        (rgw_op.cc verify_bucket_permission → -EACCES)."""
+        buckets = await self._load(BUCKETS_OID)
+        if bucket not in buckets:
+            raise RgwError(ENOENT, "NoSuchBucket", bucket)
+        info = buckets[bucket]
+        if not self._allowed(info, actor, need):
+            raise RgwError(EPERM, "AccessDenied", f"{actor} lacks {need} on {bucket}")
+        return info
+
+    async def get_bucket_acl(self, bucket: str, actor: str | None = None) -> dict:
+        info = await self._require_access(bucket, actor, "FULL_CONTROL")
+        return {"owner": info.get("owner", ""), "grants": info.get("grants", {})}
+
+    async def set_bucket_acl(
+        self, bucket: str, grants: dict, actor: str | None = None
+    ) -> None:
+        await self._require_access(bucket, actor, "FULL_CONTROL")
+        buckets = await self._load(BUCKETS_OID)
+        buckets[bucket]["grants"] = dict(grants)
+        await self._store(BUCKETS_OID, buckets)
+
+    # -- versioning (RGWBucketVersioning; rgw_op RGWSetBucketVersioning) -------
+
+    async def set_versioning(
+        self, bucket: str, status: str, actor: str | None = None
+    ) -> None:
+        if status not in ("Enabled", "Suspended"):
+            raise RgwError(EINVAL, "IllegalVersioningConfigurationException", status)
+        await self._require_access(bucket, actor, "WRITE")
+        buckets = await self._load(BUCKETS_OID)
+        buckets[bucket]["versioning"] = status
+        await self._store(BUCKETS_OID, buckets)
+
+    async def get_versioning(self, bucket: str, actor: str | None = None) -> str:
+        info = await self._require_access(bucket, actor, "READ")
+        return info.get("versioning", "")
 
     async def list_buckets(self, owner: str | None = None) -> list[str]:
         buckets = await self._load(BUCKETS_OID)
@@ -138,50 +213,194 @@ class ObjectGateway:
 
     # -- objects ---------------------------------------------------------------
 
-    def _data(self, bucket: str, key: str) -> StripedObject:
-        return StripedObject(
-            self.ioctx, f"rgw.obj.{bucket}/{key}", policy=self.policy
+    def _data(self, bucket: str, key: str, vid: str = "") -> StripedObject:
+        # versioned data lives under its own prefix keyed by version id
+        # ("@" is reserved for snap clones in the RADOS flat namespace)
+        oid = (
+            f"rgw.ver.{vid}.{bucket}/{key}" if vid else f"rgw.obj.{bucket}/{key}"
         )
+        return StripedObject(self.ioctx, oid, policy=self.policy)
+
+    @staticmethod
+    def _latest(entry: dict) -> dict | None:
+        """Latest version record of a versioned entry (None = plain)."""
+        versions = entry.get("versions")
+        return versions[-1] if versions else None
+
+    @staticmethod
+    def _live(entry: dict) -> dict | None:
+        """The record a plain GET serves: the entry itself (plain), or
+        the latest version when it is not a delete marker."""
+        if "versions" not in entry:
+            return entry
+        latest = entry["versions"][-1]
+        return None if latest.get("delete_marker") else latest
 
     async def put_object(
-        self, bucket: str, key: str, data: bytes, meta: dict | None = None
-    ) -> str:
-        """PutObject; returns the etag (RGWPutObj).  `meta` carries user
-        metadata (x-amz-meta-* / X-Object-Meta-*, RGWObjManifest attrs)."""
-        await self._require_bucket(bucket)
-        obj = self._data(bucket, key)
-        await obj.remove()  # overwrite semantics
-        await obj.write(data)
+        self,
+        bucket: str,
+        key: str,
+        data: bytes,
+        meta: dict | None = None,
+        actor: str | None = None,
+    ) -> tuple[str, str]:
+        """PutObject; returns (etag, version_id) — version_id "" on an
+        unversioned bucket (RGWPutObj).  `meta` carries user metadata
+        (x-amz-meta-* / X-Object-Meta-*, RGWObjManifest attrs)."""
+        info = await self._require_access(bucket, actor, "WRITE")
+        versioning = info.get("versioning", "")
         etag = _etag(data)
         index = await self._load(self._index_oid(bucket))
-        entry = {"size": len(data), "etag": etag, "mtime": time.time()}
+        entry = index.get(key, {})
+        record = {"size": len(data), "etag": etag, "mtime": time.time()}
         if meta:
-            entry["meta"] = dict(meta)
-        index[key] = entry
+            record["meta"] = dict(meta)
+        if versioning == "Enabled":
+            vid = secrets.token_hex(8)
+        elif versioning == "Suspended" or "versions" in entry:
+            # suspended (or formerly-versioned): writes land on the
+            # "null" version, replacing any previous null (S3 semantics)
+            vid = "null"
+        else:
+            vid = ""
+        if vid:
+            record["version_id"] = vid
+            versions = [
+                v for v in entry.get("versions", []) if v.get("version_id") != vid
+            ]
+            versions.append(record)
+            index[key] = {"versions": versions}
+            obj = self._data(bucket, key, vid)
+        else:
+            index[key] = record
+            obj = self._data(bucket, key)
+        await obj.remove()  # overwrite semantics
+        await obj.write(data)
         await self._store(self._index_oid(bucket), index)
-        return etag
+        return etag, vid
 
-    async def get_object(self, bucket: str, key: str) -> bytes:
-        await self._require_bucket(bucket)
+    def _resolve(
+        self, entry: dict, key: str, version_id: str
+    ) -> dict:
+        """Pick the version record a read addresses, with S3's errors:
+        latest-is-marker -> NoSuchKey; explicit missing vid -> NoSuchVersion."""
+        if version_id:
+            for v in entry.get("versions", []):
+                if v.get("version_id") == version_id:
+                    if v.get("delete_marker"):
+                        raise RgwError(ENOENT, "MethodNotAllowed", "delete marker")
+                    return v
+            raise RgwError(ENOENT, "NoSuchVersion", version_id)
+        live = self._live(entry)
+        if live is None:
+            raise RgwError(ENOENT, "NoSuchKey", key)
+        return live
+
+    async def get_object(
+        self,
+        bucket: str,
+        key: str,
+        actor: str | None = None,
+        version_id: str = "",
+    ) -> bytes:
+        await self._require_access(bucket, actor, "READ")
         index = await self._load(self._index_oid(bucket))
         if key not in index:
             raise RgwError(ENOENT, "NoSuchKey", key)
-        return await self._data(bucket, key).read()
+        record = self._resolve(index[key], key, version_id)
+        return await self._data(
+            bucket, key, record.get("version_id", "")
+        ).read()
 
-    async def head_object(self, bucket: str, key: str) -> dict:
-        await self._require_bucket(bucket)
+    async def head_object(
+        self,
+        bucket: str,
+        key: str,
+        actor: str | None = None,
+        version_id: str = "",
+    ) -> dict:
+        await self._require_access(bucket, actor, "READ")
         index = await self._load(self._index_oid(bucket))
         if key not in index:
             raise RgwError(ENOENT, "NoSuchKey", key)
-        return index[key]
+        return self._resolve(index[key], key, version_id)
 
-    async def delete_object(self, bucket: str, key: str) -> None:
-        await self._require_bucket(bucket)
+    async def delete_object(
+        self,
+        bucket: str,
+        key: str,
+        actor: str | None = None,
+        version_id: str = "",
+    ) -> str:
+        """DeleteObject.  On a versioning-enabled bucket a plain delete
+        lays down a DELETE MARKER (returns its version id); deleting a
+        specific version removes that version's bytes (RGWDeleteObj)."""
+        info = await self._require_access(bucket, actor, "WRITE")
+        versioning = info.get("versioning", "")
         index = await self._load(self._index_oid(bucket))
-        if key in index:
-            del index[key]
+        entry = index.get(key)
+        if entry is None:
+            # deleting a missing key succeeds (S3), marker only if enabled
+            if versioning != "Enabled":
+                await self._data(bucket, key).remove()
+                return ""
+            entry = {"versions": []}
+        if version_id:
+            versions = entry.get("versions", [])
+            keep = [v for v in versions if v.get("version_id") != version_id]
+            if len(keep) == len(versions):
+                raise RgwError(ENOENT, "NoSuchVersion", version_id)
+            await self._data(bucket, key, version_id).remove()
+            if keep:
+                index[key] = {"versions": keep}
+            else:
+                del index[key]
             await self._store(self._index_oid(bucket), index)
+            return version_id
+        if versioning == "Enabled":
+            vid = secrets.token_hex(8)
+            versions = entry.get("versions", [])
+            versions.append(
+                {"version_id": vid, "delete_marker": True, "mtime": time.time()}
+            )
+            index[key] = {"versions": versions}
+            await self._store(self._index_oid(bucket), index)
+            return vid
+        if "versions" in entry:
+            # suspended: plain delete replaces the null version with a
+            # null delete marker
+            versions = [
+                v for v in entry["versions"] if v.get("version_id") != "null"
+            ]
+            await self._data(bucket, key, "null").remove()
+            versions.append(
+                {"version_id": "null", "delete_marker": True, "mtime": time.time()}
+            )
+            index[key] = {"versions": versions}
+            await self._store(self._index_oid(bucket), index)
+            return "null"
+        del index[key]
+        await self._store(self._index_oid(bucket), index)
         await self._data(bucket, key).remove()
+        return ""
+
+    async def list_object_versions(
+        self, bucket: str, prefix: str = "", actor: str | None = None
+    ) -> list[dict]:
+        """ListObjectVersions: every version + delete marker, newest
+        first per key (RGWListBucketVersions)."""
+        await self._require_access(bucket, actor, "READ")
+        index = await self._load(self._index_oid(bucket))
+        out: list[dict] = []
+        for key in sorted(k for k in index if k.startswith(prefix)):
+            entry = index[key]
+            versions = entry.get("versions")
+            if versions is None:
+                out.append({"key": key, "version_id": "null", "is_latest": True, **entry})
+                continue
+            for i, v in enumerate(reversed(versions)):
+                out.append({"key": key, "is_latest": i == 0, **v})
+        return out
 
     async def list_objects(
         self,
@@ -190,16 +409,22 @@ class ObjectGateway:
         delimiter: str = "",
         marker: str = "",
         max_keys: int = 1000,
+        actor: str | None = None,
     ) -> dict:
         """ListObjects with CommonPrefixes rollup
-        (RGWRados::Bucket::List::list_objects)."""
-        await self._require_bucket(bucket)
+        (RGWRados::Bucket::List::list_objects).  Versioned entries show
+        their latest LIVE version; keys whose latest is a delete marker
+        are hidden (as S3 lists them)."""
+        await self._require_access(bucket, actor, "READ")
         index = await self._load(self._index_oid(bucket))
         keys = sorted(k for k in index if k.startswith(prefix) and k > marker)
         contents: list[dict] = []
         common: list[str] = []
         truncated = False
         for key in keys:
+            live = self._live(index[key])
+            if live is None:
+                continue  # latest is a delete marker
             if len(contents) + len(common) >= max_keys:
                 truncated = True
                 break
@@ -211,7 +436,7 @@ class ObjectGateway:
                     if cp not in common:
                         common.append(cp)
                     continue
-            contents.append({"key": key, **index[key]})
+            contents.append({"key": key, **live})
         return {
             "contents": contents,
             "common_prefixes": common,
@@ -220,8 +445,10 @@ class ObjectGateway:
 
     # -- multipart (RGWCompleteMultipart) --------------------------------------
 
-    async def initiate_multipart(self, bucket: str, key: str) -> str:
-        await self._require_bucket(bucket)
+    async def initiate_multipart(
+        self, bucket: str, key: str, actor: str | None = None
+    ) -> str:
+        await self._require_access(bucket, actor, "WRITE")
         upload_id = secrets.token_hex(8)
         await self._store(
             f"rgw.multipart.{upload_id}",
@@ -245,12 +472,23 @@ class ObjectGateway:
         await self._store(f"rgw.multipart.{upload_id}", meta)
         return etag
 
-    async def complete_multipart(self, upload_id: str) -> str:
+    async def complete_multipart(
+        self, upload_id: str, actor: str | None = None
+    ) -> str:
         meta = await self._load(f"rgw.multipart.{upload_id}")
         if not meta:
             raise RgwError(ENOENT, "NoSuchUpload", upload_id)
         bucket, key = meta["bucket"], meta["key"]
-        obj = self._data(bucket, key)
+        info = await self._require_access(bucket, actor, "WRITE")
+        versioning = info.get("versioning", "")
+        index = await self._load(self._index_oid(bucket))
+        if versioning == "Enabled":
+            vid = secrets.token_hex(8)
+        elif versioning == "Suspended" or "versions" in index.get(key, {}):
+            vid = "null"
+        else:
+            vid = ""
+        obj = self._data(bucket, key, vid)
         await obj.remove()
         off = 0
         md5s = []
@@ -265,8 +503,17 @@ class ObjectGateway:
             await part_obj.remove()
         # S3 multipart etag convention: md5-of-md5s + "-<nparts>"
         etag = f"{hashlib.md5(b''.join(md5s)).hexdigest()}-{len(md5s)}"
-        index = await self._load(self._index_oid(bucket))
-        index[key] = {"size": off, "etag": etag, "mtime": time.time()}
+        record = {"size": off, "etag": etag, "mtime": time.time()}
+        if vid:
+            record["version_id"] = vid
+            entry = index.get(key, {})
+            versions = [
+                v for v in entry.get("versions", []) if v.get("version_id") != vid
+            ]
+            versions.append(record)
+            index[key] = {"versions": versions}
+        else:
+            index[key] = record
         await self._store(self._index_oid(bucket), index)
         await self.ioctx.remove(f"rgw.multipart.{upload_id}")
         return etag

@@ -253,7 +253,7 @@ class SwiftServer:
                 for name, value in headers.items()
                 if name.startswith("x-object-meta-")
             }
-            etag = await self.gw.put_object(container, obj, body, meta=meta)
+            etag, _vid = await self.gw.put_object(container, obj, body, meta=meta)
             return "201 Created", {"ETag": etag}, b""
         if method in ("GET", "HEAD"):
             info = await self.gw.head_object(container, obj)

@@ -22,11 +22,16 @@ from ceph_tpu.rgw.http import S3Server, sign_v2
 
 class _FakeGateway:
     async def user_by_access_key(self, access_key):
-        return {"secret_key": "secret"} if access_key == "AK" else None
+        return (
+            {"uid": "u", "secret_key": "secret"} if access_key == "AK" else None
+        )
 
 
 def _auth(server, method, path, headers, body=b""):
-    return asyncio.run(server._authenticate(method, path, headers, body))
+    """True when the request authenticates (round-5: _authenticate now
+    returns the identity — uid / None anonymous / _BAD_AUTH failure)."""
+    res = asyncio.run(server._authenticate(method, path, headers, body))
+    return res is not S3Server._BAD_AUTH
 
 
 def _signed_headers(method, path, body=b"", date=None, secret="secret"):
