@@ -186,6 +186,43 @@ class IoCtx:
         rep = await self._op(oid, [OSDOp(op=OSDOp.RMXATTR, name=name)])
         _check(rep.result, f"rmxattr {oid}:{name}")
 
+    # -- omap (rados_omap_* / ObjectOperation omap ops; replicated pools
+    # only — EC pools answer -EOPNOTSUPP exactly like the reference) -----------
+
+    async def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
+        from ..common.encoding import encode_kv_map
+
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.OMAPSETVALS, data=encode_kv_map(kv))]
+        )
+        _check(rep.result, f"omap_set {oid}")
+
+    async def omap_get_vals(self, oid: str) -> dict[str, bytes]:
+        from ..common.encoding import decode_kv_map
+
+        rep = await self._op(oid, [OSDOp(op=OSDOp.OMAPGETVALS)])
+        _check(rep.result, f"omap_get_vals {oid}")
+        return decode_kv_map(rep.outdata[0])
+
+    async def omap_get_keys(self, oid: str) -> list[str]:
+        from ..common.encoding import decode_str_list
+
+        rep = await self._op(oid, [OSDOp(op=OSDOp.OMAPGETKEYS)])
+        _check(rep.result, f"omap_get_keys {oid}")
+        return decode_str_list(rep.outdata[0])
+
+    async def omap_rm_keys(self, oid: str, keys: list[str]) -> None:
+        from ..common.encoding import encode_str_list
+
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.OMAPRMKEYS, data=encode_str_list(keys))]
+        )
+        _check(rep.result, f"omap_rm_keys {oid}")
+
+    async def omap_clear(self, oid: str) -> None:
+        rep = await self._op(oid, [OSDOp(op=OSDOp.OMAPCLEAR)])
+        _check(rep.result, f"omap_clear {oid}")
+
     # -- snapshots -------------------------------------------------------------
 
     async def rollback(self, oid: str, snap_id: int, snapc=None) -> None:

@@ -211,6 +211,32 @@ class Decoder:
         self._off = end
 
 
+def encode_kv_map(kv: dict[str, bytes]) -> bytes:
+    """Wire blob for a str->bytes map (xattr dumps, omap key/value sets)."""
+    e = Encoder()
+    e.map_(kv, lambda enc, k: enc.string(k), lambda enc, v: enc.bytes_(v))
+    return e.tobytes()
+
+
+def decode_kv_map(blob: bytes) -> dict[str, bytes]:
+    if not blob:
+        return {}
+    d = Decoder(blob)
+    return d.map_(lambda dec: dec.string(), lambda dec: dec.bytes_())
+
+
+def encode_str_list(items) -> bytes:
+    e = Encoder()
+    e.list_(items, lambda enc, s: enc.string(s))
+    return e.tobytes()
+
+
+def decode_str_list(blob: bytes) -> list[str]:
+    if not blob:
+        return []
+    return Decoder(blob).list_(lambda dec: dec.string())
+
+
 class Encodable:
     """Types with versioned encode/decode (WRITE_CLASS_ENCODER analog).
 

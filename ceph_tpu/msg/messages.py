@@ -73,6 +73,13 @@ class OSDOp(Struct):
     CALL = 18         # object-class method (name = "cls.method", data = input)
     GETXATTRS = 19    # bulk-dump all client xattrs (copy-get attr leg)
     RMXATTR = 20      # remove one client xattr (CEPH_OSD_OP_RMXATTR)
+    # omap (CEPH_OSD_OP_OMAP*): str->bytes KV attached to the object,
+    # replicated pools only (the reference rejects omap on EC pools too)
+    OMAPGETKEYS = 21  # -> encoded str list
+    OMAPGETVALS = 22  # -> encoded kv map (whole omap)
+    OMAPSETVALS = 23  # data = encoded kv map to merge
+    OMAPRMKEYS = 24   # data = encoded str list
+    OMAPCLEAR = 25
 
     FIELDS = [
         ("op", "u8"),
@@ -106,10 +113,14 @@ class PushOp(Struct):
         ("data", "bytes"),
         ("attrs", ("map", "str", "bytes")),
         ("version", "u64"),
+        ("omap", ("map", "str", "bytes")),
     ]
 
-    def __init__(self, oid="", data=b"", attrs=None, version=0):
-        super().__init__(oid=oid, data=data, attrs=attrs or {}, version=version)
+    def __init__(self, oid="", data=b"", attrs=None, version=0, omap=None):
+        super().__init__(
+            oid=oid, data=data, attrs=attrs or {}, version=version,
+            omap=omap or {},
+        )
 
 
 # --- liveness ----------------------------------------------------------------

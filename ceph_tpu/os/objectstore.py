@@ -76,6 +76,8 @@ class ObjectStore:
             self._omap_set(op.coll, op.oid, op.keys)
         elif op.code == tx.OP_OMAP_RMKEYS:
             self._omap_rm(op.coll, op.oid, list(op.keys))
+        elif op.code == tx.OP_OMAP_CLEAR:
+            self._omap_rm(op.coll, op.oid, list(self.omap_get(op.coll, op.oid)))
         elif op.code == tx.OP_MKCOLL:
             self._mkcoll(op.coll)
         elif op.code == tx.OP_RMCOLL:

@@ -30,6 +30,7 @@ OP_MKCOLL = 10
 OP_RMCOLL = 11
 OP_CLONE = 12
 OP_WRITE_APPEND = 13  # append-only fast path (EC shard writes)
+OP_OMAP_CLEAR = 14
 
 # alloc hints (ObjectStore.h CEPH_OSD_ALLOC_HINT_FLAG_*)
 ALLOC_HINT_SEQUENTIAL_WRITE = 1
@@ -118,6 +119,10 @@ class Transaction(Encodable):
         self.ops.append(
             Op(OP_OMAP_RMKEYS, coll, oid, keys={k: b"" for k in keys})
         )
+        return self
+
+    def omap_clear(self, coll: str, oid: str) -> "Transaction":
+        self.ops.append(Op(OP_OMAP_CLEAR, coll, oid))
         return self
 
     def create_collection(self, coll: str) -> "Transaction":

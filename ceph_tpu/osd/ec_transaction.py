@@ -74,6 +74,11 @@ class PGTransaction:
     pre_clone: str | None = None
     # Extra whole-object deletions riding this txn (snap-trimmed clones).
     also_delete: list[str] = field(default_factory=list)
+    # omap mutations (replicated pools only; the PG rejects omap ops on
+    # EC pools with -EOPNOTSUPP as the reference does)
+    omap_set: dict[str, bytes] = field(default_factory=dict)
+    omap_rm: list[str] = field(default_factory=list)
+    omap_clear: bool = False
 
     def write(self, off: int, data: bytes) -> "PGTransaction":
         self.writes.append((off, bytes(data)))

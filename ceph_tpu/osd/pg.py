@@ -26,7 +26,15 @@ import time
 from collections import OrderedDict
 from typing import TYPE_CHECKING, Callable
 
-from ..common.errs import EAGAIN, EBUSY, EINVAL, ENODATA, ENOENT, EPERM
+from ..common.errs import (
+    EAGAIN,
+    EBUSY,
+    EINVAL,
+    ENODATA,
+    ENOENT,
+    EOPNOTSUPP,
+    EPERM,
+)
 from ..common.log import dout
 from ..msg.messages import (
     MBackfillReserve,
@@ -58,6 +66,9 @@ WRITE_OPS = {
     OSDOp.RMXATTR,
     OSDOp.ROLLBACK,
     OSDOp.COPY_FROM,
+    OSDOp.OMAPSETVALS,
+    OSDOp.OMAPRMKEYS,
+    OSDOp.OMAPCLEAR,
 }
 
 # Cache-tier dirty marker (object_info_t FLAG_DIRTY analog): set by client
@@ -66,23 +77,12 @@ WRITE_OPS = {
 DIRTY_ATTR = "cache_dirty"
 
 
-def encode_attrs(attrs: dict[str, bytes]) -> bytes:
-    """Wire blob for a GETXATTRS dump (the copy-get attrs map,
-    /root/reference/src/osd/PrimaryLogPG.cc do_copy_get)."""
-    from ..common.encoding import Encoder
-
-    e = Encoder()
-    e.map_(attrs, lambda enc, k: enc.string(k), lambda enc, v: enc.bytes_(v))
-    return e.tobytes()
-
-
-def decode_attrs(blob: bytes) -> dict[str, bytes]:
-    from ..common.encoding import Decoder
-
-    if not blob:
-        return {}
-    d = Decoder(blob)
-    return d.map_(lambda dec: dec.string(), lambda dec: dec.bytes_())
+# Wire blobs for GETXATTRS dumps and omap ops (the copy-get attrs map,
+# /root/reference/src/osd/PrimaryLogPG.cc do_copy_get).
+from ..common.encoding import (  # noqa: E402 (module-level re-export)
+    decode_kv_map as decode_attrs,
+    encode_kv_map as encode_attrs,
+)
 
 
 def op_is_write(op: OSDOp) -> bool:
@@ -502,6 +502,31 @@ class PG(PGListener):
                 pgt.attrs.setdefault(WHITEOUT_ATTR, None)
             elif op.op == OSDOp.RMXATTR:
                 pgt.attrs[f"_{op.name}"] = None  # staged removal
+            elif op.op in (
+                OSDOp.OMAPSETVALS, OSDOp.OMAPRMKEYS, OSDOp.OMAPCLEAR
+            ):
+                # omap rides replicated pools only (the reference's
+                # pool_requires_alignment / MODE check answers the same)
+                if self.pool.type == POOL_TYPE_ERASURE:
+                    self._inflight_reqids.pop(msg.reqid.key(), None)
+                    reply(self._errored(msg, -EOPNOTSUPP))
+                    return
+                if op.op == OSDOp.OMAPSETVALS:
+                    pgt.omap_set.update(decode_attrs(op.data))
+                elif op.op == OSDOp.OMAPRMKEYS:
+                    from ..common.encoding import decode_str_list
+
+                    for k in decode_str_list(op.data):
+                        # keep op order: a later rm wins over an earlier
+                        # set in this compound op (backends apply rm
+                        # before set)
+                        pgt.omap_set.pop(k, None)
+                        pgt.omap_rm.append(k)
+                else:
+                    pgt.omap_clear = True
+                    pgt.omap_set.clear()
+                    pgt.omap_rm.clear()
+                pgt.attrs.setdefault(WHITEOUT_ATTR, None)
             elif op.op == OSDOp.ROLLBACK:
                 self._start_rollback(msg, reply, int(op.off))
                 return
@@ -667,6 +692,21 @@ class PG(PGListener):
                 # (PrimaryLogPG::do_copy_get), consumed by COPY_FROM and
                 # cache-tier promotion so metadata survives the trip.
                 outdata[i] = encode_attrs(self._client_attrs(target))
+            elif op.op in (OSDOp.OMAPGETKEYS, OSDOp.OMAPGETVALS):
+                if self.pool.type == POOL_TYPE_ERASURE:
+                    result = -EOPNOTSUPP
+                    break
+                coll = shard_coll(self.pgid, -1)
+                try:
+                    omap = self.osd.store.omap_get(coll, target)
+                except Exception:
+                    omap = {}
+                if op.op == OSDOp.OMAPGETVALS:
+                    outdata[i] = encode_attrs(omap)
+                else:
+                    from ..common.encoding import encode_str_list
+
+                    outdata[i] = encode_str_list(sorted(omap))
             elif op.op == OSDOp.CALL:
                 # RD-class object-class method (PrimaryLogPG do_osd_ops
                 # CALL case; WR methods classify as writes in do_op)

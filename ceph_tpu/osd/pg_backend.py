@@ -158,6 +158,9 @@ class PGBackend(abc.ABC):
             txn.write(coll, push.oid, 0, push.data)
             for name, val in push.attrs.items():
                 txn.setattr(coll, push.oid, name, val)
+            omap = getattr(push, "omap", None)
+            if omap:
+                txn.omap_setkeys(coll, push.oid, dict(omap))
         self.store.queue_transaction(txn)
         for oid in oids:
             self.listener.on_local_recover(oid)
@@ -235,6 +238,12 @@ class ReplicatedBackend(PGBackend):
                     txn.rmattr(coll, pgt.oid, name)
                 else:
                     txn.setattr(coll, pgt.oid, name, val)
+            if getattr(pgt, "omap_clear", False):
+                txn.omap_clear(coll, pgt.oid)
+            if getattr(pgt, "omap_rm", None):
+                txn.omap_rmkeys(coll, pgt.oid, list(pgt.omap_rm))
+            if getattr(pgt, "omap_set", None):
+                txn.omap_setkeys(coll, pgt.oid, dict(pgt.omap_set))
         blob = txn.tobytes()
         entry = LogEntry(
             op=LOG_DELETE if pgt.delete else LOG_MODIFY,
@@ -339,6 +348,7 @@ class ReplicatedBackend(PGBackend):
         coll = self._coll()
         data = self.store.read(coll, oid, 0, 0)
         attrs = self.store.getattrs(coll, oid)
+        omap = self.store.omap_get(coll, oid)
         version = 0
         if OI_ATTR in attrs:
             version = ObjectInfo.decode(attrs[OI_ATTR]).version
@@ -348,7 +358,8 @@ class ReplicatedBackend(PGBackend):
                 osd,
                 MOSDPGPush(
                     pgid=self.listener.pgid,
-                    pushes=[PushOp(oid=oid, data=data, attrs=attrs, version=version)],
+                    pushes=[PushOp(oid=oid, data=data, attrs=attrs,
+                                   version=version, omap=omap)],
                     epoch=self.listener.epoch(),
                     from_osd=self.listener.whoami(),
                 ),
@@ -360,6 +371,7 @@ class ReplicatedBackend(PGBackend):
         coll = self._coll()
         data = self.store.read(coll, msg.oid, 0, 0)
         attrs = self.store.getattrs(coll, msg.oid)
+        omap = self.store.omap_get(coll, msg.oid)
         version = 0
         if OI_ATTR in attrs:
             version = ObjectInfo.decode(attrs[OI_ATTR]).version
@@ -367,7 +379,8 @@ class ReplicatedBackend(PGBackend):
             msg.from_osd,
             MOSDPGPush(
                 pgid=msg.pgid,
-                pushes=[PushOp(oid=msg.oid, data=data, attrs=attrs, version=version)],
+                pushes=[PushOp(oid=msg.oid, data=data, attrs=attrs,
+                               version=version, omap=omap)],
                 epoch=self.listener.epoch(),
                 from_osd=self.listener.whoami(),
             ),

@@ -1,0 +1,116 @@
+"""RADOS omap: client KV ops, replication, recovery, EC rejection.
+
+Models the reference's omap surface (CEPH_OSD_OP_OMAP*; librados
+rados_omap_* / ObjectWriteOperation omap ops) over a live cluster:
+set/get/rm/clear round trips, op-order within a compound transaction,
+omap riding recovery pushes to a revived OSD, and the EC-pool rejection
+(-EOPNOTSUPP) the reference enforces.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import Rados, RadosError
+from ceph_tpu.osd.osd import OSD
+
+from test_cluster import fast_conf, start_cluster, stop_cluster, wait_until
+
+
+def test_omap_roundtrip_and_clear():
+    async def run():
+        monmap, mons, osds = await start_cluster(1, 3)
+        client = Rados(monmap)
+        await client.connect()
+        await client.pool_create("om", "replicated", size=2, pg_num=4)
+        io = await client.open_ioctx("om")
+        await io.write_full("obj", b"payload")
+        kv = {"alpha": b"1", "beta": b"\x00\xffraw", "gamma": b""}
+        await io.omap_set("obj", kv)
+        assert await io.omap_get_vals("obj") == kv
+        assert await io.omap_get_keys("obj") == ["alpha", "beta", "gamma"]
+        await io.omap_rm_keys("obj", ["beta", "ghost"])
+        assert await io.omap_get_keys("obj") == ["alpha", "gamma"]
+        await io.omap_set("obj", {"alpha": b"2"})
+        assert (await io.omap_get_vals("obj"))["alpha"] == b"2"
+        await io.omap_clear("obj")
+        assert await io.omap_get_vals("obj") == {}
+        # omap on a bare (never-written) object creates it
+        await io.omap_set("idx", {"k": b"v"})
+        assert await io.omap_get_vals("idx") == {"k": b"v"}
+        # data bytes are untouched by omap traffic
+        assert await io.read("obj") == b"payload"
+        await client.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())
+
+
+def test_omap_rejected_on_ec_pool():
+    async def run():
+        monmap, mons, osds = await start_cluster(1, 4)
+        client = Rados(monmap)
+        await client.connect()
+        rv, rs, _ = await client.mon_command(
+            {
+                "prefix": "osd erasure-code-profile set",
+                "name": "omk2m1",
+                "profile": ["k=2", "m=1", "plugin=tpu"],
+            }
+        )
+        assert rv == 0, rs
+        await client.pool_create("ecp", "erasure", profile="omk2m1", pg_num=2)
+        io = await client.open_ioctx("ecp")
+        with pytest.raises(RadosError):
+            await io.omap_set("o", {"k": b"v"})
+        with pytest.raises(RadosError):
+            await io.omap_get_vals("o")
+        await client.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())
+
+
+def test_omap_survives_osd_restart_via_recovery():
+    """Write omap while an OSD is down; its recovery push must carry the
+    omap (PushOp.omap) so the revived replica serves identical KV."""
+
+    async def run():
+        monmap, mons, osds = await start_cluster(1, 3)
+        client = Rados(monmap)
+        await client.connect()
+        await client.pool_create("rec", "replicated", size=3, pg_num=1)
+        io = await client.open_ioctx("rec")
+        await io.write_full("obj", b"bytes")
+        await io.omap_set("obj", {"site": b"a"})
+        victim = osds[2]
+        victim_store = victim.store
+        await victim.stop()
+        await wait_until(
+            lambda: not mons[0].osdmon.osdmap.is_up(2), 10.0,
+            "victim marked down",
+        )
+        await io.omap_set("obj", {"site": b"b", "extra": b"x"})
+        revived = OSD(2, monmap, conf=fast_conf(2), store=victim_store)
+        await revived.start()
+        await revived.wait_for_up()
+        osds[2] = revived
+
+        def recovered():
+            store = victim_store
+            for coll in store.list_collections():
+                try:
+                    if store.omap_get(coll, "obj") == {
+                        "site": b"b", "extra": b"x"
+                    }:
+                        return True
+                except Exception:
+                    pass
+            return False
+
+        await wait_until(recovered, 10.0, "omap recovered on revived osd")
+        assert await io.omap_get_vals("obj") == {"site": b"b", "extra": b"x"}
+        await client.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())
