@@ -50,19 +50,39 @@ DATE_SKEW_S = 15 * 60
 
 
 class S3Server:
-    def __init__(self, gateway: ObjectGateway, require_auth: bool = False):
+    def __init__(
+        self, gateway: ObjectGateway, require_auth: bool = False,
+        lc_interval: float = 0.0,
+    ):
         self.gw = gateway
         self.require_auth = require_auth
+        self.lc_interval = lc_interval  # seconds; 0 disables the LC worker
         self._server: asyncio.AbstractServer | None = None
+        self._lc_task: asyncio.Task | None = None
         self.addr = ""
 
     async def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self._server = await asyncio.start_server(self._handle, host, port)
         sock = self._server.sockets[0].getsockname()
         self.addr = f"{sock[0]}:{sock[1]}"
+        if self.lc_interval > 0:
+            self._lc_task = asyncio.create_task(self._lc_loop())
         return self.addr
 
+    async def _lc_loop(self) -> None:
+        """Background lifecycle worker (the RGWLC thread; interval is
+        rgw_lc_debug_interval's role in the reference's QA runs)."""
+        while True:
+            await asyncio.sleep(self.lc_interval)
+            try:
+                await self.gw.process_lifecycle()
+            except Exception:
+                pass  # a pool hiccup must not kill the worker
+
     async def shutdown(self) -> None:
+        if self._lc_task is not None:
+            self._lc_task.cancel()
+            self._lc_task = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -207,6 +227,7 @@ class S3Server:
                 "NoSuchVersion": "404 Not Found",
                 "NoSuchUpload": "404 Not Found",
                 "NoSuchUser": "404 Not Found",
+                "NoSuchLifecycleConfiguration": "404 Not Found",
                 "AccessDenied": "403 Forbidden",
                 "MethodNotAllowed": "405 Method Not Allowed",
                 "BucketAlreadyExists": "409 Conflict",
@@ -234,6 +255,8 @@ class S3Server:
             return await self._acl_op(method, bucket, headers, actor)
         if "versioning" in query:
             return await self._versioning_op(method, bucket, body, actor)
+        if "lifecycle" in query:
+            return await self._lifecycle_op(method, bucket, body, actor)
         if "versions" in query and method == "GET":
             versions = await self.gw.list_object_versions(
                 bucket, prefix=query.get("prefix", [""])[0], actor=actor
@@ -318,6 +341,47 @@ class S3Server:
                 bucket, self._canned_grants(headers), actor=actor
             )
             return "200 OK", {}, b""
+        return "405 Method Not Allowed", {}, b""
+
+    async def _lifecycle_op(self, method: str, bucket: str, body: bytes, actor):
+        """?lifecycle subresource (RGWPutLC/RGWGetLC): expiration rules
+        as <Rule><ID/><Prefix/><Expiration><Days/></Expiration></Rule>."""
+        import re
+
+        if method == "GET":
+            rules = await self.gw.get_lifecycle(bucket, actor=actor)
+            xml = "".join(
+                f"<Rule><ID>{_x(r['id'])}</ID><Prefix>{_x(r['prefix'])}</Prefix>"
+                f"<Status>Enabled</Status><Expiration><Days>{r['days']}</Days>"
+                f"</Expiration></Rule>"
+                for r in rules
+            )
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<LifecycleConfiguration>{xml}</LifecycleConfiguration>".encode(),
+            )
+        if method == "PUT":
+            rules = []
+            for rule in re.findall(rb"<Rule>(.*?)</Rule>", body, re.S):
+                def field(tag, blob=rule):
+                    m = re.search(
+                        rb"<" + tag + rb">\s*(.*?)\s*</" + tag + rb">", blob, re.S
+                    )
+                    return m.group(1).decode() if m else ""
+
+                days = field(rb"Days")
+                if not days:
+                    continue
+                rules.append(
+                    {"id": field(rb"ID"), "prefix": field(rb"Prefix"),
+                     "days": days}
+                )
+            await self.gw.set_lifecycle(bucket, rules, actor=actor)
+            return "200 OK", {}, b""
+        if method == "DELETE":
+            await self.gw.set_lifecycle(bucket, [], actor=actor)
+            return "204 No Content", {}, b""
         return "405 Method Not Allowed", {}, b""
 
     async def _versioning_op(self, method: str, bucket: str, body: bytes, actor):

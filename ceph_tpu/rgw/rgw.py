@@ -169,6 +169,61 @@ class ObjectGateway:
         buckets[bucket]["grants"] = dict(grants)
         await self._store(BUCKETS_OID, buckets)
 
+    # -- lifecycle (RGWLC / RGWPutLC; cls_lc essence) --------------------------
+
+    async def set_lifecycle(
+        self, bucket: str, rules: list[dict], actor: str | None = None
+    ) -> None:
+        """rules: [{"id", "prefix", "days"}] — expiration-only scope (the
+        reference's transition rules need storage classes, out of scope)."""
+        await self._require_access(bucket, actor, "FULL_CONTROL")
+        for r in rules:
+            if int(r.get("days", -1)) < 0:
+                raise RgwError(EINVAL, "InvalidArgument", "Days must be >= 0")
+        buckets = await self._load(BUCKETS_OID)
+        buckets[bucket]["lifecycle"] = [
+            {"id": r.get("id", ""), "prefix": r.get("prefix", ""),
+             "days": int(r["days"])}
+            for r in rules
+        ]
+        await self._store(BUCKETS_OID, buckets)
+
+    async def get_lifecycle(
+        self, bucket: str, actor: str | None = None
+    ) -> list[dict]:
+        info = await self._require_access(bucket, actor, "READ")
+        rules = info.get("lifecycle", [])
+        if not rules:
+            raise RgwError(ENOENT, "NoSuchLifecycleConfiguration", bucket)
+        return rules
+
+    async def process_lifecycle(self, now: float | None = None) -> int:
+        """One LC pass over every bucket (RGWLC::process): expire objects
+        whose latest mtime is older than a matching rule's Days.  On a
+        versioning-enabled bucket expiration lays a delete marker, as S3
+        does.  Returns the number of keys expired."""
+        now = time.time() if now is None else now
+        buckets = await self._load(BUCKETS_OID)
+        expired = 0
+        for bucket, info in buckets.items():
+            rules = info.get("lifecycle")
+            if not rules:
+                continue
+            owner = info.get("owner", "") or None
+            index = await self._load(self._index_oid(bucket))
+            for key in sorted(index):
+                live = self._live(index[key])
+                if live is None:
+                    continue
+                for rule in rules:
+                    if not key.startswith(rule["prefix"]):
+                        continue
+                    if now - live.get("mtime", now) >= rule["days"] * 86400:
+                        await self.delete_object(bucket, key, actor=owner)
+                        expired += 1
+                        break
+        return expired
+
     # -- versioning (RGWBucketVersioning; rgw_op RGWSetBucketVersioning) -------
 
     async def set_versioning(
