@@ -94,14 +94,18 @@ class HCtx:
         getattr_fn: Callable[[str], bytes | None],
         entity: str = "",
         writable: bool = False,
+        omap_fn: Callable[[], dict] | None = None,
     ):
         self._exists = exists
         self._read_fn = read_fn
         self._getattr_fn = getattr_fn
+        self._omap_fn = omap_fn  # None: pool has no omap (EC)
         self.entity = entity
         self.writable = writable
         # staged state (read-your-writes overlay; None value = removed)
         self.attrs: dict[str, bytes | None] = {}
+        self.omap: dict[str, bytes | None] = {}
+        self.omap_cleared = False
         self.data: bytes | None = None
         # whole-object view already folded into the enclosing transaction
         # by an earlier method in the same op (set by the PG)
@@ -129,6 +133,32 @@ class HCtx:
             return self.attrs[name]
         return self._getattr_fn(name)
 
+    # -- omap (cls_cxx_map_* family; cls_rgw's bucket-index substrate) ---------
+
+    def _omap_view(self) -> dict[str, bytes]:
+        if self._omap_fn is None:
+            raise ClsError(EOPNOTSUPP, "omap on an EC pool")
+        base = {} if self.omap_cleared else dict(self._omap_fn())
+        for k, v in self.omap.items():
+            if v is None:
+                base.pop(k, None)
+            else:
+                base[k] = v
+        return base
+
+    def map_get_val(self, key: str) -> bytes:
+        """cls_cxx_map_get_val; raises ENOENT when absent."""
+        view = self._omap_view()
+        if key not in view:
+            raise ClsError(ENOENT, f"omap key {key!r}")
+        return view[key]
+
+    def map_get_keys(self) -> list[str]:
+        return sorted(self._omap_view())
+
+    def map_get_all(self) -> dict[str, bytes]:
+        return self._omap_view()
+
     # -- writes (WR methods only) ---------------------------------------------
 
     def _need_wr(self) -> None:
@@ -154,5 +184,36 @@ class HCtx:
         self._need_wr()
         self.attrs[name] = None
 
+    def map_set_val(self, key: str, value: bytes) -> None:
+        """cls_cxx_map_set_val."""
+        self._need_wr()
+        if self._omap_fn is None:
+            raise ClsError(EOPNOTSUPP, "omap on an EC pool")
+        self.omap[key] = bytes(value)
+        self.created = True
+
+    def map_set_vals(self, kv: dict[str, bytes]) -> None:
+        for k, v in kv.items():
+            self.map_set_val(k, v)
+
+    def map_remove_key(self, key: str) -> None:
+        self._need_wr()
+        if self._omap_fn is None:
+            raise ClsError(EOPNOTSUPP, "omap on an EC pool")
+        self.omap[key] = None
+
+    def map_clear(self) -> None:
+        self._need_wr()
+        if self._omap_fn is None:
+            raise ClsError(EOPNOTSUPP, "omap on an EC pool")
+        self.omap_cleared = True
+        self.omap.clear()
+
     def dirty(self) -> bool:
-        return bool(self.attrs) or self.data is not None or self.created
+        return (
+            bool(self.attrs)
+            or bool(self.omap)
+            or self.omap_cleared
+            or self.data is not None
+            or self.created
+        )

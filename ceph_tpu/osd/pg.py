@@ -572,6 +572,18 @@ class PG(PGListener):
                 for k, v in hctx.attrs.items():
                     pgt.attrs[f"_{k}"] = v
                 hctx.attrs.clear()
+                if hctx.omap_cleared:
+                    pgt.omap_clear = True
+                    pgt.omap_set.clear()
+                    pgt.omap_rm.clear()
+                    hctx.omap_cleared = False
+                for k, v in hctx.omap.items():
+                    if v is None:
+                        pgt.omap_set.pop(k, None)
+                        pgt.omap_rm.append(k)
+                    else:
+                        pgt.omap_set[k] = v
+                hctx.omap.clear()
                 if hctx.data is not None:
                     pgt.write(0, hctx.data)
                     pgt.truncate = len(hctx.data)
@@ -944,12 +956,29 @@ class PG(PGListener):
                 return pgt.attrs[f"_{name}"]  # None == removed
             return self._getxattr(oid, f"_{name}")
 
+        def omap_fn() -> dict:
+            # on-store omap overlaid with what THIS op already staged
+            # (clear -> rm -> set, the backends' apply order)
+            coll = shard_coll(self.pgid, -1)
+            try:
+                base = dict(self.osd.store.omap_get(coll, oid))
+            except Exception:
+                base = {}
+            if pgt is not None:
+                if pgt.omap_clear:
+                    base = {}
+                for k in pgt.omap_rm:
+                    base.pop(k, None)
+                base.update(pgt.omap_set)
+            return base
+
         return ClsHCtx(
             exists=exists,
             read_fn=read_fn,
             getattr_fn=getattr_fn,
             entity=msg.reqid.client,
             writable=writable,
+            omap_fn=None if self.pool.type == POOL_TYPE_ERASURE else omap_fn,
         )
 
     # -- cache tiering (PrimaryLogPG maybe_handle_cache / TierAgentState) ------

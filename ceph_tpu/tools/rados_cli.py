@@ -54,6 +54,21 @@ async def _run(args) -> int:
         elif args.op == "ls":
             for oid in await ioctx.list_objects():
                 print(oid)
+        elif args.op == "listomapkeys":
+            for k in await ioctx.omap_get_keys(args.args[0]):
+                print(k)
+        elif args.op == "listomapvals":
+            for k, v in sorted((await ioctx.omap_get_vals(args.args[0])).items()):
+                print(f"{k}\n       value ({len(v)} bytes) :")
+                sys.stdout.buffer.write(v + b"\n")
+        elif args.op == "setomapval":
+            await ioctx.omap_set(
+                args.args[0], {args.args[1]: args.args[2].encode()}
+            )
+        elif args.op == "rmomapkey":
+            await ioctx.omap_rm_keys(args.args[0], [args.args[1]])
+        elif args.op == "clearomap":
+            await ioctx.omap_clear(args.args[0])
         elif args.op == "cache-flush":
             # rados cache-flush: write a dirty cache-tier object back
             await ioctx.cache_flush(args.args[0])
@@ -108,7 +123,11 @@ def main() -> None:
     p.add_argument("-p", "--pool", default="")
     p.add_argument("--cluster-file", default=CLUSTER_FILE)
     p.add_argument("--size", type=int, default=3, help="pool size for mkpool")
-    p.add_argument("op", help="put|get|rm|stat|ls|bench|lspools|mkpool|cache-flush|cache-evict")
+    p.add_argument(
+        "op",
+        help="put|get|rm|stat|ls|bench|lspools|mkpool|cache-flush|cache-evict"
+        "|listomapkeys|listomapvals|setomapval|rmomapkey|clearomap",
+    )
     p.add_argument("args", nargs="*")
     sys.exit(asyncio.run(_run(p.parse_args())))
 
