@@ -421,6 +421,13 @@ class Monitor(Dispatcher):
                 if len(self.quorum) < self.monmap.size():
                     out = self.monmap.size() - len(self.quorum)
                     checks["MON_DOWN"] = f"{out} monitor(s) out of quorum"
+                fsmap = self.mdsmon.map
+                if fsmap.fs_name and not fsmap.active_name:
+                    # a filesystem with no rank 0 serves nothing
+                    # (MDSMonitor MDS_ALL_DOWN health check)
+                    checks["MDS_ALL_DOWN"] = (
+                        f"fs {fsmap.fs_name} has no active MDS"
+                    )
                 reply(
                     0,
                     "",

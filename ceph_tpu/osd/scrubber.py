@@ -157,6 +157,19 @@ class PgScrubber:
                     hinfo = HashInfo.decode(attrs[HINFO_ATTR])
                     entry["hinfo_digest"] = hinfo.get_chunk_hash(shard)
                     entry["hinfo_size"] = hinfo.get_total_chunk_size()
+                else:
+                    # replicated deep scrub covers omap too (be_deep_scrub
+                    # omap_digest): crc over the canonical KV encoding
+                    from ..common.encoding import encode_kv_map
+
+                    try:
+                        omap = store.omap_get(coll, oid)
+                    except StoreError:
+                        omap = {}
+                    if omap:
+                        entry["omap_digest"] = crc32c(
+                            encode_kv_map(omap), HashInfo.SEED
+                        )
             out[oid] = entry
         return out
 
@@ -337,7 +350,7 @@ class PgScrubber:
             if osd != PG_NONE
         }
         digests = [
-            (e.get("digest"), e.get("size"))
+            (e.get("digest"), e.get("size"), e.get("omap_digest"))
             for osd, e in sorted(entries.items())
             if e is not None
         ]
@@ -348,8 +361,11 @@ class PgScrubber:
             if e is None:
                 if not self._object_expected_missing(oid, osd):
                     bad[osd] = "missing"
-            elif (e.get("digest"), e.get("size")) != auth:
-                bad[osd] = "digest/size mismatch vs authoritative copy"
+            elif (e.get("digest"), e.get("size"), e.get("omap_digest")) != auth:
+                if e.get("omap_digest") != auth[2]:
+                    bad[osd] = "omap digest mismatch vs authoritative copy"
+                else:
+                    bad[osd] = "digest/size mismatch vs authoritative copy"
         return bad
 
     def _object_expected_missing(self, oid: str, osd: int) -> bool:

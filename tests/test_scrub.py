@@ -184,3 +184,54 @@ class TestScrubRobustness:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+    def test_deep_scrub_detects_and_repairs_omap_divergence(self):
+        """Deep scrub covers omap (be_deep_scrub omap_digest): a replica
+        whose omap silently diverges is flagged and repair restores it
+        (recovery pushes carry omap since round 5)."""
+        from test_cluster import start_cluster
+
+        async def run():
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("rpo", "replicated", size=3, pg_num=1)
+            ioctx = await client.open_ioctx("rpo")
+            await ioctx.write_full("oobj", b"bytes")
+            good = {"k1": b"v1", "k2": b"v2"}
+            await ioctx.omap_set("oobj", good)
+            await wait_until(
+                lambda: sum(
+                    1 for o in osds
+                    for coll in o.store.list_collections()
+                    if o.store.exists(coll, "oobj")
+                ) == 3,
+                3.0,
+                "3 replicas",
+            )
+            osd, pg = find_primary_pg(osds, "rpo")
+            coll = shard_coll(pg.pgid, -1)
+            # a NON-primary replica's omap diverges (majority must win)
+            victim = next(o for o in osds if o is not osd and any(
+                o.store.exists(c, "oobj") for c in o.store.list_collections()
+            ))
+            victim.store.queue_transaction(
+                Transaction().omap_setkeys(coll, "oobj", {"k1": b"EVIL"})
+            )
+            # shallow scrub cannot see it; deep flags exactly the victim
+            res_shallow = await run_scrub(pg, deep=False)
+            assert res_shallow.clean
+            res = await run_scrub(pg, deep=True)
+            assert not res.clean
+            assert list(res.inconsistent["oobj"]) == [victim.whoami]
+            assert "omap" in res.inconsistent["oobj"][victim.whoami]
+            res2 = await run_scrub(pg, deep=True, repair=True)
+            assert res2.repaired == 1
+            await wait_until(lambda: pg.is_clean, 5.0, "repair recovery")
+            assert victim.store.omap_get(coll, "oobj") == good
+            res3 = await run_scrub(pg, deep=True)
+            assert res3.clean
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
