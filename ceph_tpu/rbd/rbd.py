@@ -34,6 +34,7 @@ from ..cls import client as cls_client
 from ..common.errs import EBUSY, EEXIST, EINVAL, ENOENT
 
 DIRECTORY_OID = "rbd_directory"
+CHILDREN_OID = "rbd_children"  # parent "<id>@<snap_id>" -> [child ids]
 DEFAULT_ORDER = 22  # 4 MiB objects
 
 
@@ -83,12 +84,87 @@ class RBD:
     async def list(self) -> list[str]:
         return sorted(await self._read_directory())
 
+    async def _read_children(self) -> dict[str, list[str]]:
+        try:
+            raw = await self.ioctx.read(CHILDREN_OID)
+            return json.loads(raw.decode() or "{}")
+        except Exception:
+            return {}
+
+    async def _write_children(self, d: dict[str, list[str]]) -> None:
+        await self.ioctx.write_full(
+            CHILDREN_OID, json.dumps({k: v for k, v in d.items() if v}).encode()
+        )
+
+    async def clone(
+        self, parent_name: str, snap_name: str, child_name: str,
+        order: int | None = None,
+    ) -> None:
+        """rbd clone (librbd::clone): a copy-on-write child of a
+        PROTECTED parent snapshot.  The child starts as pure metadata —
+        reads fall through to the parent's snap until copy-up."""
+        parent = await self.open(parent_name)
+        snap = parent._snap_by_name(snap_name)
+        if not snap.get("protected"):
+            raise RbdError(EINVAL, f"snapshot {snap_name!r} is not protected")
+        directory = await self._read_directory()
+        if child_name in directory:
+            raise RbdError(EEXIST, f"image {child_name!r} exists")
+        child_id = secrets.token_hex(8)
+        overlap = snap.get("size", parent.size)
+        header = {
+            "id": child_id,
+            "size": overlap,
+            "max_size": overlap,
+            "order": order if order is not None else parent.order,
+            "snaps": [],
+            "parent": {
+                "image_id": parent.id,
+                "image_name": parent_name,
+                "snap_id": snap["id"],
+                "snap_name": snap_name,
+                "overlap": overlap,
+            },
+        }
+        await self.ioctx.write_full(
+            f"rbd_header.{child_id}", json.dumps(header).encode()
+        )
+        directory[child_name] = child_id
+        await self._write_directory(directory)
+        children = await self._read_children()
+        children.setdefault(f"{parent.id}@{snap['id']}", []).append(child_id)
+        await self._write_children(children)
+
+    async def children(self, parent_name: str, snap_name: str) -> list[str]:
+        """rbd children: names of clones of this snapshot."""
+        parent = await self.open(parent_name)
+        snap = parent._snap_by_name(snap_name)
+        ids = (await self._read_children()).get(
+            f"{parent.id}@{snap['id']}", []
+        )
+        directory = await self._read_directory()
+        by_id = {v: k for k, v in directory.items()}
+        return sorted(by_id.get(i, i) for i in ids)
+
     async def remove(self, name: str) -> None:
         directory = await self._read_directory()
         image_id = directory.get(name)
         if image_id is None:
             raise RbdError(ENOENT, f"image {name!r} not found")
         img = await self.open(name)
+        if any(s.get("protected") for s in img.header["snaps"]):
+            raise RbdError(
+                EBUSY, f"image {name!r} has protected snapshots"
+            )
+        if img.header.get("parent"):
+            # a clone: unregister from the parent's children first
+            p = img.header["parent"]
+            children = await self._read_children()
+            key = f"{p['image_id']}@{p['snap_id']}"
+            children[key] = [
+                c for c in children.get(key, []) if c != image_id
+            ]
+            await self._write_children(children)
         span = max(img.size, img.header.get("max_size", img.size))
         objects = (span + img.object_bytes - 1) // img.object_bytes
         for objno in range(objects):
@@ -250,7 +326,10 @@ class Image:
             raise RbdError(EINVAL, "write past end of image")
         snapc = self._snapc()
         cursor = 0
+        has_parent = self.header.get("parent") is not None
         for objno, obj_off, ln in self._extents(off, len(data)):
+            if has_parent:
+                await self._copy_up(objno)
             await self.ioctx.write(
                 self._data_oid(objno),
                 data[cursor : cursor + ln],
@@ -273,8 +352,9 @@ class Image:
         return b"".join(parts)
 
     async def _read_object(self, objno: int, snap_id: int) -> bytes:
-        """Block reads zero-fill absent objects/holes (ObjectRequest's
-        read-from-parent/zero semantics, flattened)."""
+        """Block reads zero-fill absent objects/holes; an absent object
+        of a CLONE falls through to the parent snapshot within the
+        overlap (ObjectRequest's read-from-parent semantics)."""
         from ..client.rados import RadosError
 
         try:
@@ -282,7 +362,52 @@ class Image:
         except RadosError as e:
             if e.errno != -ENOENT:
                 raise
+            return await self._read_parent_object(objno)
+
+    async def _parent(self) -> "Image | None":
+        p = self.header.get("parent")
+        if p is None:
+            return None
+        if getattr(self, "_parent_img", None) is None:
+            self._parent_img = Image(
+                self.ioctx, p.get("image_name", ""), p["image_id"]
+            )
+            await self._parent_img._load_header()
+        return self._parent_img
+
+    async def _read_parent_object(self, objno: int) -> bytes:
+        """The child's view of one object as served by the parent snap,
+        clipped to the overlap (zeros past it)."""
+        p = self.header.get("parent")
+        if p is None:
             return b""
+        start = objno * self.object_bytes
+        if start >= p["overlap"]:
+            return b""
+        parent = await self._parent()
+        data = await parent.read(
+            start,
+            min(self.object_bytes, p["overlap"] - start),
+            snap_name=p["snap_name"],
+        )
+        return data
+
+    async def _copy_up(self, objno: int) -> None:
+        """First write to a parent-backed object copies the parent's
+        bytes into the child (ObjectRequest copy-up), so the write lands
+        on a child-owned object and the parent stays untouched."""
+        from ..client.rados import RadosError
+
+        oid = self._data_oid(objno)
+        try:
+            await self.ioctx.stat(oid)
+            return  # child already owns the object
+        except RadosError as e:
+            if e.errno != -ENOENT:
+                raise
+        base = await self._read_parent_object(objno)
+        if base.rstrip(b"\x00"):
+            await self.ioctx.write(oid, base, 0, snapc=self._snapc())
 
     async def resize(self, new_size: int) -> None:
         """librbd::resize; shrinking drops whole objects past the end.
@@ -308,6 +433,11 @@ class Image:
                     pass
         self.header["size"] = new_size
         self.header["max_size"] = max(self.header.get("max_size", old), new_size)
+        parent = self.header.get("parent")
+        if parent is not None and new_size < parent["overlap"]:
+            # shrinking a clone shrinks what the parent still backs
+            # (librbd trims the parent overlap on resize)
+            parent["overlap"] = new_size
         await self._save_header()
 
     # -- snapshots ---------------------------------------------------------------
@@ -362,6 +492,45 @@ class Image:
         self.header["size"] = snap.get("size", self.size)
         await self._save_header()
 
+    async def snap_protect(self, name: str) -> None:
+        """rbd snap protect: required before cloning; a protected snap
+        cannot be removed (librbd snap_protect)."""
+        snap = self._snap_by_name(name)
+        snap["protected"] = True
+        await self._save_header()
+
+    async def snap_unprotect(self, name: str) -> None:
+        """rbd snap unprotect: refused while clones of the snap exist
+        (librbd snap_unprotect scans rbd_children)."""
+        snap = self._snap_by_name(name)
+        rbd = RBD(self.ioctx)
+        if (await rbd._read_children()).get(f"{self.id}@{snap['id']}"):
+            raise RbdError(EBUSY, f"snapshot {name!r} has clones")
+        snap["protected"] = False
+        await self._save_header()
+
+    async def snap_is_protected(self, name: str) -> bool:
+        return bool(self._snap_by_name(name).get("protected"))
+
+    async def flatten(self) -> None:
+        """rbd flatten: copy every parent-backed object into the child,
+        then sever the parent link (librbd flatten; the child becomes a
+        standalone image and the snap can be unprotected)."""
+        p = self.header.get("parent")
+        if p is None:
+            raise RbdError(EINVAL, f"image {self.name!r} has no parent")
+        objects = (p["overlap"] + self.object_bytes - 1) // self.object_bytes
+        for objno in range(objects):
+            await self._copy_up(objno)
+        rbd = RBD(self.ioctx)
+        children = await rbd._read_children()
+        key = f"{p['image_id']}@{p['snap_id']}"
+        children[key] = [c for c in children.get(key, []) if c != self.id]
+        await rbd._write_children(children)
+        del self.header["parent"]
+        self._parent_img = None
+        await self._save_header()
+
     async def snap_remove(self, name: str) -> None:
         """librbd snap_remove: per-object server-side snap trim — the OSD
         drops the snap from each clone's coverage and deletes clones no
@@ -370,6 +539,8 @@ class Image:
         from ..client.rados import RadosError
 
         snap = self._snap_by_name(name)
+        if snap.get("protected"):
+            raise RbdError(EBUSY, f"snapshot {name!r} is protected")
         span = max(self.size, self.header.get("max_size", self.size))
         objects = (span + self.object_bytes - 1) // self.object_bytes
         for objno in range(objects):

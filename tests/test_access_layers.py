@@ -108,6 +108,58 @@ class TestRbd:
 
         asyncio.run(run())
 
+    def test_clone_layering_copyup_flatten(self):
+        """librbd layering: clone from a protected snap, COW read-through,
+        copy-up on first write, children accounting, flatten severs the
+        parent (librbd::clone / ObjectRequest copy-up)."""
+
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rbdc")
+            rbd = RBD(ioctx)
+            await rbd.create("base", 1 << 18, order=16)  # 4 x 64 KiB objects
+            base = await rbd.open("base")
+            golden = bytes([7]) * 65536 + bytes([9]) * 65536
+            await base.write(0, golden)
+            await base.snap_create("gold")
+            # clone requires protection
+            with pytest.raises(RbdError):
+                await rbd.clone("base", "gold", "child")
+            await base.snap_protect("gold")
+            assert await base.snap_is_protected("gold")
+            await rbd.clone("base", "gold", "child")
+            assert await rbd.children("base", "gold") == ["child"]
+            # protected snap can be neither removed nor unprotected
+            with pytest.raises(RbdError):
+                await base.snap_remove("gold")
+            with pytest.raises(RbdError):
+                await base.snap_unprotect("gold")
+            # the parent keeps changing; the child still sees the snap
+            await base.write(0, bytes([1]) * 65536)
+            child = await rbd.open("child")
+            assert await child.read(0, len(golden)) == golden
+            # copy-up: child write diverges, parent snap untouched
+            await child.write(100, b"CHILD")
+            got = await child.read(0, len(golden))
+            assert got[100:105] == b"CHILD"
+            assert got[:100] == golden[:100] and got[105:] == golden[105:]
+            assert await base.read(0, 65536, snap_name="gold") == bytes([7]) * 65536
+            # second object still parent-backed (no copy-up happened there)
+            assert (await child.read(65536, 65536)) == bytes([9]) * 65536
+            # flatten: child stands alone, snap becomes unprotectable
+            await child.flatten()
+            assert await rbd.children("base", "gold") == []
+            await base.snap_unprotect("gold")
+            await base.snap_remove("gold")
+            assert (await child.read(65536, 65536)) == bytes([9]) * 65536
+            assert (await child.read(0, 105))[100:105] == b"CHILD"
+            # clone removal unregisters cleanly
+            await rbd.remove("child")
+            assert await rbd.list() == ["base"]
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
     def test_snapshots_cow(self):
         async def run():
             monmap, mons, osds, client, ioctx = await make_client("rbds")
