@@ -52,6 +52,15 @@ class Objecter(Dispatcher):
         stack: str = "posix",
     ):
         self.name = name
+        # Per-INSTANCE identity for osd_reqid_t: the reference's clients
+        # carry a mon-assigned global_id in entity_name_t, so two
+        # processes (or sequential runs) named "client.foo" never share
+        # reqids.  Without the nonce, a second process reusing the name
+        # restarts tids at 1 and the PG's dup detection would serve it
+        # the FIRST process's remembered replies instead of applying.
+        import secrets
+
+        self.reqid_name = f"{name}.{secrets.token_hex(4)}"
         self.msgr = Messenger(
             name, auth=auth, secure=secure, compress=compress, stack=stack
         )
@@ -114,7 +123,9 @@ class Objecter(Dispatcher):
                 cookie=msg.cookie,
                 payload=bytes(ack_payload),
                 is_ack=1,
-                watcher=self.name,
+                # the instance identity the watch REGISTERED under
+                # (reqid.client): the PG's pending-ack set is keyed on it
+                watcher=self.reqid_name,
             )
 
             async def _send_ack() -> None:
@@ -164,7 +175,7 @@ class Objecter(Dispatcher):
         reply.  Raises TimeoutError past `timeout`.  `ps` targets a
         specific PG instead of hashing `oid` (pg ops like PGLS)."""
         self._tid += 1
-        reqid = ReqId(client=self.name, tid=self._tid)
+        reqid = ReqId(client=self.reqid_name, tid=self._tid)
         deadline = time.monotonic() + timeout
         while True:
             remaining = deadline - time.monotonic()

@@ -38,6 +38,9 @@ _PREFIXES = [
     "osd dump",
     "osd out",
     "osd in",
+    "fs new",
+    "fs rm",
+    "fs status",
     "quorum_status",
     "status",
 ]
@@ -75,6 +78,10 @@ def build_cmd(words: list[str]) -> dict:
                 cmd["id"], cmd["weight"] = rest[0], rest[1]
             elif prefix in ("osd out", "osd in"):
                 cmd["id"] = rest[0]
+            elif prefix == "fs new":
+                for i, k in enumerate(["fs_name", "metadata", "data"]):
+                    if i < len(rest):
+                        cmd[k] = rest[i]
             elif prefix.startswith("osd erasure-code-profile"):
                 if rest:
                     cmd["name"] = rest[0]

@@ -114,8 +114,10 @@ class TestLock:
             info = await cls_client.get_lock_info(io, "obj", "guard")
             assert info["type"] == "exclusive" and len(info["holders"]) == 1
             # break the holder's lock from the other client, then acquire
+            # (the holder entity carries the instance nonce: read it back)
+            holder_entity = info["holders"][0][0]
             await cls_client.break_lock(
-                oio, "obj", "guard", entity="client.admin", cookie="c1"
+                oio, "obj", "guard", entity=holder_entity, cookie="c1"
             )
             await cls_client.lock(oio, "obj", "guard", cookie="c2")
             await other.shutdown()

@@ -341,15 +341,19 @@ class TestExclusiveLock:
             with pytest.raises(RbdError):
                 await timg.lock_acquire(cookie="c-taker")
             holders = await timg.lock_owners()
-            assert holders == [
-                {"entity": "client.owner", "cookie": "c-owner",
-                 "description": "rbd image disk"}
-            ]
+            assert len(holders) == 1
+            # entity is the owner's per-instance identity (name + nonce)
+            assert holders[0]["entity"] == owner.objecter.reqid_name
+            assert holders[0]["cookie"] == "c-owner"
+            assert holders[0]["description"] == "rbd image disk"
             # the owner "dies" (no unlock); failover breaks + acquires
             await owner.shutdown()
-            await timg.break_lock("client.owner", cookie="c-owner")
+            await timg.break_lock(holders[0]["entity"], cookie="c-owner")
             await timg.lock_acquire(cookie="c-taker")
-            assert (await timg.lock_owners())[0]["entity"] == "client.taker"
+            assert (
+                (await timg.lock_owners())[0]["entity"]
+                == taker.objecter.reqid_name
+            )
             await timg.lock_release(cookie="c-taker")
             await taker.shutdown()
             await stop_cluster(mons, osds)

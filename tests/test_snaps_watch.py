@@ -158,7 +158,10 @@ class TestWatchNotify:
             assert got1 == [b"hello watchers"]
             assert got2 == [b"hello watchers"]
             assert res["timeouts"] == []
-            k1, k2 = f"client.w1/{c1}", f"client.w2/{c2}"
+            # watcher keys carry the client's per-instance identity
+            # (entity + nonce, the reference's name.global_id shape)
+            k1 = f"{w1.objecter.reqid_name}/{c1}"
+            k2 = f"{w2.objecter.reqid_name}/{c2}"
             assert set(res["acks"]) == {k1, k2}
             assert bytes.fromhex(res["acks"][k1]) == b"ack-from-w1"
 
@@ -192,7 +195,9 @@ class TestWatchNotify:
             watcher.objecter.ms_dispatch = lambda conn, msg: True
 
             res = await io_n.notify("o", b"anyone there?", timeout_ms=500)
-            assert res["timeouts"] == [f"client.dead/{cookie}"]
+            assert res["timeouts"] == [
+                f"{watcher.objecter.reqid_name}/{cookie}"
+            ]
             assert res["acks"] == {}
 
             for c in (watcher, notifier):

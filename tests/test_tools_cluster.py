@@ -223,6 +223,41 @@ class TestCliSubprocess:
             assert r.returncode == 0 and b"obj1" in r.stdout
             r = await loop.run_in_executor(None, lambda: ceph("status"))
             assert r.returncode == 0 and b"num_up_osds" in r.stdout
+
+            # rbd CLI: create/snap/protect/clone/info/children round trip
+            def rbd(*argv):
+                return subprocess.run(
+                    [sys.executable, "-m", "ceph_tpu.tools.rbd_cli",
+                     "--cluster-file", cfile, *argv],
+                    capture_output=True, timeout=60, cwd="/root/repo",
+                    env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                         "PYTHONPATH": "/root/repo"},
+                )
+
+            async def sh(fn):
+                return await loop.run_in_executor(None, fn)
+
+            r = await sh(lambda: rbd(
+                "-p", "clip", "--size", "1048576", "--order", "16",
+                "create", "vol1",
+            ))
+            assert r.returncode == 0, r.stderr
+            for words in (
+                ["snap", "create", "vol1@s1"],
+                ["snap", "protect", "vol1@s1"],
+                ["clone", "vol1@s1", "vol2"],
+            ):
+                r = await sh(lambda w=words: rbd("-p", "clip", *w))
+                assert r.returncode == 0, (words, r.stderr)
+            r = await sh(lambda: rbd("-p", "clip", "children", "vol1@s1"))
+            assert r.returncode == 0 and b"vol2" in r.stdout
+            r = await sh(lambda: rbd("-p", "clip", "info", "vol2"))
+            assert r.returncode == 0 and b"vol1@s1" in r.stdout
+            # protected snap refuses removal through the CLI too
+            r = await sh(lambda: rbd("-p", "clip", "snap", "rm", "vol1@s1"))
+            assert r.returncode == 1
+            r = await sh(lambda: rbd("-p", "clip", "ls"))
+            assert r.stdout.split() == [b"vol1", b"vol2"]
             await cluster.stop()
 
         asyncio.run(run())
