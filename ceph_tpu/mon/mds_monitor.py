@@ -186,12 +186,21 @@ class MDSMonitor:
             return handler
         if prefix == "fs rm":
             def handler(cmd, reply):
+                name = cmd.get("fs_name", "")
+                if not name:
+                    reply(-22, "usage: fs rm <fs_name>")
+                    return
+                if name != self.map.fs_name:
+                    # a typo'd name must not remove the real filesystem
+                    reply(-2, f"filesystem {name!r} does not exist")
+                    return
+
                 def mutate(m: FSMap):
-                    if not m.fs_name:
+                    if m.fs_name != name:
                         return None
                     return ("", "", dict(m.standbys), "", "", "")
 
-                self._queue(mutate, lambda v: reply(0, "fs removed"))
+                self._queue(mutate, lambda v: reply(0, f"fs {name!r} removed"))
 
             handler.mutating = True
             return handler

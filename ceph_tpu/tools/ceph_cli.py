@@ -82,6 +82,9 @@ def build_cmd(words: list[str]) -> dict:
                 for i, k in enumerate(["fs_name", "metadata", "data"]):
                     if i < len(rest):
                         cmd[k] = rest[i]
+            elif prefix == "fs rm":
+                if rest:
+                    cmd["fs_name"] = rest[0]
             elif prefix.startswith("osd erasure-code-profile"):
                 if rest:
                     cmd["name"] = rest[0]

@@ -18,6 +18,7 @@ import json
 import sys
 
 from ..client import Rados
+from ..client.rados import RadosError
 from ..rbd import RBD, RbdError
 from .vstart import CLUSTER_FILE, load_monmap
 
@@ -31,20 +32,32 @@ async def _run(args) -> int:
     client = Rados(load_monmap(args.cluster_file), name="client.rbd-cli")
     await client.connect()
     try:
-        ioctx = await client.open_ioctx(args.pool)
-        rbd = RBD(ioctx)
         words = args.words
         op = words[0]
+
+        def need(n: int, usage: str) -> None:
+            if len(words) < n:
+                raise RbdError(22, f"usage: {usage}")
+
         try:
+            ioctx = await client.open_ioctx(args.pool)
+            rbd = RBD(ioctx)
+            if op in ("create", "resize") and args.size is None:
+                # an implicit default here could silently SHRINK an image
+                print(f"rbd: {op} requires an explicit --size", file=sys.stderr)
+                return 1
             if op == "create":
+                need(2, "create <image> --size N")
                 await rbd.create(words[1], args.size, order=args.order)
                 print(f"created {words[1]} ({args.size} bytes)")
             elif op in ("ls", "list"):
                 for name in await rbd.list():
                     print(name)
             elif op in ("rm", "remove"):
+                need(2, "rm <image>")
                 await rbd.remove(words[1])
             elif op == "info":
+                need(2, "info <image>")
                 img = await rbd.open(words[1])
                 info = {
                     "name": img.name,
@@ -59,20 +72,25 @@ async def _run(args) -> int:
                     info["overlap"] = p["overlap"]
                 print(json.dumps(info, indent=2))
             elif op == "resize":
+                need(2, "resize <image> --size N")
                 img = await rbd.open(words[1])
                 await img.resize(args.size)
             elif op == "clone":
+                need(3, "clone <parent@snap> <child>")
                 parent, snap = _split_spec(words[1])
                 await rbd.clone(parent, snap, words[2])
                 print(f"cloned {words[1]} -> {words[2]}")
             elif op == "flatten":
+                need(2, "flatten <image>")
                 img = await rbd.open(words[1])
                 await img.flatten()
             elif op == "children":
+                need(2, "children <parent@snap>")
                 parent, snap = _split_spec(words[1])
                 for child in await rbd.children(parent, snap):
                     print(child)
             elif op == "snap":
+                need(3, "snap <create|rm|ls|rollback|protect|unprotect> <image[@snap]>")
                 sub = words[1]
                 image, snap = _split_spec(words[2])
                 img = await rbd.open(image)
@@ -93,12 +111,14 @@ async def _run(args) -> int:
                     print(f"unknown snap op {sub!r}", file=sys.stderr)
                     return 1
             elif op == "lock":
+                need(3, "lock <ls|rm> <image> [entity cookie]")
                 sub, image = words[1], words[2]
                 img = await rbd.open(image)
                 if sub == "ls":
                     for holder in await img.lock_owners():
                         print(json.dumps(holder))
                 elif sub == "rm":
+                    need(5, "lock rm <image> <entity> <cookie>")
                     await img.break_lock(words[3], words[4])
                 else:
                     print(f"unknown lock op {sub!r}", file=sys.stderr)
@@ -107,6 +127,9 @@ async def _run(args) -> int:
                 print(f"unknown op {op!r}", file=sys.stderr)
                 return 1
         except RbdError as e:
+            print(f"rbd: {e}", file=sys.stderr)
+            return 1
+        except RadosError as e:
             print(f"rbd: {e}", file=sys.stderr)
             return 1
         return 0
@@ -118,7 +141,10 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-p", "--pool", required=True)
     p.add_argument("--cluster-file", default=CLUSTER_FILE)
-    p.add_argument("--size", type=int, default=1 << 30)
+    p.add_argument(
+        "--size", type=int, default=None,
+        help="bytes; REQUIRED for create/resize",
+    )
     p.add_argument("--order", type=int, default=22)
     p.add_argument(
         "words", nargs="+",

@@ -30,6 +30,14 @@ def test_fs_new_requires_pools():
              "data": "nope2"}
         )
         assert rv != 0 and "does not exist" in rs
+        # fs rm guards: a name is required, and a typo'd name must not
+        # remove anything
+        rv, rs, _ = await client.mon_command({"prefix": "fs rm"})
+        assert rv != 0
+        rv, rs, _ = await client.mon_command(
+            {"prefix": "fs rm", "fs_name": "no-such-fs"}
+        )
+        assert rv != 0 and "does not exist" in rs
         await client.shutdown()
         await cluster.stop()
 

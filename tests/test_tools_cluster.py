@@ -187,23 +187,19 @@ class TestCliSubprocess:
             # create a pool via the ceph CLI (subprocess)
             loop = asyncio.get_event_loop()
 
-            def ceph(*words):
-                return subprocess.run(
-                    [sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
-                     "--cluster-file", cfile, *words],
-                    capture_output=True, timeout=60, cwd="/root/repo",
-                    env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
-                         "PYTHONPATH": "/root/repo"},
-                )
+            def tool(mod):
+                def run_tool(*argv):
+                    return subprocess.run(
+                        [sys.executable, "-m", f"ceph_tpu.tools.{mod}",
+                         "--cluster-file", cfile, *argv],
+                        capture_output=True, timeout=60, cwd="/root/repo",
+                        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                             "PYTHONPATH": "/root/repo"},
+                    )
 
-            def rados(*argv):
-                return subprocess.run(
-                    [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
-                     "--cluster-file", cfile, *argv],
-                    capture_output=True, timeout=60, cwd="/root/repo",
-                    env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
-                         "PYTHONPATH": "/root/repo"},
-                )
+                return run_tool
+
+            ceph, rados, rbd = tool("ceph_cli"), tool("rados_cli"), tool("rbd_cli")
 
             r = await loop.run_in_executor(
                 None, lambda: ceph("osd", "pool", "create", "clip")
@@ -225,15 +221,6 @@ class TestCliSubprocess:
             assert r.returncode == 0 and b"num_up_osds" in r.stdout
 
             # rbd CLI: create/snap/protect/clone/info/children round trip
-            def rbd(*argv):
-                return subprocess.run(
-                    [sys.executable, "-m", "ceph_tpu.tools.rbd_cli",
-                     "--cluster-file", cfile, *argv],
-                    capture_output=True, timeout=60, cwd="/root/repo",
-                    env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
-                         "PYTHONPATH": "/root/repo"},
-                )
-
             async def sh(fn):
                 return await loop.run_in_executor(None, fn)
 
