@@ -186,6 +186,32 @@ class IoCtx:
         rep = await self._op(oid, [OSDOp(op=OSDOp.RMXATTR, name=name)])
         _check(rep.result, f"rmxattr {oid}:{name}")
 
+    CMPXATTR_OPS = {"eq": 1, "ne": 2, "gt": 3, "gte": 4, "lt": 5, "lte": 6}
+
+    def cmpxattr_op(self, name: str, value: bytes, op: str = "eq") -> OSDOp:
+        """Build a CMPXATTR guard sub-op for a compound `operate` call:
+        the transaction aborts with -ECANCELED unless the xattr compares
+        true (rados_cmpxattr / ObjectOperation::cmpxattr)."""
+        return OSDOp(
+            op=OSDOp.CMPXATTR, name=name, data=bytes(value),
+            off=self.CMPXATTR_OPS[op],
+        )
+
+    async def cmpxattr(
+        self, oid: str, name: str, value: bytes, op: str = "eq"
+    ) -> None:
+        rep = await self._op(oid, [self.cmpxattr_op(name, value, op)])
+        _check(rep.result, f"cmpxattr {oid}:{name}")
+
+    async def operate(self, oid: str, ops: list[OSDOp], snapc=None):
+        """Compound object operation, applied ATOMICALLY in order — the
+        ObjectWriteOperation/ObjectReadOperation surface.  Returns the
+        reply's per-op outdata list; raises on a nonzero result (a failed
+        guard aborts the whole compound with -ECANCELED)."""
+        rep = await self._op(oid, ops, snapc=snapc)
+        _check(rep.result, f"operate {oid}")
+        return list(rep.outdata)
+
     # -- omap (rados_omap_* / ObjectOperation omap ops; replicated pools
     # only — EC pools answer -EOPNOTSUPP exactly like the reference) -----------
 

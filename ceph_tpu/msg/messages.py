@@ -80,6 +80,7 @@ class OSDOp(Struct):
     OMAPSETVALS = 23  # data = encoded kv map to merge
     OMAPRMKEYS = 24   # data = encoded str list
     OMAPCLEAR = 25
+    CMPXATTR = 26     # guard: xattr vs data per `off` mode; -ECANCELED on miss
 
     FIELDS = [
         ("op", "u8"),

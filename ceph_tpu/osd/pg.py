@@ -29,6 +29,7 @@ from typing import TYPE_CHECKING, Callable
 from ..common.errs import (
     EAGAIN,
     EBUSY,
+    ECANCELED,
     EINVAL,
     ENODATA,
     ENOENT,
@@ -83,6 +84,25 @@ from ..common.encoding import (  # noqa: E402 (module-level re-export)
     decode_kv_map as decode_attrs,
     encode_kv_map as encode_attrs,
 )
+
+
+def cmpxattr_ok(cur: bytes | None, want: bytes, mode: int) -> bool:
+    """CEPH_OSD_CMPXATTR_OP_* byte-string comparison; a missing xattr
+    compares as empty (the reference's cmpxattr on absent attrs)."""
+    cur = cur if cur is not None else b""
+    if mode == 1:
+        return cur == want
+    if mode == 2:
+        return cur != want
+    if mode == 3:
+        return cur > want
+    if mode == 4:
+        return cur >= want
+    if mode == 5:
+        return cur < want
+    if mode == 6:
+        return cur <= want
+    return False
 
 
 def op_is_write(op: OSDOp) -> bool:
@@ -502,6 +522,20 @@ class PG(PGListener):
                 pgt.attrs.setdefault(WHITEOUT_ATTR, None)
             elif op.op == OSDOp.RMXATTR:
                 pgt.attrs[f"_{op.name}"] = None  # staged removal
+            elif op.op == OSDOp.CMPXATTR:
+                # guard op: a failed compare aborts the WHOLE transaction
+                # (nothing staged lands) with -ECANCELED, the atomic
+                # check-and-mutate librbd/rgw build on
+                key = f"_{op.name}"
+                cur = (
+                    pgt.attrs[key]
+                    if key in pgt.attrs
+                    else self._getxattr(msg.oid, key)
+                )
+                if not cmpxattr_ok(cur, op.data, int(op.off)):
+                    self._inflight_reqids.pop(msg.reqid.key(), None)
+                    reply(self._errored(msg, -ECANCELED))
+                    return
             elif op.op in (
                 OSDOp.OMAPSETVALS, OSDOp.OMAPRMKEYS, OSDOp.OMAPCLEAR
             ):
@@ -699,6 +733,11 @@ class PG(PGListener):
                     result = -ENODATA
                     break
                 outdata[i] = val
+            elif op.op == OSDOp.CMPXATTR:
+                cur = self._getxattr(target, f"_{op.name}")
+                if not cmpxattr_ok(cur, op.data, int(op.off)):
+                    result = -ECANCELED
+                    break
             elif op.op == OSDOp.GETXATTRS:
                 # Bulk client-xattr dump — the attrs leg of copy-get
                 # (PrimaryLogPG::do_copy_get), consumed by COPY_FROM and

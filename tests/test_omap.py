@@ -46,6 +46,65 @@ def test_omap_roundtrip_and_clear():
     asyncio.run(run())
 
 
+def test_cmpxattr_guarded_compound_ops():
+    """CMPXATTR guards (rados_cmpxattr / ObjectOperation::cmpxattr):
+    a failed compare aborts the whole compound with -ECANCELED and
+    nothing staged lands — the atomic check-and-mutate primitive."""
+
+    async def run():
+        from ceph_tpu.msg.messages import OSDOp
+
+        monmap, mons, osds = await start_cluster(1, 3)
+        client = Rados(monmap)
+        await client.connect()
+        await client.pool_create("gx", "replicated", size=2, pg_num=2)
+        io = await client.open_ioctx("gx")
+        await io.write_full("obj", b"v1-bytes")
+        await io.setxattr("obj", "ver", b"1")
+        # matching guard: the compound write lands
+        await io.operate(
+            "obj",
+            [
+                io.cmpxattr_op("ver", b"1"),
+                OSDOp(op=OSDOp.WRITEFULL, data=b"v2-bytes"),
+                OSDOp(op=OSDOp.SETXATTR, name="ver", data=b"2"),
+            ],
+        )
+        assert await io.read("obj") == b"v2-bytes"
+        # stale guard: ECANCELED, and NEITHER the write nor the xattr land
+        with pytest.raises(RadosError) as ei:
+            await io.operate(
+                "obj",
+                [
+                    io.cmpxattr_op("ver", b"1"),
+                    OSDOp(op=OSDOp.WRITEFULL, data=b"v3-bytes"),
+                    OSDOp(op=OSDOp.SETXATTR, name="ver", data=b"3"),
+                ],
+            )
+        assert ei.value.errno == -125  # ECANCELED
+        assert await io.read("obj") == b"v2-bytes"
+        assert await io.getxattr("obj", "ver") == b"2"
+        # read-class standalone compare + guard sees EARLIER staged attrs
+        await io.cmpxattr("obj", "ver", b"2")
+        with pytest.raises(RadosError):
+            await io.cmpxattr("obj", "ver", b"9")
+        await io.operate(
+            "obj",
+            [
+                OSDOp(op=OSDOp.SETXATTR, name="ver", data=b"5"),
+                io.cmpxattr_op("ver", b"5"),  # sees the staged value
+                OSDOp(op=OSDOp.WRITEFULL, data=b"v5"),
+            ],
+        )
+        assert await io.read("obj") == b"v5"
+        # missing xattr compares as empty
+        await io.cmpxattr("obj", "ghost", b"", op="eq")
+        await client.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())
+
+
 def test_omap_rejected_on_ec_pool():
     async def run():
         monmap, mons, osds = await start_cluster(1, 4)
