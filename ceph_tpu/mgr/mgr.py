@@ -25,7 +25,13 @@ from ..common.config import Config
 from ..common.log import dout
 from ..mon.client import MonClient
 from ..mon.monmap import MonMap
-from ..msg.messages import MMgrBeacon, MMgrMap, MMgrReport, MOSDMap
+from ..msg.messages import (
+    MMgrBeacon,
+    MMgrMap,
+    MMgrReport,
+    MMonMgrReport,
+    MOSDMap,
+)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..osd.osdmap import OSDMap, advance_map
 
@@ -96,9 +102,20 @@ class Mgr(Dispatcher):
     async def _beacon_loop(self) -> None:
         while self._running:
             beacon = MMgrBeacon(name=self.name, addr=self.msgr.addr)
+            digest = None
+            if self.active:
+                # PGMap digest to the mons (MMonMgrReport): what `ceph df`
+                # and mon-side health read
+                digest = MMonMgrReport(
+                    digest=json.dumps(self.pg_digest()).encode()
+                )
             for mon_name in self.monmap.ranks:
                 try:
                     await self.monc.msgr.send_to(self.monmap.addrs[mon_name], beacon)
+                    if digest is not None:
+                        await self.monc.msgr.send_to(
+                            self.monmap.addrs[mon_name], digest
+                        )
                 except ConnectionError:
                     continue
             try:
@@ -106,6 +123,30 @@ class Mgr(Dispatcher):
             except ConnectionError:
                 pass
             await asyncio.sleep(self.beacon_interval)
+
+    def pg_digest(self) -> dict:
+        """Aggregate the OSDs' reported pool stats into the df shape:
+        STORED (primary-only logical bytes), OBJECTS (primary-only head
+        count), USED (raw bytes summed over every replica/shard)."""
+        pools: dict[str, dict] = {}
+        names = {str(p.id): p.name for p in self.osdmap.pools.values()}
+        for st in self.daemons.values():
+            status = st.status or {}
+            for key, field in (
+                ("pool_stored", "stored"),
+                ("pool_heads", "objects"),
+                ("pool_bytes", "used_raw"),
+            ):
+                for pid, v in (status.get(key) or {}).items():
+                    name = names.get(pid, f"pool{pid}")
+                    rec = pools.setdefault(
+                        name, {"stored": 0, "objects": 0, "used_raw": 0}
+                    )
+                    rec[field] += v
+        return {
+            "pools": pools,
+            "total_used_raw": sum(p["used_raw"] for p in pools.values()),
+        }
 
     def _on_osdmap(self, msg: MOSDMap) -> None:
         self.osdmap = advance_map(self.osdmap, msg)

@@ -29,6 +29,7 @@ from ..msg.messages import (
     MLog,
     MMDSBeacon,
     MMgrBeacon,
+    MMonMgrReport,
     MMonCommand,
     MMonCommandAck,
     MMonElection,
@@ -97,6 +98,9 @@ class Monitor(Dispatcher):
         self.authmon = AuthMonitor(self)
         # conn -> {what -> next epoch}
         self.subs: dict[Connection, dict[str, int]] = {}
+        # latest PGMap digest from the active mgr (MMonMgrReport);
+        # volatile health data, re-sent every mgr beacon interval
+        self.pg_digest: dict = {}
         self._started = asyncio.Event()
         self._tick_task: asyncio.Task | None = None
 
@@ -276,6 +280,11 @@ class Monitor(Dispatcher):
         elif isinstance(msg, MMDSBeacon):
             if self.is_leader():
                 self.mdsmon.prepare_beacon(msg)
+        elif isinstance(msg, MMonMgrReport):
+            try:
+                self.pg_digest = json.loads(msg.digest.decode() or "{}")
+            except json.JSONDecodeError:
+                pass
         elif isinstance(msg, MLog):
             # Daemon clog entries: the leader proposes them; a peon forwards
             # to the leader (Monitor::forward_request_leader).
@@ -397,6 +406,12 @@ class Monitor(Dispatcher):
             reply(-EINVAL, f"command failed: {e}")
 
     def _mon_command_handler(self, prefix: str):
+        if prefix == "df":
+            def handler(cmd, reply):
+                # `ceph df`: the mgr's PGMap digest (pools' STORED /
+                # OBJECTS / raw USED); empty until a mgr reports
+                reply(0, "", json.dumps(self.pg_digest).encode())
+            return handler
         if prefix == "quorum_status":
             def handler(cmd, reply):
                 reply(0, "", json.dumps(self.quorum_status()).encode())

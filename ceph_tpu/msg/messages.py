@@ -638,6 +638,16 @@ class MMDSBeacon(Message):
     FIELDS = [("name", "str"), ("addr", "str"), ("state", "str")]
 
 
+@message_type(41)
+class MMonMgrReport(Message):
+    """Active mgr -> mons: the PGMap digest (src/messages/
+    MMonMgrReport.h).  `digest` is a JSON pool-stats summary the mon
+    serves through `ceph df` / health; volatile (re-sent each beacon
+    interval), not paxos state — the freshest report wins."""
+
+    FIELDS = [("digest", "bytes")]
+
+
 @message_type(40)
 class MMDSMap(Message):
     """Mon -> subscribers: the FSMap (src/messages/MMDSMap.h + FSMap):

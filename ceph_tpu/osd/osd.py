@@ -823,9 +823,21 @@ class OSD(Dispatcher):
 def _osd_status(osd: "OSD") -> dict:
     """The status blob the mgr aggregates (DaemonServer daemon status)."""
     pool_objects: dict[str, int] = {}
+    pool_bytes: dict[str, int] = {}
+    pool_stored: dict[str, int] = {}
+    pool_heads: dict[str, int] = {}
     for pg in osd.pgs.values():
         pid = str(pg.pool.id)
         pool_objects[pid] = pool_objects.get(pid, 0) + pg.local_object_count()
+        pool_bytes[pid] = pool_bytes.get(pid, 0) + pg.local_bytes_used()
+        if pg.peering.is_primary():
+            # logical ("STORED") bytes + head counts, counted once from
+            # primaries only
+            heads = pg.list_heads()
+            pool_stored[pid] = pool_stored.get(pid, 0) + sum(
+                pg.logical_object_size(o) for o in heads
+            )
+            pool_heads[pid] = pool_heads.get(pid, 0) + len(heads)
     return {
         "num_pgs": len(osd.pgs),
         "up": osd.up,
@@ -835,4 +847,9 @@ def _osd_status(osd: "OSD") -> dict:
         # needs to verify a pool is empty before a pg_num change
         # (the reference's richer MPGStats -> mgr flow)
         "pool_objects": pool_objects,
+        # raw bytes on this OSD (replicas/shards multi-count, `ceph df`
+        # USED) and primary-only logical bytes (`ceph df` STORED)
+        "pool_bytes": pool_bytes,
+        "pool_stored": pool_stored,
+        "pool_heads": pool_heads,
     }

@@ -230,6 +230,14 @@ class PG(PGListener):
         except Exception:
             return []
 
+    def list_heads(self) -> list[str]:
+        """Client-visible head objects (snap clones carry the reserved
+        "@" separator and are internal)."""
+        return [o for o in self._list_local() if "@" not in o]
+
+    def logical_object_size(self, oid: str) -> int:
+        return self._object_size(oid)
+
     def local_object_count(self) -> int:
         """O(1)/one-readdir count for stat reporting (no enumeration)."""
         coll = shard_coll(self.pgid, self.whoami_shard())
@@ -237,6 +245,22 @@ class PG(PGListener):
             return self.osd.store.count_objects(coll)
         except Exception:
             return 0
+
+    def local_bytes_used(self) -> int:
+        """Raw bytes this OSD stores for the PG (every local object incl.
+        snap clones and EC shard chunks) — the pg_stats slice `ceph df`'s
+        USED column aggregates."""
+        coll = shard_coll(self.pgid, self.whoami_shard())
+        total = 0
+        try:
+            for oid in self.osd.store.list_objects(coll):
+                try:
+                    total += self.osd.store.stat(coll, oid)
+                except Exception:
+                    pass
+        except Exception:
+            return 0
+        return total
 
     def _drop_local_object(self, oid: str) -> None:
         """Divergent-rewind hook: a stale-but-present local copy must be

@@ -43,6 +43,7 @@ _PREFIXES = [
     "fs status",
     "quorum_status",
     "status",
+    "df",
 ]
 
 

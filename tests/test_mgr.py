@@ -55,6 +55,52 @@ class TestMgrDaemon:
 
         asyncio.run(run())
 
+    def test_pg_digest_feeds_ceph_df(self):
+        """mgr aggregates the OSDs' pool stats into a PGMap digest and
+        ships it to the mons (MMonMgrReport): `ceph df` serves STORED
+        (logical, once) vs USED (raw, xreplication)."""
+
+        async def run():
+            import json
+
+            monmap, mons, osds = await start_cluster(1, 3)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("dfp", "replicated", size=3, pg_num=4)
+            io = await client.open_ioctx("dfp")
+            for i in range(4):
+                await io.write_full(f"o{i}", b"z" * 10_000)
+
+            def df():
+                return mons[0].pg_digest.get("pools", {}).get("dfp")
+
+            # every OSD's periodic report must land post-write: replicas'
+            # raw bytes arrive on their own report cadence
+            await wait_until(
+                lambda: df() is not None
+                and df()["objects"] == 4
+                and df()["used_raw"] == 120_000,
+                10.0,
+                "df digest reaching the mon",
+            )
+            stats = df()
+            assert stats["stored"] == 40_000
+            # raw usage counts every replica (size=3)
+            assert stats["used_raw"] == 120_000
+            # and the command surface serves the same digest
+            rv, _, out = await client.mon_command({"prefix": "df"})
+            assert rv == 0
+            parsed = json.loads(out)
+            assert parsed["pools"]["dfp"]["stored"] == 40_000
+            assert parsed["total_used_raw"] >= 120_000
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
     def test_standby_failover(self):
         async def run():
             monmap, mons, osds = await start_cluster(1, 1)
