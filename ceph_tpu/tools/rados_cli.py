@@ -33,6 +33,23 @@ async def _run(args) -> int:
             await client.pool_create(args.pool, "replicated", size=args.size)
             print(f"pool {args.pool!r} created")
             return 0
+        if args.op == "df":
+            # rados df: the mon-served PGMap digest (ceph df shape)
+            import json as _json
+
+            rv, rs, out = await client.mon_command({"prefix": "df"})
+            if rv:
+                print(rs, file=sys.stderr)
+                return 1
+            digest = _json.loads(out.decode() or "{}")
+            print(f"{'POOL':<20}{'STORED':>12}{'OBJECTS':>10}{'USED':>12}")
+            for name, st in sorted(digest.get("pools", {}).items()):
+                print(
+                    f"{name:<20}{st['stored']:>12}{st['objects']:>10}"
+                    f"{st['used_raw']:>12}"
+                )
+            print(f"total_used_raw {digest.get('total_used_raw', 0)}")
+            return 0
         ioctx = await client.open_ioctx(args.pool)
         if args.op == "put":
             with open(args.args[1], "rb") as f:

@@ -245,6 +245,19 @@ class TestCliSubprocess:
             assert r.returncode == 1
             r = await sh(lambda: rbd("-p", "clip", "ls"))
             assert r.stdout.split() == [b"vol1", b"vol2"]
+
+            # radosgw-admin: user + bucket admin against the same pool
+            rgwadm = tool("rgw_admin")
+            r = await sh(lambda: rgwadm(
+                "-p", "clip", "--uid", "alice", "user", "create"
+            ))
+            assert r.returncode == 0 and b"access_key" in r.stdout, r.stderr
+            r = await sh(lambda: rgwadm("-p", "clip", "user", "list"))
+            assert r.stdout.split() == [b"alice"]
+            r = await sh(lambda: rgwadm(
+                "-p", "clip", "--uid", "alice", "user", "create"
+            ))
+            assert r.returncode == 1  # UserAlreadyExists -> clean error
             await cluster.stop()
 
         asyncio.run(run())
