@@ -17,3 +17,4 @@ ENOTDIR = 20
 ENOTEMPTY = 39
 EOPNOTSUPP = 95
 ECANCELED = 125
+EDQUOT = 122

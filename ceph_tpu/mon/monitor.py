@@ -178,6 +178,7 @@ class Monitor(Dispatcher):
             if self.is_leader():
                 self.mgrmon.tick()
                 self.mdsmon.tick()
+                self.osdmon.tick()
 
     async def wait_for_quorum(self, timeout: float = 5.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout

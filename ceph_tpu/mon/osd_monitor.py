@@ -31,6 +31,7 @@ from ..common.log import dout
 from ..msg.messages import MOSDBoot, MOSDFailure, MOSDMap
 from ..osd.osdmap import (
     FLAG_EC_OVERWRITES,
+    FLAG_FULL_QUOTA,
     Incremental,
     OSDMap,
     POOL_TYPE_ERASURE,
@@ -214,6 +215,7 @@ class OSDMonitor:
             "osd in": (self._cmd_in, True),
             "osd reweight": (self._cmd_reweight, True),
             "osd pool set": (self._cmd_pool_set, True),
+            "osd pool set-quota": (self._cmd_pool_set_quota, True),
             "osd pool selfmanaged-snap-create": (self._cmd_snap_create, True),
             "osd tier add": (self._cmd_tier_add, True),
             "osd tier remove": (self._cmd_tier_remove, True),
@@ -352,6 +354,59 @@ class OSDMonitor:
                 return f"pool '{name}' created"
 
         self._queue(mutate, lambda rv, rs: reply(rv, rs))
+
+    def _cmd_pool_set_quota(self, cmd, reply) -> None:
+        """`osd pool set-quota <pool> max_bytes|max_objects <val>`
+        (OSDMonitor prepare_command; 0 clears).  Enforcement closes the
+        loop in tick(): the mgr digest flips FLAG_FULL_QUOTA."""
+        pool, field, val = cmd.get("pool"), cmd.get("field"), cmd.get("val")
+        if field not in ("max_bytes", "max_objects"):
+            reply(-EINVAL, f"unknown quota field {field!r}")
+            return
+
+        def mutate(m: OSDMap) -> str:
+            p = m.get_pool(pool)
+            if p is None:
+                raise KeyError(f"pool {pool!r} does not exist")
+            setattr(p, f"quota_{field}", int(val))
+            if not p.quota_max_bytes and not p.quota_max_objects:
+                p.flags &= ~FLAG_FULL_QUOTA  # clearing quotas unfulls
+            return f"set-quota {field}={val} on pool {pool!r}"
+
+        self._queue(mutate, reply)
+
+    def tick(self) -> None:
+        """Quota enforcement (leader): compare the mgr's PGMap digest
+        against pool quotas and flip FLAG_FULL_QUOTA via paxos
+        (OSDMonitor::tick + the reference's pool-full checks)."""
+        if not self.mon.is_leader():
+            return
+        stats = (self.mon.pg_digest or {}).get("pools", {})
+        for p in list(self.osdmap.pools.values()):
+            if not p.quota_max_bytes and not p.quota_max_objects:
+                continue
+            st = stats.get(p.name)
+            if st is None:
+                continue
+            full = (
+                (p.quota_max_objects and st["objects"] >= p.quota_max_objects)
+                or (p.quota_max_bytes and st["stored"] >= p.quota_max_bytes)
+            )
+            if bool(p.flags & FLAG_FULL_QUOTA) == bool(full):
+                continue
+            name, want = p.name, bool(full)
+
+            def mutate(m: OSDMap, name=name, want=want) -> str:
+                tp = m.get_pool(name)
+                if tp is None:
+                    return ""
+                if want:
+                    tp.flags |= FLAG_FULL_QUOTA
+                else:
+                    tp.flags &= ~FLAG_FULL_QUOTA
+                return f"pool {name!r} {'full (quota)' if want else 'no longer full'}"
+
+            self._queue(mutate, None)
 
     def _cmd_pool_ls(self, cmd, reply) -> None:
         reply(0, "", json.dumps([p.name for p in self.osdmap.pools.values()]).encode())

@@ -26,6 +26,7 @@ POOL_TYPE_REPLICATED = 1
 POOL_TYPE_ERASURE = 3
 
 FLAG_EC_OVERWRITES = 1 << 0  # pool flag (osd_types.h:1222)
+FLAG_FULL_QUOTA = 1 << 1     # pool hit its quota (pg_pool_t FLAG_FULL_QUOTA)
 
 
 def advance_map(current: "OSDMap", msg) -> "OSDMap":
@@ -85,6 +86,10 @@ class PgPool:
     read_tier: int = -1  # overlay: clients redirect ops here (set-overlay)
     cache_mode: str = "none"  # none | writeback | readonly
     target_max_objects: int = 0  # tier agent flush/evict threshold (0 = off)
+    # pool quotas (pg_pool_t quota_max_*; `osd pool set-quota`); the mon
+    # flips FLAG_FULL_QUOTA from the mgr's PGMap digest when exceeded
+    quota_max_bytes: int = 0
+    quota_max_objects: int = 0
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE
@@ -222,10 +227,11 @@ class OSDMap(Encodable):
     # -- encoding ------------------------------------------------------------
 
     def encode(self, enc: Encoder) -> None:
-        # v2 appends the per-pool tiering map AFTER the v1 payload, so v1
-        # decoders skip it via the frame length (the reference's rolling-
-        # upgrade convention, src/include/encoding.h ENCODE_START).
-        enc.start(2, 1)
+        # v2 appends the per-pool tiering map AFTER the v1 payload (and
+        # v3 the quota map), so older decoders skip the trailers via the
+        # frame length (the reference's rolling-upgrade convention,
+        # src/include/encoding.h ENCODE_START).
+        enc.start(3, 1)
         enc.u32(self.epoch)
         enc.string(self.fsid)
         enc.map_(
@@ -282,6 +288,20 @@ class OSDMap(Encodable):
                 e.u64(p.target_max_objects),
             ),
         )
+        # --- v3 trailer: pool quotas ------------------------------------
+        quotas = {
+            pid: p
+            for pid, p in self.pools.items()
+            if p.quota_max_bytes or p.quota_max_objects
+        }
+        enc.map_(
+            quotas,
+            lambda e, k: e.u32(k),
+            lambda e, p: (
+                e.u64(p.quota_max_bytes),
+                e.u64(p.quota_max_objects),
+            ),
+        )
         enc.finish()
 
     @classmethod
@@ -324,7 +344,7 @@ class OSDMap(Encodable):
             lambda d: d.map_(lambda d2: d2.string(), lambda d2: d2.string()),
         )
         m.crush = CrushWrapper.decode(dec)
-        if struct_v >= 2:
+        if struct_v >= 2:  # noqa: SIM102 — versioned trailers read in order
             tiered = dec.map_(
                 lambda d: d.u32(),
                 lambda d: dict(
@@ -340,6 +360,15 @@ class OSDMap(Encodable):
                 if p is not None:
                     for attr, val in kw.items():
                         setattr(p, attr, val)
+        if struct_v >= 3:
+            quotas = dec.map_(
+                lambda d: d.u32(),
+                lambda d: (d.u64(), d.u64()),
+            )
+            for pid, (qb, qo) in quotas.items():
+                p = m.pools.get(pid)
+                if p is not None:
+                    p.quota_max_bytes, p.quota_max_objects = qb, qo
         dec.finish()
         return m
 

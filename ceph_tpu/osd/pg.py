@@ -30,6 +30,7 @@ from ..common.errs import (
     EAGAIN,
     EBUSY,
     ECANCELED,
+    EDQUOT,
     EINVAL,
     ENODATA,
     ENOENT,
@@ -50,7 +51,7 @@ from ..msg.messages import (
 )
 from ..os.transaction import Transaction
 from .ec_transaction import PGTransaction
-from .osdmap import PG_NONE, POOL_TYPE_ERASURE, PgPool
+from .osdmap import FLAG_FULL_QUOTA, PG_NONE, POOL_TYPE_ERASURE, PgPool
 from .peering import PeeringState
 from .pg_backend import PGListener, build_pg_backend, shard_coll
 from .pg_log import Eversion, LogEntry, Missing, PGLog, PgInfo
@@ -446,6 +447,17 @@ class PG(PGListener):
         # import on first use), so the result is shared by the tier gate
         # and the dispatch decision below.
         writing = any(op_is_write(op) for op in msg.ops)
+        if (
+            writing
+            and (self.pool.flags & FLAG_FULL_QUOTA)
+            and msg.reqid.client
+            and not msg.reqid.client.startswith("osd.")
+        ):
+            # pool over quota: client mutations bounce with -EDQUOT
+            # (librados surfaces exactly this on quota-full pools);
+            # OSD-internal traffic (flush/promote) still flows
+            reply(self._errored(msg, -EDQUOT))
+            return
         # Cache-tier gate (PrimaryLogPG::maybe_handle_cache): promote on
         # miss, forward deletes to the base, reject writes on readonly.
         # OSD-internal traffic ("osd." clients: promote writes, flush acks)

@@ -26,6 +26,7 @@ _PREFIXES = [
     "osd erasure-code-profile ls",
     "osd erasure-code-profile rm",
     "osd pool create",
+    "osd pool set-quota",
     "osd pool set",
     "osd pool ls",
     "osd pool rm",
@@ -60,6 +61,10 @@ def build_cmd(words: list[str]) -> dict:
                         cmd[k] = rest[i]
             elif prefix == "osd pool set":
                 for i, k in enumerate(["pool", "var", "val"]):
+                    if i < len(rest):
+                        cmd[k] = rest[i]
+            elif prefix == "osd pool set-quota":
+                for i, k in enumerate(["pool", "field", "val"]):
                     if i < len(rest):
                         cmd[k] = rest[i]
                 if "yes_i_really_mean_it" in rest:

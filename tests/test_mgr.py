@@ -6,6 +6,8 @@ import asyncio
 import json
 import urllib.request
 
+import pytest
+
 from ceph_tpu.client import Rados
 from ceph_tpu.mgr import Mgr
 from ceph_tpu.mgr.balancer import BalancerModule
@@ -458,5 +460,55 @@ class TestOrchestrator:
             assert orch.events  # scaling recorded
             await mgr.stop()
             await stop_cluster(mons, osds + spawned)
+
+        asyncio.run(run())
+
+
+class TestPoolQuota:
+    def test_quota_full_flag_bounces_writes(self):
+        """`osd pool set-quota` + the mgr digest: exceeding the quota
+        flips FLAG_FULL_QUOTA via paxos and client writes bounce with
+        -EDQUOT until the quota is raised (OSDMonitor pool-full loop)."""
+
+        async def run():
+            from ceph_tpu.client.rados import RadosError
+            from ceph_tpu.osd.osdmap import FLAG_FULL_QUOTA
+
+            monmap, mons, osds = await start_cluster(1, 3)
+            mgr = await start_mgr(monmap)
+            await mgr.wait_for_active()
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("qp", "replicated", size=2, pg_num=2)
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "osd pool set-quota", "pool": "qp",
+                 "field": "max_objects", "val": "2"}
+            )
+            assert rv == 0, rs
+            io = await client.open_ioctx("qp")
+            await io.write_full("a", b"1")
+            await io.write_full("b", b"2")
+
+            def pool_full():
+                p = client.objecter.osdmap.get_pool("qp")
+                return p is not None and bool(p.flags & FLAG_FULL_QUOTA)
+
+            await wait_until(pool_full, 15.0, "quota-full flag reaching client")
+            with pytest.raises(RadosError) as ei:
+                await io.write_full("c", b"3")
+            assert ei.value.errno == -122  # EDQUOT
+            # reads still work on a full pool
+            assert await io.read("a") == b"1"
+            # raising the quota unfulls and writes resume
+            rv, _, _ = await client.mon_command(
+                {"prefix": "osd pool set-quota", "pool": "qp",
+                 "field": "max_objects", "val": "100"}
+            )
+            assert rv == 0
+            await wait_until(lambda: not pool_full(), 15.0, "unfull")
+            await io.write_full("c", b"3")
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
 
         asyncio.run(run())
