@@ -209,6 +209,7 @@ class OSDMonitor:
             "osd erasure-code-profile rm": (self._cmd_profile_rm, True),
             "osd pool create": (self._cmd_pool_create, True),
             "osd pool ls": (self._cmd_pool_ls, False),
+            "osd pool get": (self._cmd_pool_get, False),
             "osd pool rm": (self._cmd_pool_rm, True),
             "osd dump": (self._cmd_dump, False),
             "osd out": (self._cmd_out, True),
@@ -407,6 +408,25 @@ class OSDMonitor:
                 return f"pool {name!r} {'full (quota)' if want else 'no longer full'}"
 
             self._queue(mutate, None)
+
+    def _cmd_pool_get(self, cmd, reply) -> None:
+        """`osd pool get <pool> <var>|all` (OSDMonitor prepare_command
+        get variants)."""
+        import dataclasses
+
+        p = self.osdmap.get_pool(cmd.get("pool"))
+        if p is None:
+            reply(-EINVAL, f"pool {cmd.get('pool')!r} does not exist")
+            return
+        info = dataclasses.asdict(p)
+        var = cmd.get("var", "all")
+        if var in ("", "all"):
+            reply(0, "", json.dumps(info).encode())
+            return
+        if var not in info:
+            reply(-EINVAL, f"unknown pool variable {var!r}")
+            return
+        reply(0, "", json.dumps({var: info[var]}).encode())
 
     def _cmd_pool_ls(self, cmd, reply) -> None:
         reply(0, "", json.dumps([p.name for p in self.osdmap.pools.values()]).encode())

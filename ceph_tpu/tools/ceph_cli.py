@@ -29,6 +29,7 @@ _PREFIXES = [
     "osd pool set-quota",
     "osd pool set",
     "osd pool ls",
+    "osd pool get",
     "osd pool rm",
     "osd tier add",
     "osd tier remove-overlay",
@@ -72,6 +73,10 @@ def build_cmd(words: list[str]) -> dict:
             elif prefix in ("osd pool rm",):
                 if rest:
                     cmd["pool"] = rest[0]
+            elif prefix == "osd pool get":
+                for i, k in enumerate(["pool", "var"]):
+                    if i < len(rest):
+                        cmd[k] = rest[i]
             elif prefix in ("osd tier add", "osd tier remove"):
                 cmd["pool"], cmd["tierpool"] = rest[0], rest[1]
             elif prefix == "osd tier cache-mode":

@@ -269,3 +269,39 @@ class TestAuthMonitor:
             await stop_cluster(mons, [])
 
         asyncio.run(run())
+
+
+def test_osd_pool_get():
+    """`osd pool get <pool> <var>|all` (OSDMonitor get variants)."""
+
+    async def run():
+        import json
+
+        from ceph_tpu.client import Rados
+        from test_cluster import start_cluster, stop_cluster
+
+        monmap, mons, osds = await start_cluster(1, 3)
+        client = Rados(monmap)
+        await client.connect()
+        await client.pool_create("gp", "replicated", size=2)
+        rv, _, out = await client.mon_command(
+            {"prefix": "osd pool get", "pool": "gp", "var": "size"}
+        )
+        assert rv == 0 and json.loads(out) == {"size": 2}
+        rv, _, out = await client.mon_command(
+            {"prefix": "osd pool get", "pool": "gp"}
+        )
+        allinfo = json.loads(out)
+        assert allinfo["pg_num"] > 0 and allinfo["quota_max_objects"] == 0
+        rv, _, _ = await client.mon_command(
+            {"prefix": "osd pool get", "pool": "gp", "var": "bogus"}
+        )
+        assert rv != 0
+        rv, _, _ = await client.mon_command(
+            {"prefix": "osd pool get", "pool": "nope"}
+        )
+        assert rv != 0
+        await client.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())

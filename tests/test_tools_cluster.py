@@ -87,6 +87,22 @@ class TestCephCliCmdBuilder:
             "id": "3",
             "weight": "0.5",
         }
+        assert build_cmd(["osd", "pool", "get", "p1", "size"]) == {
+            "prefix": "osd pool get", "pool": "p1", "var": "size",
+        }
+        assert build_cmd(
+            ["osd", "pool", "set-quota", "p1", "max_objects", "10"]
+        ) == {
+            "prefix": "osd pool set-quota", "pool": "p1",
+            "field": "max_objects", "val": "10",
+        }
+        assert build_cmd(["fs", "new", "cephfs", "m", "d"]) == {
+            "prefix": "fs new", "fs_name": "cephfs",
+            "metadata": "m", "data": "d",
+        }
+        assert build_cmd(["fs", "rm", "cephfs"]) == {
+            "prefix": "fs rm", "fs_name": "cephfs",
+        }
 
 
 class TestObjectstoreTool:
