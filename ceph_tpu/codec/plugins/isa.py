@@ -1,0 +1,18 @@
+"""The `isa` plugin name — a drop-in alias for the flagship `tpu` codec.
+
+The reference's profiles say `plugin=isa`
+(/root/reference/src/erasure-code/isa/ErasureCodePluginIsa.cc); this
+framework's equivalent codec is byte-identical to ISA-L's output
+(tests/test_isal_golden.py proves it three ways), so existing pool
+profiles port verbatim: `plugin=isa` loads the same class the `tpu`
+name does.
+"""
+
+from ceph_tpu.codec.registry import EC_VERSION, ErasureCodePlugin
+from ceph_tpu.codec.plugins.tpu import _factory
+
+__erasure_code_version__ = EC_VERSION
+
+
+def __erasure_code_init__(registry):
+    registry.add("isa", ErasureCodePlugin("isa", _factory))

@@ -492,6 +492,26 @@ class Image:
         self.header["size"] = snap.get("size", self.size)
         await self._save_header()
 
+    async def export(self, snap_name: str | None = None) -> bytes:
+        """rbd export: the full image (or a snapshot's view) as bytes,
+        read in object-size chunks (rbd export's sequential reader)."""
+        out = bytearray()
+        off = 0
+        while off < self.size:
+            take = min(self.object_bytes, self.size - off)
+            out += await self.read(off, take, snap_name=snap_name)
+            off += take
+        return bytes(out)
+
+    async def import_bytes(self, data: bytes) -> None:
+        """rbd import payload: write the blob from offset 0 (the caller
+        created the image at len(data))."""
+        off = 0
+        while off < len(data):
+            take = min(self.object_bytes, len(data) - off)
+            await self.write(off, data[off : off + take])
+            off += take
+
     async def snap_protect(self, name: str) -> None:
         """rbd snap protect: required before cloning; a protected snap
         cannot be removed (librbd snap_protect)."""

@@ -75,6 +75,33 @@ async def _run(args) -> int:
                 need(2, "resize <image> --size N")
                 img = await rbd.open(words[1])
                 await img.resize(args.size)
+            elif op == "export":
+                need(3, "export <image[@snap]> <file>")
+                image, snap = _split_spec(words[1])
+                img = await rbd.open(image)
+                data = await img.export(snap_name=snap or None)
+                with open(words[2], "wb") as f:
+                    f.write(data)
+                print(f"exported {len(data)} bytes to {words[2]}")
+            elif op == "import":
+                need(3, "import <file> <image>")
+                with open(words[1], "rb") as f:
+                    data = f.read()
+                await rbd.create(
+                    words[2], len(data),
+                    order=args.order,
+                )
+                img = await rbd.open(words[2])
+                await img.import_bytes(data)
+                print(f"imported {len(data)} bytes as {words[2]}")
+            elif op == "cp":
+                need(3, "cp <src[@snap]> <dst>")
+                src_name, snap = _split_spec(words[1])
+                src = await rbd.open(src_name)
+                data = await src.export(snap_name=snap or None)
+                await rbd.create(words[2], len(data), order=src.order)
+                dst = await rbd.open(words[2])
+                await dst.import_bytes(data)
             elif op == "clone":
                 need(3, "clone <parent@snap> <child>")
                 parent, snap = _split_spec(words[1])
