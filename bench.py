@@ -113,18 +113,9 @@ def run_child(platform: str) -> None:
     clog("building codec via plugin registry")
     ec = instance().factory("tpu", {"k": str(k), "m": str(m)})
     encode_fn = ec.encode_array
-
-    # On-chip parity check before timing: the kernel's bytes must equal the
-    # host GF oracle's on a small slice (bench validates bytes, then speed).
     rng = np.random.default_rng(0)
-    probe = rng.integers(0, 256, (2, k, 1024), dtype=np.uint8)
     gfm = isa_rs_vandermonde_matrix(k, m)[k:]
-    want = np.stack([gf_matmul(gfm, probe[s]) for s in range(2)])
-    clog("compiling + checking parity vs host oracle")
-    got_parity = np.asarray(encode_fn(jnp.asarray(probe)))
-    if not np.array_equal(got_parity, want):
-        clog("PARITY MISMATCH vs host oracle")
-        sys.exit(4)
+    parity_checked = False
 
     # Serial-chain methodology: each launch's input depends on the previous
     # launch's parity (a 128-byte patch, updated in place via donation), so
@@ -142,16 +133,29 @@ def run_child(platform: str) -> None:
         the axon backend, block_until_ready alone has been observed to
         return before queued launches finish; materializing bytes cannot.
         """
-        data = jnp.asarray(
-            rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8),
-            dtype=jnp.uint8,
-        )
+        nonlocal parity_checked
+        host = rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8)
+        data = jnp.asarray(host, dtype=jnp.uint8)
         # zeros seed: step only reads 128 bytes of p for the patch, and
         # the warm call below regenerates real parity — seeding through
         # encode_fn would cost a second remote compile per depth
         p = jnp.zeros((batch, m, chunk), jnp.uint8)
         data, p = step(data, p)  # compile + warm
         jax.block_until_ready((data, p))
+        if not parity_checked:
+            # On-chip byte check ON THE MEASUREMENT SHAPE (bytes first,
+            # then speed) — riding the already-compiled step saves a
+            # separate small-shape remote compile (~30 s cold).  The warm
+            # step patched stripe 0's first 128 bytes with p^1 = 0x01.
+            stripe0 = host[0].copy()
+            stripe0[0, :128] = 1
+            want = gf_matmul(gfm, stripe0)
+            got = np.asarray(p[0])
+            if not np.array_equal(got, want):
+                clog("PARITY MISMATCH vs host oracle")
+                sys.exit(4)
+            clog("on-chip parity vs host oracle OK")
+            parity_checked = True
         t0 = time.perf_counter()
         for _ in range(n):
             data, p = step(data, p)
