@@ -334,6 +334,14 @@ class IoCtx:
         self.rados.objecter._watches.pop((self.pool_id, oid, cookie), None)
         _check(rep.result, f"unwatch {oid}")
 
+    async def list_watchers(self, oid: str) -> list[dict]:
+        """rados listwatchers: [{watcher, cookie}] on the object's head."""
+        import json as _json
+
+        rep = await self._op(oid, [OSDOp(op=OSDOp.LIST_WATCHERS)])
+        _check(rep.result, f"list_watchers {oid}")
+        return _json.loads(rep.outdata[0].decode() or "[]")
+
     async def notify(
         self, oid: str, payload: bytes = b"", timeout_ms: int = 3000
     ) -> dict:

@@ -81,6 +81,7 @@ class OSDOp(Struct):
     OMAPRMKEYS = 24   # data = encoded str list
     OMAPCLEAR = 25
     CMPXATTR = 26     # guard: xattr vs data per `off` mode; -ECANCELED on miss
+    LIST_WATCHERS = 27  # dump the object's watch table (rados listwatchers)
 
     FIELDS = [
         ("op", "u8"),

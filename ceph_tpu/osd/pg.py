@@ -774,6 +774,17 @@ class PG(PGListener):
                 if not cmpxattr_ok(cur, op.data, int(op.off)):
                     result = -ECANCELED
                     break
+            elif op.op == OSDOp.LIST_WATCHERS:
+                # PrimaryLogPG do_osd_ops CEPH_OSD_OP_LIST_WATCHERS:
+                # (entity, cookie) pairs currently registered on the head
+                import json as _json
+
+                outdata[i] = _json.dumps(
+                    [
+                        {"watcher": e, "cookie": c}
+                        for e, c in sorted(self.watchers.get(msg.oid, {}))
+                    ]
+                ).encode()
             elif op.op == OSDOp.GETXATTRS:
                 # Bulk client-xattr dump — the attrs leg of copy-get
                 # (PrimaryLogPG::do_copy_get), consumed by COPY_FROM and

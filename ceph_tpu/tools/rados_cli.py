@@ -71,6 +71,9 @@ async def _run(args) -> int:
         elif args.op == "ls":
             for oid in await ioctx.list_objects():
                 print(oid)
+        elif args.op == "listwatchers":
+            for w in await ioctx.list_watchers(args.args[0]):
+                print(f"watcher={w['watcher']} cookie={w['cookie']}")
         elif args.op == "listomapkeys":
             for k in await ioctx.omap_get_keys(args.args[0]):
                 print(k)

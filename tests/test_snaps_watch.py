@@ -165,11 +165,17 @@ class TestWatchNotify:
             assert set(res["acks"]) == {k1, k2}
             assert bytes.fromhex(res["acks"][k1]) == b"ack-from-w1"
 
+            # listwatchers sees both registrations
+            watchers = await io_n.list_watchers("watched")
+            assert {w["watcher"] for w in watchers} == {
+                w1.objecter.reqid_name, w2.objecter.reqid_name
+            }
             # unwatch: w2 no longer hears notifies
             await io_2.unwatch("watched", c2)
             res = await io_n.notify("watched", b"again")
             assert got2 == [b"hello watchers"]
             assert set(res["acks"]) == {k1}
+            assert len(await io_n.list_watchers("watched")) == 1
 
             for c in (w1, w2, notifier):
                 await c.shutdown()
