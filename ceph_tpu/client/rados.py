@@ -166,6 +166,24 @@ class IoCtx:
         )
         _check(rep.result, f"append {oid}")
 
+    async def zero(self, oid: str, off: int, length: int, snapc=None) -> None:
+        """rados_write zero extent (CEPH_OSD_OP_ZERO): reads as zeros."""
+        rep = await self._op(
+            oid, [OSDOp(op=OSDOp.ZERO, off=off, len=length)], snapc=snapc
+        )
+        _check(rep.result, f"zero {oid}")
+
+    async def writesame(
+        self, oid: str, data: bytes, off: int, length: int, snapc=None
+    ) -> None:
+        """rados_writesame: tile `data` across [off, off+length)."""
+        rep = await self._op(
+            oid,
+            [OSDOp(op=OSDOp.WRITESAME, off=off, len=length, data=bytes(data))],
+            snapc=snapc,
+        )
+        _check(rep.result, f"writesame {oid}")
+
     async def truncate(self, oid: str, size: int, snapc=None) -> None:
         rep = await self._op(
             oid, [OSDOp(op=OSDOp.TRUNCATE, off=size)], snapc=snapc

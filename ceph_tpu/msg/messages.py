@@ -82,6 +82,8 @@ class OSDOp(Struct):
     OMAPCLEAR = 25
     CMPXATTR = 26     # guard: xattr vs data per `off` mode; -ECANCELED on miss
     LIST_WATCHERS = 27  # dump the object's watch table (rados listwatchers)
+    ZERO = 28         # zero an extent (CEPH_OSD_OP_ZERO)
+    WRITESAME = 29    # tile `data` across [off, off+len) (CEPH_OSD_OP_WRITESAME)
 
     FIELDS = [
         ("op", "u8"),

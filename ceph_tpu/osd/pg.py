@@ -71,6 +71,8 @@ WRITE_OPS = {
     OSDOp.OMAPSETVALS,
     OSDOp.OMAPRMKEYS,
     OSDOp.OMAPCLEAR,
+    OSDOp.ZERO,
+    OSDOp.WRITESAME,
 }
 
 # Cache-tier dirty marker (object_info_t FLAG_DIRTY analog): set by client
@@ -516,6 +518,27 @@ class PG(PGListener):
             elif op.op == OSDOp.APPEND:
                 pgt.write(size, op.data)
                 size += len(op.data)
+                pgt.attrs.setdefault(WHITEOUT_ATTR, None)
+            elif op.op == OSDOp.ZERO:
+                # CEPH_OSD_OP_ZERO: the extent reads back as zeros; does
+                # not extend the object (the reference zeroes within
+                # bounds and ignores wholly-past-end extents)
+                ln = min(int(op.len), max(size - int(op.off), 0))
+                if ln > 0:
+                    pgt.write(int(op.off), b"\x00" * ln)
+            elif op.op == OSDOp.WRITESAME:
+                # CEPH_OSD_OP_WRITESAME: tile data across [off, off+len)
+                if (
+                    not op.data
+                    or int(op.len) % len(op.data)
+                    or int(op.len) <= 0
+                ):
+                    self._inflight_reqids.pop(msg.reqid.key(), None)
+                    reply(self._errored(msg, -EINVAL))
+                    return
+                tiled = bytes(op.data) * (int(op.len) // len(op.data))
+                pgt.write(int(op.off), tiled)
+                size = max(size, int(op.off) + len(tiled))
                 pgt.attrs.setdefault(WHITEOUT_ATTR, None)
             elif op.op == OSDOp.TRUNCATE:
                 pgt.truncate = op.off

@@ -173,3 +173,37 @@ def test_omap_survives_osd_restart_via_recovery():
         await stop_cluster(mons, osds)
 
     asyncio.run(run())
+
+
+def test_zero_and_writesame_ops():
+    """CEPH_OSD_OP_ZERO / WRITESAME: extent zeroing (no size extension)
+    and tiled writes (replicated pool; EC pools route these through the
+    same staged-write path under FLAG_EC_OVERWRITES)."""
+
+    async def run():
+        monmap, mons, osds = await start_cluster(1, 4)
+        client = Rados(monmap)
+        await client.connect()
+        await client.pool_create("zw", "replicated", size=2, pg_num=2)
+        io = await client.open_ioctx("zw")
+        await io.write_full("o", b"A" * 1000)
+        await io.zero("o", 100, 200)
+        got = await io.read("o")
+        assert got[:100] == b"A" * 100
+        assert got[100:300] == b"\x00" * 200
+        assert got[300:] == b"A" * 700 and len(got) == 1000
+        # zero past the end neither extends nor errors
+        await io.zero("o", 900, 500)
+        assert await io.stat("o") == 1000
+        assert (await io.read("o"))[900:] == b"\x00" * 100
+        # writesame tiles and extends
+        await io.writesame("o", b"xy", 1000, 10)
+        assert (await io.read("o"))[1000:] == b"xy" * 5
+        with pytest.raises(RadosError):
+            await io.writesame("o", b"xyz", 0, 10)  # len % data != 0
+        with pytest.raises(RadosError):
+            await io.writesame("o", b"", 0, 10)
+        await client.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())
