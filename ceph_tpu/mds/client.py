@@ -139,9 +139,10 @@ class CephFSClient(Dispatcher):
 
     def __init__(
         self, mds_addr: str = "", data_ioctx=None, name: str = "client.fs",
-        stack: str = "posix", monmap=None,
+        stack: str = "posix", monmap=None, fs_name: str = "",
     ):
         self.mds_addr = mds_addr
+        self.fs_name = fs_name  # "" = the first filesystem in the fsmap
         self.data = data_ioctx
         self.monmap = monmap
         self.monc = None
@@ -184,9 +185,16 @@ class CephFSClient(Dispatcher):
         if isinstance(msg, MMDSMap):
             if msg.epoch > self._mdsmap_epoch:
                 self._mdsmap_epoch = msg.epoch
-                if msg.active_addr != self.mds_addr:
-                    self.mds_addr = msg.active_addr
-                    self._mds_changed.set()
+                fss = msg.filesystems()
+                if self.fs_name:
+                    fs = fss.get(self.fs_name, {})
+                else:
+                    fs = fss[sorted(fss)[0]] if fss else {}
+                addr = fs.get("active_addr", "")
+                if addr != self.mds_addr:
+                    self.mds_addr = addr
+                    if addr:
+                        self._mds_changed.set()
             return True
         if isinstance(msg, MClientReply):
             fut = self._replies.pop(msg.tid, None)

@@ -69,13 +69,19 @@ BEACON_INTERVAL = 1.0  # mds_beacon_interval (scaled down)
 class MDS(Dispatcher):
     """One metadata server daemon (standby until the FSMap says active)."""
 
-    def __init__(self, meta_ioctx, data_ioctx, addr: str = "127.0.0.1:0",
+    def __init__(self, meta_ioctx=None, data_ioctx=None,
+                 addr: str = "127.0.0.1:0",
                  layout: dict | None = None, stack: str = "posix",
-                 name: str = "0", monmap=None):
+                 name: str = "0", monmap=None, rados=None):
         self.meta = meta_ioctx
         self.data = data_ioctx
         self.name = name
         self.monmap = monmap
+        # with `rados`, pools bind at PROMOTION from the fsmap's
+        # assignment (the reference's MDSRank opening the metadata pool
+        # named by its MDSMap); fixed ioctxs are the embedded path
+        self.rados = rados
+        self.fs_name = ""  # filesystem this daemon holds rank 0 of
         self.monc = None
         self.state = "boot"  # boot -> standby -> replay -> active
         self.mdsmap_epoch = 0
@@ -129,16 +135,24 @@ class MDS(Dispatcher):
         await self.monc.subscribe("mdsmap")
         self._beacon_task = asyncio.create_task(self._beacon_loop())
 
-    async def _activate(self) -> None:
+    async def _activate(self, fs: dict | None = None) -> None:
         """standby → replay → active (MDSDaemon::boot_start / replay_done):
-        load the on-pool state, replay the journal, start serving."""
+        bind the assigned filesystem's pools, load the on-pool state,
+        replay the journal, start serving."""
         self.state = "replay"
+        if fs is not None and self.rados is not None:
+            self.meta = await self.rados.open_ioctx(fs["meta_pool"])
+            self.data = await self.rados.open_ioctx(fs["data_pool"])
         await self._load_or_mkfs()
         await self._replay_journal()
         self._running = True
         self.state = "active"
         self._flush_task = asyncio.create_task(self._flush_loop())
-        dout("mds", 1, f"mds.{self.name}: now active (rank 0)")
+        dout(
+            "mds", 1,
+            f"mds.{self.name}: now active (rank 0"
+            + (f" of {self.fs_name}" if self.fs_name else "") + ")",
+        )
 
     def _demote(self) -> None:
         """active → standby (fs removed / rank reassigned): stop serving
@@ -153,6 +167,9 @@ class MDS(Dispatcher):
         self.caps.clear()
         self._revoke_waiters.clear()
         self._ino_loc.clear()
+        self._journal_seq = 0
+        self._journal_bytes = 0
+        self.fs_name = ""
         self.state = "standby"
         dout("mds", 1, f"mds.{self.name}: demoted to standby")
 
@@ -178,12 +195,18 @@ class MDS(Dispatcher):
         if msg.epoch <= self.mdsmap_epoch:
             return
         self.mdsmap_epoch = msg.epoch
-        am_active = msg.active_name == self.name
-        if am_active and self.state == "standby" and self._activate_task is None:
-            task = asyncio.create_task(self._activate())
+        mine = ""
+        my_fs = None
+        for fs_name, fs in msg.filesystems().items():
+            if fs.get("active_name") == self.name:
+                mine, my_fs = fs_name, fs
+                break
+        if mine and self.state == "standby" and self._activate_task is None:
+            self.fs_name = mine
+            task = asyncio.create_task(self._activate(my_fs))
             task.add_done_callback(lambda _t: setattr(self, "_activate_task", None))
             self._activate_task = task
-        elif not am_active and self.state in ("replay", "active"):
+        elif not mine and self.state in ("replay", "active"):
             if self._activate_task is not None:
                 self._activate_task.cancel()
                 self._activate_task = None

@@ -3,22 +3,21 @@ src/mds/FSMap.h).
 
 Mirrored behaviors:
 - MDS daemons announce themselves with beacons (MMDSBeacon →
-  MDSMonitor::prepare_beacon); once a filesystem exists (`fs new`), the
-  first daemon takes **rank 0 (active)** and later ones queue as
-  **standbys** (FSMap::promote / assign_standby_replay essence).
-- A missed beacon window fails the active rank over to a standby
-  (`mds_beacon_grace`, MDSMonitor::tick → maybe_replace_gid), bumping the
-  map epoch; the promoted standby sees itself active in the next MMDSMap
-  and runs journal replay before serving.
-- The map publishes to "mdsmap" subscribers (clients resolving the
-  active MDS; standbys learning of promotion) — check_sub.
-- Commands: `fs new <name> <meta> <data>`, `fs rm <name>`, `fs status`
-  (MDSMonitor's command surface, trimmed to the single-fs scope the MDS
-  daemon implements).
+  MDSMonitor::prepare_beacon) and pool as STANDBYS until a filesystem
+  wants a rank; each `fs new` filesystem gets its own **rank 0** daemon
+  assigned from the standby pool (FSMap::promote — the reference's
+  multi-filesystem map, one MDSMap per fs inside the FSMap envelope).
+- A missed beacon window fails a filesystem's active rank over to a
+  standby (`mds_beacon_grace`, MDSMonitor::tick → maybe_replace_gid),
+  bumping the map epoch; the promoted standby sees its assignment in
+  the next MMDSMap and runs journal replay for THAT filesystem before
+  serving.
+- The map publishes to "mdsmap" subscribers (clients resolving their
+  filesystem's active MDS; standbys learning of promotion) — check_sub.
+- Commands: `fs new <name> <meta> <data>`, `fs rm <name>`, `fs status`.
 
-Single-filesystem, single-active-rank scope matching ceph_tpu.mds (rank
-0 only; multi-rank subtree partitioning is out of scope there and
-therefore here).
+Rank scope per filesystem: one ACTIVE rank (0); multi-rank subtree
+partitioning is out of scope in ceph_tpu.mds and therefore here.
 """
 
 from __future__ import annotations
@@ -34,43 +33,87 @@ BEACON_GRACE = 6.0  # mds_beacon_grace (scaled down like mgr's)
 
 
 class FSMap:
-    """The one-filesystem FSMap: rank-0 holder + standbys."""
+    """The multi-filesystem FSMap: per-fs rank-0 holder + a shared
+    standby pool (FSMap.h filesystems + standby_daemons)."""
 
     def __init__(self) -> None:
         self.epoch = 0
-        self.fs_name = ""  # empty until `fs new`
-        self.meta_pool = ""
-        self.data_pool = ""
-        self.active_name = ""
-        self.active_addr = ""
-        self.standbys: dict[str, str] = {}  # name -> addr
+        # fs name -> {meta_pool, data_pool, active_name, active_addr}
+        self.filesystems: dict[str, dict] = {}
+        self.standbys: dict[str, str] = {}  # daemon name -> addr
+
+    # -- queries ---------------------------------------------------------------
+
+    def fs_of_daemon(self, daemon: str) -> str:
+        """Filesystem this daemon holds rank 0 of ('' = none)."""
+        for name, fs in self.filesystems.items():
+            if fs["active_name"] == daemon:
+                return name
+        return ""
+
+    def actives(self) -> dict[str, str]:
+        return {
+            name: fs["active_name"]
+            for name, fs in self.filesystems.items()
+            if fs["active_name"]
+        }
 
     def to_msg(self) -> MMDSMap:
         return MMDSMap(
             epoch=self.epoch,
-            fs_name=self.fs_name,
-            active_name=self.active_name,
-            active_addr=self.active_addr,
-            standbys=sorted(self.standbys),
+            fsmap=json.dumps(
+                {"filesystems": self.filesystems, "standbys": self.standbys}
+            ).encode(),
         )
+
+    def to_blob(self, epoch: int) -> bytes:
+        return json.dumps(
+            {
+                "epoch": epoch,
+                "filesystems": self.filesystems,
+                "standbys": self.standbys,
+            }
+        ).encode()
+
+    @staticmethod
+    def scratch(m: "FSMap") -> "FSMap":
+        s = FSMap()
+        s.epoch = m.epoch
+        s.filesystems = {k: dict(v) for k, v in m.filesystems.items()}
+        s.standbys = dict(m.standbys)
+        return s
 
     def status(self) -> dict:
         """`ceph fs status` / `ceph status` fsmap line."""
-        if not self.fs_name:
-            return {"epoch": self.epoch, "filesystems": []}
         return {
             "epoch": self.epoch,
             "filesystems": [
                 {
-                    "name": self.fs_name,
-                    "metadata_pool": self.meta_pool,
-                    "data_pool": self.data_pool,
-                    "rank0": self.active_name or None,
-                    "standbys": sorted(self.standbys),
-                    "state": "up:active" if self.active_name else "down",
+                    "name": name,
+                    "metadata_pool": fs["meta_pool"],
+                    "data_pool": fs["data_pool"],
+                    "rank0": fs["active_name"] or None,
+                    "state": "up:active" if fs["active_name"] else "down",
                 }
+                for name, fs in sorted(self.filesystems.items())
             ],
+            "standbys": sorted(self.standbys),
         }
+
+
+def _assign_standbys(m: FSMap) -> bool:
+    """Give every active-less filesystem a standby (deterministic order);
+    True when anything changed (FSMap::promote)."""
+    changed = False
+    for name in sorted(m.filesystems):
+        fs = m.filesystems[name]
+        if fs["active_name"] or not m.standbys:
+            continue
+        daemon = sorted(m.standbys)[0]
+        fs["active_name"] = daemon
+        fs["active_addr"] = m.standbys.pop(daemon)
+        changed = True
+    return changed
 
 
 class MDSMonitor:
@@ -85,9 +128,8 @@ class MDSMonitor:
         # Re-baseline beacons: a fresh leader judging against 0.0 would
         # instantly fail a healthy active (same as MgrMonitor).
         now = time.monotonic()
-        for name in [self.map.active_name, *self.map.standbys]:
-            if name:
-                self._last_beacon[name] = now
+        for name in [*self.map.actives().values(), *self.map.standbys]:
+            self._last_beacon[name] = now
 
     # -- beacons ---------------------------------------------------------------
 
@@ -96,53 +138,49 @@ class MDSMonitor:
         self._last_beacon[msg.name] = time.monotonic()
 
         def mutate(m: FSMap):
-            if not m.fs_name:
-                # No filesystem yet: everyone waits as a standby so
-                # `fs new` can promote instantly (MDSMonitor holds boot
-                # beacons in standby until a filesystem wants a rank).
-                if m.standbys.get(msg.name) != msg.addr:
-                    standbys = dict(m.standbys)
-                    standbys[msg.name] = msg.addr
-                    return ("", "", standbys)
-                return None
-            if m.active_name == msg.name:
-                if m.active_addr != msg.addr:
-                    return (msg.name, msg.addr, m.standbys)
-                return None
-            if not m.active_name:
-                standbys = dict(m.standbys)
-                standbys.pop(msg.name, None)
-                return (msg.name, msg.addr, standbys)
-            if m.standbys.get(msg.name) != msg.addr:
-                standbys = dict(m.standbys)
-                standbys[msg.name] = msg.addr
-                return (m.active_name, m.active_addr, standbys)
-            return None
+            changed = False
+            held = m.fs_of_daemon(msg.name)
+            if held:
+                fs = m.filesystems[held]
+                if fs["active_addr"] != msg.addr:
+                    fs["active_addr"] = msg.addr
+                    changed = True
+            elif m.standbys.get(msg.name) != msg.addr:
+                m.standbys[msg.name] = msg.addr
+                changed = True
+            changed |= _assign_standbys(m)
+            return m if changed else None
 
         self._queue(mutate)
 
     def tick(self) -> None:
-        """Fail rank 0 over when its beacons stop (MDSMonitor::tick →
+        """Fail expired actives over (MDSMonitor::tick →
         maybe_replace_gid; driven by the monitor's periodic tick)."""
-        if not self.mon.is_leader() or not self.map.active_name:
+        if not self.mon.is_leader():
             return
-        last = self._last_beacon.get(self.map.active_name, 0.0)
-        if time.monotonic() - last <= BEACON_GRACE:
+        now = time.monotonic()
+        failed = [
+            daemon
+            for daemon in self.map.actives().values()
+            if now - self._last_beacon.get(daemon, 0.0) > BEACON_GRACE
+        ]
+        if not failed:
             return
-        failed = self.map.active_name
-        self._last_beacon.pop(failed, None)
+        for daemon in failed:
+            self._last_beacon.pop(daemon, None)
 
         def mutate(m: FSMap):
-            if m.active_name != failed:
-                return None  # already replaced
-            standbys = dict(m.standbys)
-            if standbys:
-                name = sorted(standbys)[0]
-                addr = standbys.pop(name)
-                dout("mon", 1, f"mds {failed} failed; promoting {name} to rank 0")
-                return (name, addr, standbys)
-            dout("mon", 1, f"mds {failed} failed; no standby — fs degraded")
-            return ("", "", {})
+            changed = False
+            for daemon in failed:
+                held = m.fs_of_daemon(daemon)
+                if not held:
+                    continue  # already replaced
+                fs = m.filesystems[held]
+                fs["active_name"] = fs["active_addr"] = ""
+                changed = True
+                dout("mon", 1, f"mds {daemon} failed; fs {held} rank 0 vacated")
+            changed |= _assign_standbys(m)
+            return m if changed else None
 
         self._queue(mutate)
 
@@ -156,6 +194,9 @@ class MDSMonitor:
                 if not name or not meta or not data:
                     reply(-22, "usage: fs new <fs_name> <metadata> <data>")
                     return
+                if name in self.map.filesystems:
+                    reply(-17, f"filesystem {name!r} already exists")
+                    return
                 osdmap = self.mon.osdmon.osdmap
                 pools = {p.name for p in osdmap.pools.values()}
                 for pool in (meta, data):
@@ -164,21 +205,25 @@ class MDSMonitor:
                         return
 
                 def mutate(m: FSMap):
-                    if m.fs_name:
-                        return None  # single-fs scope: already created
-                    # promote the first waiting standby to rank 0
-                    standbys = dict(m.standbys)
-                    active_name = active_addr = ""
-                    if standbys:
-                        active_name = sorted(standbys)[0]
-                        active_addr = standbys.pop(active_name)
-                    return (active_name, active_addr, standbys, name, meta, data)
+                    if name in m.filesystems:
+                        return None
+                    m.filesystems[name] = {
+                        "meta_pool": meta,
+                        "data_pool": data,
+                        "active_name": "",
+                        "active_addr": "",
+                    }
+                    _assign_standbys(m)
+                    return m
 
                 def on_committed(version: int) -> None:
-                    if version < 0 and self.map.fs_name != name:
-                        reply(-17, f"filesystem {self.map.fs_name!r} already exists")
+                    if version < 0 and name not in self.map.filesystems:
+                        reply(-17, f"filesystem {name!r} already exists")
                     else:
-                        reply(0, f"new fs with metadata pool {meta} and data pool {data}")
+                        reply(
+                            0,
+                            f"new fs with metadata pool {meta} and data pool {data}",
+                        )
 
                 self._queue(mutate, on_committed)
 
@@ -190,15 +235,21 @@ class MDSMonitor:
                 if not name:
                     reply(-22, "usage: fs rm <fs_name>")
                     return
-                if name != self.map.fs_name:
-                    # a typo'd name must not remove the real filesystem
+                if name not in self.map.filesystems:
+                    # a typo'd name must not remove a real filesystem
                     reply(-2, f"filesystem {name!r} does not exist")
                     return
 
                 def mutate(m: FSMap):
-                    if m.fs_name != name:
+                    fs = m.filesystems.pop(name, None)
+                    if fs is None:
                         return None
-                    return ("", "", dict(m.standbys), "", "", "")
+                    # its active returns to the standby pool (the daemon
+                    # demotes itself when the map stops naming it)
+                    if fs["active_name"]:
+                        m.standbys[fs["active_name"]] = fs["active_addr"]
+                    _assign_standbys(m)
+                    return m
 
                 self._queue(mutate, lambda v: reply(0, f"fs {name!r} removed"))
 
@@ -215,25 +266,11 @@ class MDSMonitor:
 
     def _queue(self, mutate, on_committed=None) -> None:
         def make_blob():
-            result = mutate(self.map)
+            scratch = FSMap.scratch(self.map)
+            result = mutate(scratch)
             if result is None:
                 return None
-            if len(result) == 3:
-                active_name, active_addr, standbys = result
-                fs = (self.map.fs_name, self.map.meta_pool, self.map.data_pool)
-            else:
-                active_name, active_addr, standbys, *fs = result
-            return json.dumps(
-                {
-                    "epoch": self.map.epoch + 1,
-                    "fs_name": fs[0],
-                    "meta_pool": fs[1],
-                    "data_pool": fs[2],
-                    "active_name": active_name,
-                    "active_addr": active_addr,
-                    "standbys": standbys,
-                }
-            ).encode()
+            return result.to_blob(self.map.epoch + 1)
 
         self._props.queue(make_blob, on_committed)
 
@@ -241,16 +278,12 @@ class MDSMonitor:
         info = json.loads(blob.decode())
         m = self.map
         m.epoch = info["epoch"]
-        m.fs_name = info["fs_name"]
-        m.meta_pool = info["meta_pool"]
-        m.data_pool = info["data_pool"]
-        m.active_name = info["active_name"]
-        m.active_addr = info["active_addr"]
+        m.filesystems = info["filesystems"]
         m.standbys = dict(info["standbys"])
         dout(
             "mon", 10,
-            f"fsmap e{m.epoch}: fs={m.fs_name or '(none)'} "
-            f"rank0={m.active_name or '(none)'} standbys={sorted(m.standbys)}",
+            f"fsmap e{m.epoch}: {sorted(m.actives().items())} "
+            f"standbys={sorted(m.standbys)}",
         )
         self.mon.publish_mdsmap()
 

@@ -437,12 +437,16 @@ class Monitor(Dispatcher):
                 if len(self.quorum) < self.monmap.size():
                     out = self.monmap.size() - len(self.quorum)
                     checks["MON_DOWN"] = f"{out} monitor(s) out of quorum"
-                fsmap = self.mdsmon.map
-                if fsmap.fs_name and not fsmap.active_name:
+                down_fs = [
+                    name
+                    for name, fs in self.mdsmon.map.filesystems.items()
+                    if not fs["active_name"]
+                ]
+                if down_fs:
                     # a filesystem with no rank 0 serves nothing
                     # (MDSMonitor MDS_ALL_DOWN health check)
                     checks["MDS_ALL_DOWN"] = (
-                        f"fs {fsmap.fs_name} has no active MDS"
+                        f"fs {', '.join(sorted(down_fs))} has no active MDS"
                     )
                 reply(
                     0,

@@ -654,14 +654,15 @@ class MMonMgrReport(Message):
 @message_type(40)
 class MMDSMap(Message):
     """Mon -> subscribers: the FSMap (src/messages/MMDSMap.h + FSMap):
-    which daemon holds rank 0 (active) for the one filesystem, plus the
-    standby pool.  Clients resolve the active MDS from this; standby
-    daemons learn here that they have been promoted."""
+    per-filesystem rank-0 holders plus the shared standby pool, as a
+    JSON envelope {"filesystems": {name: {meta_pool, data_pool,
+    active_name, active_addr}}, "standbys": {daemon: addr}}.  Clients
+    resolve their filesystem's active MDS from this; standby daemons
+    learn here which filesystem they were promoted to."""
 
-    FIELDS = [
-        ("epoch", "u32"),
-        ("fs_name", "str"),
-        ("active_name", "str"),
-        ("active_addr", "str"),
-        ("standbys", ("list", "str")),
-    ]
+    FIELDS = [("epoch", "u32"), ("fsmap", "bytes")]
+
+    def filesystems(self) -> dict:
+        import json as _json
+
+        return _json.loads(self.fsmap.decode() or "{}").get("filesystems", {})

@@ -67,6 +67,7 @@ class DevCluster:
         self.mds = None  # the active MDS (rank 0)
         self.mds_daemons: list = []
         self._mds_rados = None
+        self._mds_radoses: list = []
 
     async def start(self) -> MonMap:
         # ms_type applies cluster-wide (every daemon + client must share a
@@ -160,11 +161,15 @@ class DevCluster:
             )
             assert rv == 0, f"fs new failed: {rs}"
             for name in ("a", "b")[: max(1, self.n_mds)]:
-                meta = await self._mds_rados.open_ioctx("cephfs_metadata")
-                data = await self._mds_rados.open_ioctx("cephfs_data")
+                # each daemon gets its own RADOS client and binds its
+                # assigned filesystem's pools at promotion (multi-fs FSMap)
+                r = Rados(
+                    self.monmap, name=f"client.mds-{name}", stack=self._stack
+                )
+                await r.connect()
+                self._mds_radoses.append(r)
                 d = MDS(
-                    meta, data, stack=self._stack, name=name,
-                    monmap=self.monmap,
+                    stack=self._stack, name=name, monmap=self.monmap, rados=r,
                 )
                 await d.start()
                 self.mds_daemons.append(d)
@@ -188,6 +193,9 @@ class DevCluster:
             await d.stop()
         self.mds_daemons.clear()
         self.mds = None
+        for r in self._mds_radoses:
+            await r.shutdown()
+        self._mds_radoses.clear()
         if self._mds_rados is not None:
             await self._mds_rados.shutdown()
         if self.mgr is not None:

@@ -61,13 +61,85 @@ def test_fsmap_ranks_and_status():
         fs = st["filesystems"][0]
         assert fs["name"] == "cephfs"
         assert fs["rank0"] == cluster.mds.name
-        assert len(fs["standbys"]) == 1
+        assert len(st["standbys"]) == 1  # shared standby pool (FSMap.h)
         assert fs["state"] == "up:active"
         # `ceph status` carries the fsmap line
         rv, _, out = await client.mon_command({"prefix": "status"})
         assert rv == 0
         assert json.loads(out)["fsmap"]["filesystems"][0]["name"] == "cephfs"
         await client.shutdown()
+        await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_multiple_filesystems_independent_namespaces():
+    """FSMap multi-fs (src/mds/FSMap.h filesystems map): two `fs new`
+    filesystems each get their own rank 0 from the shared standby pool,
+    serve DISJOINT namespaces from their own pools, and `fs rm` of one
+    returns its daemon to the standby pool without touching the other."""
+
+    async def run():
+        import json
+
+        from ceph_tpu.mds.mds import MDS
+
+        cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=False, with_mds=True)
+        await cluster.start()
+        rados = Rados(cluster.monmap)
+        await rados.connect()
+        # a second filesystem over its own pools; the standby takes it
+        await rados.pool_create("fs2_meta", "replicated", size=2, pg_num=2)
+        await rados.pool_create("fs2_data", "replicated", size=2, pg_num=2)
+        rv, rs, _ = await rados.mon_command(
+            {"prefix": "fs new", "fs_name": "fs2", "metadata": "fs2_meta",
+             "data": "fs2_data"}
+        )
+        assert rv == 0, rs
+        await wait_until(
+            lambda: sum(d.state == "active" for d in cluster.mds_daemons) == 2,
+            10.0,
+            "both filesystems get a rank 0",
+        )
+        assert {d.fs_name for d in cluster.mds_daemons} == {"cephfs", "fs2"}
+        # duplicate fs name rejected
+        rv, _, _ = await rados.mon_command(
+            {"prefix": "fs new", "fs_name": "fs2", "metadata": "fs2_meta",
+             "data": "fs2_data"}
+        )
+        assert rv != 0
+        # disjoint namespaces through fs_name-addressed clients
+        d1 = await rados.open_ioctx("cephfs_data")
+        d2 = await rados.open_ioctx("fs2_data")
+        c1 = CephFSClient(data_ioctx=d1, monmap=cluster.monmap,
+                          fs_name="cephfs", name="client.c1")
+        c2 = CephFSClient(data_ioctx=d2, monmap=cluster.monmap,
+                          fs_name="fs2", name="client.c2")
+        await c1.connect()
+        await c2.connect()
+        await c1.write_file("/one", b"fs one")
+        await c2.write_file("/two", b"fs two")
+        assert await c1.listdir("/") == ["one"]
+        assert await c2.listdir("/") == ["two"]
+        assert await c2.read_file("/two") == b"fs two"
+        # fs status lists both
+        rv, _, out = await rados.mon_command({"prefix": "fs status"})
+        st = json.loads(out)
+        assert [f["name"] for f in st["filesystems"]] == ["cephfs", "fs2"]
+        # removing fs2 frees its daemon back into the standby pool
+        rv, _, _ = await rados.mon_command(
+            {"prefix": "fs rm", "fs_name": "fs2"}
+        )
+        assert rv == 0
+        await wait_until(
+            lambda: sum(d.state == "standby" for d in cluster.mds_daemons) == 1,
+            10.0,
+            "fs2's daemon demoted to standby",
+        )
+        assert await c1.read_file("/one") == b"fs one"  # cephfs untouched
+        await c1.shutdown()
+        await c2.shutdown()
+        await rados.shutdown()
         await cluster.stop()
 
     asyncio.run(run())
