@@ -134,25 +134,46 @@ class SwiftServer:
         container = parts[1] if len(parts) > 1 else ""
         obj = parts[2] if len(parts) > 2 else ""
 
+        uid = None
         if self.require_auth:
             uid = self._verify_token(headers.get("x-auth-token", ""))
-            if uid is None or uid != account:
+            if uid is None:
                 return "401 Unauthorized", {}, b""
+            # account-level ops are the owner's; container/object access
+            # across accounts is decided by container ACLs (rgw_swift's
+            # read/write ACL model)
+            if not container and uid != account:
+                return "403 Forbidden", {}, b""
 
         try:
             if not container:
-                return await self._account_op(method, account, query)
+                return await self._account_op(method, account, query, uid)
             if not obj:
-                return await self._container_op(method, container, query)
-            return await self._object_op(method, container, obj, headers, body)
+                return await self._container_op(method, container, query,
+                                                headers, uid)
+            return await self._object_op(
+                method, container, obj, headers, body, uid
+            )
         except RgwError as e:
             status = {
                 "NoSuchBucket": "404 Not Found",
                 "NoSuchKey": "404 Not Found",
+                "AccessDenied": "403 Forbidden",
                 "BucketAlreadyExists": "202 Accepted",  # swift PUT is idempotent
                 "BucketNotEmpty": "409 Conflict",
             }.get(e.code, "400 Bad Request")
             return status, {}, b""
+
+    @staticmethod
+    def _acl_grants(value: str, perm: str) -> dict:
+        """X-Container-Read/Write -> grant map: ".r:*" is world access,
+        otherwise a comma list of account uids (rgw_swift ACL parsing)."""
+        grants: dict = {}
+        for tok in (t.strip() for t in value.split(",")):
+            if not tok:
+                continue
+            grants["*" if tok in (".r:*", ".referrer:*") else tok] = perm
+        return grants
 
     async def _auth(self, method: str, headers: dict):
         if method != "GET":
@@ -178,10 +199,10 @@ class SwiftServer:
             b"",
         )
 
-    async def _account_op(self, method: str, account: str, query: dict):
+    async def _account_op(self, method: str, account: str, query: dict, uid):
         if method not in ("GET", "HEAD"):
             return "405 Method Not Allowed", {}, b""
-        names = await self.gw.list_buckets()
+        names = await self.gw.list_buckets(owner=uid if uid else None)
         if method == "HEAD":
             return "204 No Content", {"X-Account-Container-Count": str(len(names))}, b""
         if query.get("format", [""])[0] == "json":
@@ -196,16 +217,41 @@ class SwiftServer:
             ("\n".join(names) + "\n" if names else "").encode(),
         )
 
-    async def _container_op(self, method: str, container: str, query: dict):
+    async def _container_op(
+        self, method: str, container: str, query: dict, headers: dict, uid
+    ):
         if method == "PUT":
+            grants: dict = {}
+            for hdr, perm in (
+                ("x-container-read", "READ"), ("x-container-write", "WRITE")
+            ):
+                if hdr in headers:
+                    grants.update(self._acl_grants(headers[hdr], perm))
             try:
-                await self.gw.create_bucket(container)
+                await self.gw.create_bucket(
+                    container, owner=uid or "", grants=grants
+                )
                 return "201 Created", {}, b""
             except RgwError as e:
                 if e.code == "BucketAlreadyExists":
                     return "202 Accepted", {}, b""  # idempotent in swift
                 raise
+        if method == "POST":
+            # update container ACLs (swift POST metadata semantics)
+            acl = await self.gw.get_bucket_acl(container, actor=uid)
+            grants = dict(acl["grants"])
+            for hdr, perm in (
+                ("x-container-read", "READ"), ("x-container-write", "WRITE")
+            ):
+                if hdr in headers:
+                    grants = {
+                        g: p for g, p in grants.items() if p != perm
+                    }
+                    grants.update(self._acl_grants(headers[hdr], perm))
+            await self.gw.set_bucket_acl(container, grants, actor=uid)
+            return "204 No Content", {}, b""
         if method == "DELETE":
+            await self.gw._require_access(container, uid, "FULL_CONTROL")
             await self.gw.delete_bucket(container)
             return "204 No Content", {}, b""
         if method in ("GET", "HEAD"):
@@ -214,6 +260,7 @@ class SwiftServer:
                 prefix=query.get("prefix", [""])[0],
                 marker=query.get("marker", [""])[0],
                 max_keys=int(query.get("limit", ["10000"])[0]),
+                actor=uid,
             )
             if method == "HEAD":
                 return (
@@ -245,7 +292,8 @@ class SwiftServer:
         return "405 Method Not Allowed", {}, b""
 
     async def _object_op(
-        self, method: str, container: str, obj: str, headers: dict, body: bytes
+        self, method: str, container: str, obj: str, headers: dict,
+        body: bytes, uid,
     ):
         if method == "PUT":
             meta = {
@@ -253,10 +301,12 @@ class SwiftServer:
                 for name, value in headers.items()
                 if name.startswith("x-object-meta-")
             }
-            etag, _vid = await self.gw.put_object(container, obj, body, meta=meta)
+            etag, _vid = await self.gw.put_object(
+                container, obj, body, meta=meta, actor=uid
+            )
             return "201 Created", {"ETag": etag}, b""
         if method in ("GET", "HEAD"):
-            info = await self.gw.head_object(container, obj)
+            info = await self.gw.head_object(container, obj, actor=uid)
             resp_headers = {
                 "ETag": info["etag"],
                 "Content-Type": "application/octet-stream",
@@ -267,10 +317,10 @@ class SwiftServer:
             if method == "HEAD":
                 resp_headers["Content-Length"] = str(info["size"])
                 return "200 OK", resp_headers, b""
-            data = await self.gw.get_object(container, obj)
+            data = await self.gw.get_object(container, obj, actor=uid)
             return "200 OK", resp_headers, data
         if method == "DELETE":
-            await self.gw.head_object(container, obj)  # 404 when absent
-            await self.gw.delete_object(container, obj)
+            await self.gw.head_object(container, obj, actor=uid)  # 404 if absent
+            await self.gw.delete_object(container, obj, actor=uid)
             return "204 No Content", {}, b""
         return "405 Method Not Allowed", {}, b""

@@ -158,3 +158,75 @@ class TestSwiftApi:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestSwiftContainerAcls:
+    def test_cross_account_acls(self):
+        """X-Container-Read ACLs (rgw_swift read/write ACL model): a
+        second account is denied until the owner grants read — and still
+        cannot write; .r:* grants the world read."""
+
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("swacl")
+            gw = ObjectGateway(ioctx)
+            alice = await gw.create_user("alice")
+            bob = await gw.create_user("bob")
+            server = SwiftServer(gw)
+            base = f"http://{await server.serve()}"
+            loop = asyncio.get_event_loop()
+
+            def call(method, path, data=None, headers=None):
+                return loop.run_in_executor(
+                    None, lambda: _req(base, method, path, data, headers)
+                )
+
+            async def token(user, uid):
+                auth = await call("GET", "/auth/v1.0", headers={
+                    "X-Auth-User": f"{uid}:swift",
+                    "X-Auth-Key": user["secret_key"]})
+                return {"X-Auth-Token": auth.headers["X-Auth-Token"]}
+
+            ta, tb = await token(alice, "alice"), await token(bob, "bob")
+            assert (
+                await call("PUT", "/v1/AUTH_alice/priv", headers=ta)
+            ).status == 201
+            assert (
+                await call("PUT", "/v1/AUTH_alice/priv/o", b"secret", headers=ta)
+            ).status == 201
+            # bob (cross-account) is denied read and write
+            for method, path, data in (
+                ("GET", "/v1/AUTH_alice/priv/o", None),
+                ("PUT", "/v1/AUTH_alice/priv/mine", b"x"),
+                ("GET", "/v1/AUTH_alice/priv", None),
+            ):
+                try:
+                    await call(method, path, data, headers=tb)
+                    raise AssertionError(f"bob {method} {path} allowed")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 403, (method, path)
+            # the owner grants bob read via POST X-Container-Read
+            assert (
+                await call("POST", "/v1/AUTH_alice/priv",
+                           headers={**ta, "X-Container-Read": "bob"})
+            ).status == 204
+            got = await call("GET", "/v1/AUTH_alice/priv/o", headers=tb)
+            assert got.read() == b"secret"
+            # ...but not write
+            try:
+                await call("PUT", "/v1/AUTH_alice/priv/mine", b"x", headers=tb)
+                raise AssertionError("read grant allowed a write")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+            # .r:* at create time = world-readable container
+            assert (
+                await call("PUT", "/v1/AUTH_alice/pub",
+                           headers={**ta, "X-Container-Read": ".r:*"})
+            ).status == 201
+            await call("PUT", "/v1/AUTH_alice/pub/p", b"open", headers=ta)
+            got = await call("GET", "/v1/AUTH_alice/pub/p", headers=tb)
+            assert got.read() == b"open"
+            await server.shutdown()
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
