@@ -239,12 +239,14 @@ class S3Server:
     @staticmethod
     def _canned_grants(headers: dict) -> dict:
         """x-amz-acl canned ACL -> grant map (rgw_acl_s3.cc canned
-        policies; private is the empty grant set — owner only)."""
+        policies; private is the empty grant set — owner only).  READ and
+        WRITE are independent permissions, so public-read-write grants
+        both explicitly."""
         canned = headers.get("x-amz-acl", "private")
         if canned == "public-read":
             return {"*": "READ"}
         if canned == "public-read-write":
-            return {"*": "WRITE"}
+            return {"*": ["READ", "WRITE"]}
         return {}
 
     async def _bucket_op(
@@ -326,7 +328,8 @@ class S3Server:
             acl = await self.gw.get_bucket_acl(bucket, actor=actor)
             grants = "".join(
                 f"<Grant><Grantee>{_x(g)}</Grantee>"
-                f"<Permission>{_x(p)}</Permission></Grant>"
+                f"<Permission>{_x(p if isinstance(p, str) else '+'.join(sorted(p)))}"
+                f"</Permission></Grant>"
                 for g, p in sorted(acl["grants"].items())
             )
             return (

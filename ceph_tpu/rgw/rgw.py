@@ -34,12 +34,19 @@ from ..striper import StripedObject, StripePolicy
 USERS_OID = "rgw.users"
 BUCKETS_OID = "rgw.buckets"
 
-# ACL permissions (rgw_acl.h RGW_PERM_*), simplified to a hierarchy:
-# FULL_CONTROL > WRITE > READ (the reference treats them as independent
-# bits; the containment ordering is the common-case subset and is
-# documented as the delta).
-PERM_ORDER = {"READ": 1, "WRITE": 2, "FULL_CONTROL": 3}
+# ACL permissions (rgw_acl.h RGW_PERM_*): READ and WRITE are INDEPENDENT
+# bits, as in the reference — a write-only grant must not disclose object
+# bytes (the Swift drop-box pattern) and a read grant must not allow
+# writes.  FULL_CONTROL implies both plus ACL administration.  A grant
+# value is one permission or a list of them.
 ALL_USERS = "*"  # the AllUsers group grantee (anonymous included)
+
+
+def _perm_set(value) -> set[str]:
+    perms = {value} if isinstance(value, str) else set(value)
+    if "FULL_CONTROL" in perms:
+        perms |= {"READ", "WRITE"}
+    return perms
 
 
 class RgwError(Exception):
@@ -137,10 +144,9 @@ class ObjectGateway:
         if actor == owner:
             return True  # owner always has FULL_CONTROL
         grants = info.get("grants", {})
-        need_rank = PERM_ORDER[need]
         for grantee, perm in grants.items():
             if grantee == ALL_USERS or grantee == actor:
-                if PERM_ORDER.get(perm, 0) >= need_rank:
+                if need in _perm_set(perm):
                     return True
         return False
 

@@ -137,12 +137,21 @@ class SwiftServer:
         uid = None
         if self.require_auth:
             uid = self._verify_token(headers.get("x-auth-token", ""))
-            if uid is None:
+            anonymous_read = (
+                uid is None and container and method in ("GET", "HEAD")
+            )
+            if uid is None and not anonymous_read:
+                # anonymous traffic may only attempt reads — which a
+                # container's .r:* (AllUsers) READ grant can then allow;
+                # everything else needs a token (rgw_swift anon handling)
                 return "401 Unauthorized", {}, b""
-            # account-level ops are the owner's; container/object access
-            # across accounts is decided by container ACLs (rgw_swift's
+            # account-level ops and container CREATION belong to the
+            # account's owner; other container/object access across
+            # accounts is decided by container ACLs (rgw_swift's
             # read/write ACL model)
             if not container and uid != account:
+                return "403 Forbidden", {}, b""
+            if container and not obj and method == "PUT" and uid != account:
                 return "403 Forbidden", {}, b""
 
         try:
@@ -159,21 +168,51 @@ class SwiftServer:
                 "NoSuchBucket": "404 Not Found",
                 "NoSuchKey": "404 Not Found",
                 "AccessDenied": "403 Forbidden",
-                "BucketAlreadyExists": "202 Accepted",  # swift PUT is idempotent
                 "BucketNotEmpty": "409 Conflict",
             }.get(e.code, "400 Bad Request")
             return status, {}, b""
 
     @staticmethod
-    def _acl_grants(value: str, perm: str) -> dict:
-        """X-Container-Read/Write -> grant map: ".r:*" is world access,
-        otherwise a comma list of account uids (rgw_swift ACL parsing)."""
-        grants: dict = {}
+    def _acl_grantees(value: str, perm: str) -> list[str]:
+        """X-Container-Read/Write -> grantee list: ".r:*" is world READ,
+        otherwise a comma list of account uids (rgw_swift ACL parsing).
+        Referrer tokens are READ-only — the reference rejects them in
+        write ACLs, where a world-WRITE would be catastrophic."""
+        out = []
         for tok in (t.strip() for t in value.split(",")):
             if not tok:
                 continue
-            grants["*" if tok in (".r:*", ".referrer:*") else tok] = perm
-        return grants
+            if tok in (".r:*", ".referrer:*"):
+                if perm != "READ":
+                    from ..common.errs import EINVAL
+
+                    raise RgwError(
+                        EINVAL, "InvalidArgument",
+                        "referrer tokens are read-only",
+                    )
+                out.append("*")
+            else:
+                out.append(tok)
+        return out
+
+    def _merge_acl_headers(self, grants: dict, headers: dict) -> dict:
+        """Apply X-Container-Read/Write headers onto a grant map keeping
+        READ and WRITE lists INDEPENDENT per grantee (swift's two ACL
+        lists): setting one list never disturbs the other."""
+        merged: dict[str, set] = {
+            g: set(p if isinstance(p, (list, set)) else [p])
+            for g, p in grants.items()
+        }
+        for hdr, perm in (
+            ("x-container-read", "READ"), ("x-container-write", "WRITE")
+        ):
+            if hdr not in headers:
+                continue
+            for perms in merged.values():
+                perms.discard(perm)
+            for grantee in self._acl_grantees(headers[hdr], perm):
+                merged.setdefault(grantee, set()).add(perm)
+        return {g: sorted(p) for g, p in merged.items() if p}
 
     async def _auth(self, method: str, headers: dict):
         if method != "GET":
@@ -221,34 +260,31 @@ class SwiftServer:
         self, method: str, container: str, query: dict, headers: dict, uid
     ):
         if method == "PUT":
-            grants: dict = {}
-            for hdr, perm in (
-                ("x-container-read", "READ"), ("x-container-write", "WRITE")
-            ):
-                if hdr in headers:
-                    grants.update(self._acl_grants(headers[hdr], perm))
             try:
                 await self.gw.create_bucket(
-                    container, owner=uid or "", grants=grants
+                    container, owner=uid or "",
+                    grants=self._merge_acl_headers({}, headers),
                 )
                 return "201 Created", {}, b""
             except RgwError as e:
-                if e.code == "BucketAlreadyExists":
-                    return "202 Accepted", {}, b""  # idempotent in swift
-                raise
+                if e.code != "BucketAlreadyExists":
+                    raise
+            # existing container: swift's PUT is a metadata update — ACL
+            # headers apply, gated on FULL_CONTROL like any ACL change
+            # (a non-owner gets 403, not a silent 202)
+            acl = await self.gw.get_bucket_acl(container, actor=uid)
+            await self.gw.set_bucket_acl(
+                container, self._merge_acl_headers(acl["grants"], headers),
+                actor=uid,
+            )
+            return "202 Accepted", {}, b""
         if method == "POST":
             # update container ACLs (swift POST metadata semantics)
             acl = await self.gw.get_bucket_acl(container, actor=uid)
-            grants = dict(acl["grants"])
-            for hdr, perm in (
-                ("x-container-read", "READ"), ("x-container-write", "WRITE")
-            ):
-                if hdr in headers:
-                    grants = {
-                        g: p for g, p in grants.items() if p != perm
-                    }
-                    grants.update(self._acl_grants(headers[hdr], perm))
-            await self.gw.set_bucket_acl(container, grants, actor=uid)
+            await self.gw.set_bucket_acl(
+                container, self._merge_acl_headers(acl["grants"], headers),
+                actor=uid,
+            )
             return "204 No Content", {}, b""
         if method == "DELETE":
             await self.gw._require_access(container, uid, "FULL_CONTROL")

@@ -217,7 +217,35 @@ class TestSwiftContainerAcls:
                 raise AssertionError("read grant allowed a write")
             except urllib.error.HTTPError as e:
                 assert e.code == 403
-            # .r:* at create time = world-readable container
+            # a WRITE-ONLY grant (drop box) must not disclose reads
+            assert (
+                await call("POST", "/v1/AUTH_alice/priv",
+                           headers={**ta, "X-Container-Read": "",
+                                    "X-Container-Write": "bob"})
+            ).status == 204
+            assert (
+                await call("PUT", "/v1/AUTH_alice/priv/drop", b"d", headers=tb)
+            ).status == 201
+            try:
+                await call("GET", "/v1/AUTH_alice/priv/o", headers=tb)
+                raise AssertionError("write-only grant disclosed a read")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+            # referrer tokens are read-only: .r:* in the WRITE header -> 400
+            try:
+                await call("POST", "/v1/AUTH_alice/priv",
+                           headers={**ta, "X-Container-Write": ".r:*"})
+                raise AssertionError("world-WRITE accepted")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            # bob cannot create containers under alice's account URL
+            try:
+                await call("PUT", "/v1/AUTH_alice/squat", headers=tb)
+                raise AssertionError("cross-account container create allowed")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+            # .r:* at create time = world-readable container, even
+            # ANONYMOUSLY (no token at all)
             assert (
                 await call("PUT", "/v1/AUTH_alice/pub",
                            headers={**ta, "X-Container-Read": ".r:*"})
@@ -225,6 +253,14 @@ class TestSwiftContainerAcls:
             await call("PUT", "/v1/AUTH_alice/pub/p", b"open", headers=ta)
             got = await call("GET", "/v1/AUTH_alice/pub/p", headers=tb)
             assert got.read() == b"open"
+            got = await call("GET", "/v1/AUTH_alice/pub/p")  # tokenless
+            assert got.read() == b"open"
+            # ...but anonymous writes still need a token
+            try:
+                await call("PUT", "/v1/AUTH_alice/pub/w", b"x")
+                raise AssertionError("anonymous write accepted")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
             await server.shutdown()
             await client.shutdown()
             await stop_cluster(mons, osds)
