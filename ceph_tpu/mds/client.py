@@ -292,6 +292,13 @@ class CephFSClient(Dispatcher):
         if replaced and replaced.get("type") == "file":
             await self._purge(replaced)
 
+    async def symlink(self, target: str, path: str) -> None:
+        """ceph_symlink: create `path` pointing at `target`."""
+        await self._request("symlink", {"path": path, "target": target})
+
+    async def readlink(self, path: str) -> str:
+        return (await self._request("readlink", {"path": path}))["target"]
+
     async def rmdir(self, path: str) -> None:
         await self._request("rmdir", {"path": path})
 

@@ -512,6 +512,10 @@ class MDS(Dispatcher):
             return await self._op_setattr(conn, args)
         if op == "open":
             return await self._op_open(conn, args)
+        if op == "symlink":
+            return await self._op_symlink(args)
+        if op == "readlink":
+            return await self._op_readlink(args)
         raise _Err(EINVAL, f"unknown mds op {op!r}")
 
     async def _op_mkdir(self, args) -> dict:
@@ -546,6 +550,32 @@ class MDS(Dispatcher):
         caps = await self._acquire_caps(conn, ino, args.get("caps", "w"))
         return {"entry": entry, "caps": caps}
 
+    async def _op_symlink(self, args) -> dict:
+        """Server::handle_client_symlink: a dentry of type symlink whose
+        target string lives in the entry (CInode symlink member)."""
+        pino, pdir, name = await self._walk_parent(args["path"])
+        if name in pdir:
+            raise _Err(EEXIST, f"{args['path']} exists")
+        ino = self._next_ino
+        entry = {
+            "ino": ino, "type": "symlink", "target": args["target"],
+            "mtime": time.time(),
+        }
+        await self._journal(
+            {"op": "inotable", "next": ino + 1},
+            {"op": "set_dentry", "dir": pino, "name": name, "entry": entry},
+        )
+        return {"entry": entry}
+
+    async def _op_readlink(self, args) -> dict:
+        pino, pdir, name = await self._walk_parent(args["path"])
+        entry = pdir.get(name)
+        if entry is None:
+            raise _Err(ENOENT, args["path"])
+        if entry["type"] != "symlink":
+            raise _Err(EINVAL, f"{args['path']} is not a symlink")
+        return {"target": entry["target"]}
+
     async def _op_lookup(self, args) -> dict:
         pino, pdir, name = await self._walk_parent(args["path"])
         entry = pdir.get(name)
@@ -558,7 +588,7 @@ class MDS(Dispatcher):
         entry = pdir.get(name)
         if entry is None:
             raise _Err(ENOENT, args["path"])
-        if entry["type"] != "file":
+        if entry["type"] == "dir":
             raise _Err(EINVAL, f"{args['path']} is a directory (use rmdir)")
         await self._journal(
             {"op": "rm_dentry", "dir": pino, "name": name}

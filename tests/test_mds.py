@@ -247,3 +247,45 @@ class TestCapabilities:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestSymlinks:
+    def test_symlink_readlink_unlink(self):
+        """Server::handle_client_symlink essence: symlink dentries hold
+        their target; readlink resolves EXPLICITLY (the client follows,
+        as in the reference's client-side symlink traversal); unlink
+        removes them like files; they survive journal replay."""
+
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            fsc = CephFSClient(mds.addr, data)
+            await fsc.mkdir("/d")
+            await fsc.write_file("/d/real.txt", b"pointed-at")
+            await fsc.symlink("/d/real.txt", "/d/link")
+            assert await fsc.readlink("/d/link") == "/d/real.txt"
+            st = await fsc.stat("/d/link")
+            assert st["type"] == "symlink"
+            # explicit client-side follow
+            assert await fsc.read_file(await fsc.readlink("/d/link")) == b"pointed-at"
+            assert sorted(await fsc.listdir("/d")) == ["link", "real.txt"]
+            with pytest.raises(FsClientError):
+                await fsc.readlink("/d/real.txt")  # not a symlink
+            with pytest.raises(FsClientError):
+                await fsc.symlink("/x", "/d/link")  # EEXIST
+            # symlinks survive an MDS crash via journal replay
+            await mds.stop(flush=False)
+            mds2 = MDS(meta, data)
+            await mds2.start()
+            fsc2 = CephFSClient(mds2.addr, data, name="client.fs2")
+            assert await fsc2.readlink("/d/link") == "/d/real.txt"
+            # unlink removes the link, not the target
+            await fsc2.unlink("/d/link")
+            assert await fsc2.listdir("/d") == ["real.txt"]
+            assert await fsc2.read_file("/d/real.txt") == b"pointed-at"
+            await fsc.shutdown()
+            await fsc2.shutdown()
+            await mds2.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
