@@ -91,6 +91,10 @@ class PeeringState:
         self.missing = Missing()  # our own missing objects
         self.peer_missing: dict[int, Missing] = {}  # primary-only
         self.backfill_targets: set[int] = set()
+        # lifetime count of backfills STARTED (pg stats' backfill state
+        # counter): survives completion, so tests/operators can tell a
+        # finished backfill from one that never happened
+        self.backfill_started_total = 0
         # per-target sorted-namespace cursor: objects <= cursor are
         # backfilled ("" = none yet; advanced by PG._kick_backfill)
         self.last_backfill: dict[int, str] = {}
@@ -341,6 +345,7 @@ class PeeringState:
                 # client writes are not blocked as degraded; the PG's
                 # backfill driver copies the namespace behind a cursor.
                 self.backfill_targets.add(osd)
+                self.backfill_started_total += 1
                 self.last_backfill[osd] = ""
                 self.peer_missing[osd] = Missing()
                 delta = list(self.log.entries)
