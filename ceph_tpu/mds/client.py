@@ -283,6 +283,11 @@ class CephFSClient(Dispatcher):
     async def listdir(self, path: str = "/") -> list[str]:
         return (await self._request("readdir", {"path": path}))["entries"]
 
+    async def listdir_plus(self, path: str = "/") -> dict[str, dict]:
+        """readdirplus: name -> entry stat record in one round trip
+        (Client::readdirplus; saves the per-entry lookup storm)."""
+        return (await self._request("readdirplus", {"path": path}))["entries"]
+
     async def stat(self, path: str) -> dict:
         return (await self._request("lookup", {"path": path}))["entry"]
 

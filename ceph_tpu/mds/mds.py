@@ -502,6 +502,11 @@ class MDS(Dispatcher):
         if op == "readdir":
             ino, d = await self._walk(args["path"])
             return {"entries": sorted(d)}
+        if op == "readdirplus":
+            # Server::handle_client_readdir with stat records inline (the
+            # reference's readdir returns full InodeStats per dentry)
+            ino, d = await self._walk(args["path"])
+            return {"entries": {n: d[n] for n in sorted(d)}}
         if op == "unlink":
             return await self._op_unlink(args)
         if op == "rmdir":

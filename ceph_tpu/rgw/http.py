@@ -259,6 +259,19 @@ class S3Server:
             return await self._versioning_op(method, bucket, body, actor)
         if "lifecycle" in query:
             return await self._lifecycle_op(method, bucket, body, actor)
+        if "uploads" in query and method == "GET":
+            ups = await self.gw.list_multipart_uploads(bucket, actor=actor)
+            rows = "".join(
+                f"<Upload><Key>{_x(u['key'])}</Key>"
+                f"<UploadId>{_x(u['upload_id'])}</UploadId></Upload>"
+                for u in ups
+            )
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<ListMultipartUploadsResult>{rows}"
+                f"</ListMultipartUploadsResult>".encode(),
+            )
         if "versions" in query and method == "GET":
             versions = await self.gw.list_object_versions(
                 bucket, prefix=query.get("prefix", [""])[0], actor=actor
@@ -411,8 +424,59 @@ class S3Server:
         headers: dict, actor,
     ):
         version_id = query.get("versionId", [""])[0]
+        upload_id = query.get("uploadId", [""])[0]
+        if "uploads" in query and method == "POST":
+            # InitiateMultipartUpload (RGWInitMultipart)
+            uid = await self.gw.initiate_multipart(bucket, key, actor=actor)
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<InitiateMultipartUploadResult><Bucket>{_x(bucket)}</Bucket>"
+                f"<Key>{_x(key)}</Key><UploadId>{_x(uid)}</UploadId>"
+                f"</InitiateMultipartUploadResult>".encode(),
+            )
+        if upload_id and method == "PUT":
+            # UploadPart
+            pn = _int_arg(query.get("partNumber", ["0"])[0])
+            etag = await self.gw.upload_part(upload_id, pn, body)
+            return "200 OK", {"ETag": f'"{etag}"'}, b""
+        if upload_id and method == "GET":
+            parts = await self.gw.list_parts(upload_id)
+            rows = "".join(
+                f"<Part><PartNumber>{p['part_number']}</PartNumber>"
+                f"<Size>{p['size']}</Size>"
+                f"<ETag>&quot;{p['etag']}&quot;</ETag></Part>"
+                for p in parts
+            )
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<ListPartsResult>{rows}</ListPartsResult>".encode(),
+            )
+        if upload_id and method == "POST":
+            # CompleteMultipartUpload
+            etag = await self.gw.complete_multipart(upload_id, actor=actor)
+            return (
+                "200 OK",
+                {"Content-Type": "application/xml"},
+                f"<CompleteMultipartUploadResult><ETag>&quot;{etag}&quot;"
+                f"</ETag></CompleteMultipartUploadResult>".encode(),
+            )
+        if upload_id and method == "DELETE":
+            await self.gw.abort_multipart(upload_id)
+            return "204 No Content", {}, b""
         if method == "PUT":
-            etag, vid = await self.gw.put_object(bucket, key, body, actor=actor)
+            meta = {
+                name[len("x-amz-meta-"):]: value
+                for name, value in headers.items()
+                if name.startswith("x-amz-meta-")
+            }
+            ct = headers.get("content-type", "")
+            if ct:
+                meta["content-type"] = ct
+            etag, vid = await self.gw.put_object(
+                bucket, key, body, meta=meta or None, actor=actor
+            )
             hdrs = {"ETag": f'"{etag}"'}
             if vid:
                 hdrs["x-amz-version-id"] = vid
@@ -424,10 +488,16 @@ class S3Server:
             meta = await self.gw.head_object(
                 bucket, key, actor=actor, version_id=version_id
             )
+            user_meta = meta.get("meta", {})
             hdrs = {
                 "ETag": f'"{meta["etag"]}"',
-                "Content-Type": "application/octet-stream",
+                "Content-Type": user_meta.get(
+                    "content-type", "application/octet-stream"
+                ),
             }
+            for mk, mv in user_meta.items():
+                if mk != "content-type":
+                    hdrs[f"x-amz-meta-{mk}"] = mv
             if meta.get("version_id"):
                 hdrs["x-amz-version-id"] = meta["version_id"]
             return "200 OK", hdrs, data

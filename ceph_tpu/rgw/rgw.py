@@ -579,6 +579,33 @@ class ObjectGateway:
         await self.ioctx.remove(f"rgw.multipart.{upload_id}")
         return etag
 
+    async def list_multipart_uploads(
+        self, bucket: str, actor: str | None = None
+    ) -> list[dict]:
+        """ListMultipartUploads (RGWListBucketMultiparts)."""
+        await self._require_access(bucket, actor, "READ")
+        out = []
+        for oid in await self.ioctx.list_objects():
+            if not oid.startswith("rgw.multipart."):
+                continue
+            meta = await self._load(oid)
+            if meta.get("bucket") == bucket:
+                out.append(
+                    {"upload_id": oid[len("rgw.multipart."):],
+                     "key": meta.get("key", "")}
+                )
+        return sorted(out, key=lambda u: (u["key"], u["upload_id"]))
+
+    async def list_parts(self, upload_id: str) -> list[dict]:
+        """ListParts (RGWListMultipart)."""
+        meta = await self._load(f"rgw.multipart.{upload_id}")
+        if not meta:
+            raise RgwError(ENOENT, "NoSuchUpload", upload_id)
+        return [
+            {"part_number": int(pn), **info}
+            for pn, info in sorted(meta["parts"].items(), key=lambda kv: int(kv[0]))
+        ]
+
     async def abort_multipart(self, upload_id: str) -> None:
         meta = await self._load(f"rgw.multipart.{upload_id}")
         for pn in meta.get("parts", {}):

@@ -391,6 +391,82 @@ class TestRgw:
 
         asyncio.run(run())
 
+    def test_s3_multipart_and_meta_over_http(self):
+        """REST multipart (initiate/part/list/complete/abort) + stored
+        Content-Type and x-amz-meta-* round-tripping (RGWInitMultipart /
+        RGWCompleteMultipart / rgw_rest_s3 meta attrs)."""
+
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rgwmp")
+            gw = ObjectGateway(ioctx)
+            server = S3Server(gw)
+            addr = await server.serve()
+            base = f"http://{addr}"
+
+            def req(method, path, data=None, headers=None):
+                r = urllib.request.Request(
+                    base + path, data=data, method=method, headers=headers or {}
+                )
+                return urllib.request.urlopen(r, timeout=5)
+
+            loop = asyncio.get_event_loop()
+
+            async def go(method, path, data=None, headers=None):
+                return await loop.run_in_executor(
+                    None, lambda: req(method, path, data, headers)
+                )
+
+            await go("PUT", "/mb")
+            # content-type + user meta stored and served back
+            await go(
+                "PUT", "/mb/doc.json", b"{}",
+                headers={"Content-Type": "application/json",
+                         "x-amz-meta-owner": "alice"},
+            )
+            got = await go("GET", "/mb/doc.json")
+            assert got.headers["Content-Type"] == "application/json"
+            assert got.headers["x-amz-meta-owner"] == "alice"
+            # multipart: initiate -> parts -> list -> complete
+            init = (await go("POST", "/mb/big.bin?uploads")).read()
+            import re
+
+            upload_id = re.search(
+                rb"<UploadId>(.*?)</UploadId>", init
+            ).group(1).decode()
+            p1, p2 = b"a" * 600_000, b"b" * 400_000
+            r1 = await go(
+                "PUT", f"/mb/big.bin?uploadId={upload_id}&partNumber=1", p1
+            )
+            assert r1.headers["ETag"]
+            await go(
+                "PUT", f"/mb/big.bin?uploadId={upload_id}&partNumber=2", p2
+            )
+            parts = (await go(
+                "GET", f"/mb/big.bin?uploadId={upload_id}"
+            )).read()
+            assert parts.count(b"<Part>") == 2
+            ups = (await go("GET", "/mb?uploads")).read()
+            assert upload_id.encode() in ups
+            done = (await go(
+                "POST", f"/mb/big.bin?uploadId={upload_id}"
+            )).read()
+            assert b"-2&quot;" in done or b"-2\"" in done or b"-2<" in done
+            got = await go("GET", "/mb/big.bin")
+            assert got.read() == p1 + p2
+            # completed upload disappears from the pending list
+            assert upload_id.encode() not in (await go("GET", "/mb?uploads")).read()
+            # abort drops a fresh upload's parts
+            init2 = (await go("POST", "/mb/tmp?uploads")).read()
+            up2 = re.search(rb"<UploadId>(.*?)</UploadId>", init2).group(1).decode()
+            await go("PUT", f"/mb/tmp?uploadId={up2}&partNumber=1", b"x" * 100)
+            assert (await go("DELETE", f"/mb/tmp?uploadId={up2}")).status == 204
+            assert up2.encode() not in (await go("GET", "/mb?uploads")).read()
+            await server.shutdown()
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
     def test_v2_signature(self):
         sig = sign_v2("secret", "GET", "/b/k", "Tue, 27 Mar 2007 19:36:42 +0000")
         assert sign_v2("secret", "GET", "/b/k", "Tue, 27 Mar 2007 19:36:42 +0000") == sig

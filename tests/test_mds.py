@@ -265,6 +265,12 @@ class TestSymlinks:
             assert await fsc.readlink("/d/link") == "/d/real.txt"
             st = await fsc.stat("/d/link")
             assert st["type"] == "symlink"
+            # readdirplus: stat records inline, one round trip
+            plus = await fsc.listdir_plus("/d")
+            assert set(plus) == {"link", "real.txt"}
+            assert plus["real.txt"]["type"] == "file"
+            assert plus["real.txt"]["size"] == len(b"pointed-at")
+            assert plus["link"]["target"] == "/d/real.txt"
             # explicit client-side follow
             assert await fsc.read_file(await fsc.readlink("/d/link")) == b"pointed-at"
             assert sorted(await fsc.listdir("/d")) == ["link", "real.txt"]
