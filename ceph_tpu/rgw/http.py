@@ -423,6 +423,30 @@ class S3Server:
         self, method: str, bucket: str, key: str, body: bytes, query: dict,
         headers: dict, actor,
     ):
+        if "acl" in query:
+            # object ?acl subresource (RGWGetACLs/RGWPutACLs on objects)
+            if method == "GET":
+                acl = await self.gw.get_object_acl(bucket, key, actor=actor)
+                grants = "".join(
+                    f"<Grant><Grantee>{_x(g)}</Grantee>"
+                    f"<Permission>"
+                    f"{_x(p if isinstance(p, str) else '+'.join(sorted(p)))}"
+                    f"</Permission></Grant>"
+                    for g, p in sorted(acl["grants"].items())
+                )
+                return (
+                    "200 OK",
+                    {"Content-Type": "application/xml"},
+                    f"<AccessControlPolicy><Owner><ID>{_x(acl['owner'])}</ID>"
+                    f"</Owner><AccessControlList>{grants}</AccessControlList>"
+                    f"</AccessControlPolicy>".encode(),
+                )
+            if method == "PUT":
+                await self.gw.set_object_acl(
+                    bucket, key, self._canned_grants(headers), actor=actor
+                )
+                return "200 OK", {}, b""
+            return "405 Method Not Allowed", {}, b""
         version_id = query.get("versionId", [""])[0]
         upload_id = query.get("uploadId", [""])[0]
         if "uploads" in query and method == "POST":

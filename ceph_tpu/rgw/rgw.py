@@ -314,6 +314,8 @@ class ObjectGateway:
         index = await self._load(self._index_oid(bucket))
         entry = index.get(key, {})
         record = {"size": len(data), "etag": etag, "mtime": time.time()}
+        if actor:
+            record["owner"] = actor  # the uploader (object owner in S3)
         if meta:
             record["meta"] = dict(meta)
         if versioning == "Enabled":
@@ -340,6 +342,28 @@ class ObjectGateway:
         await self._store(self._index_oid(bucket), index)
         return etag, vid
 
+    @staticmethod
+    def _object_allowed(
+        record: dict, bucket_info: dict, actor: str | None, need: str
+    ) -> bool:
+        """Object-level ACL check (rgw_op verify_object_permission): the
+        object's own policy decides when present; otherwise the bucket's
+        policy governs.  The object owner (its uploader) always has
+        FULL_CONTROL, like the reference's object owner semantics."""
+        acl = record.get("acl")
+        if acl is None:
+            return ObjectGateway._allowed(bucket_info, actor, need)
+        if actor and actor == acl.get("owner"):
+            return True
+        if ObjectGateway._allowed(
+            {"owner": acl.get("owner", ""), "grants": acl.get("grants", {})},
+            actor,
+            need,
+        ):
+            return True
+        # bucket owner retains control over contained objects
+        return bool(bucket_info.get("owner")) and actor == bucket_info["owner"]
+
     def _resolve(
         self, entry: dict, key: str, version_id: str
     ) -> dict:
@@ -364,7 +388,7 @@ class ObjectGateway:
         actor: str | None = None,
         version_id: str = "",
     ) -> bytes:
-        await self._require_access(bucket, actor, "READ")
+        info = await self._object_access(bucket, key, actor, "READ")
         index = await self._load(self._index_oid(bucket))
         if key not in index:
             raise RgwError(ENOENT, "NoSuchKey", key)
@@ -373,6 +397,25 @@ class ObjectGateway:
             bucket, key, record.get("version_id", "")
         ).read()
 
+    async def _object_access(
+        self, bucket: str, key: str, actor: str | None, need: str
+    ) -> dict:
+        """Bucket info after the object-level check: an object ACL (when
+        set) overrides the bucket policy for this object."""
+        buckets = await self._load(BUCKETS_OID)
+        if bucket not in buckets:
+            raise RgwError(ENOENT, "NoSuchBucket", bucket)
+        info = buckets[bucket]
+        index = await self._load(self._index_oid(bucket))
+        entry = index.get(key)
+        live = self._live(entry) if entry else None
+        record = live if live is not None else {}
+        if not self._object_allowed(record, info, actor, need):
+            raise RgwError(
+                EPERM, "AccessDenied", f"{actor} lacks {need} on {bucket}/{key}"
+            )
+        return info
+
     async def head_object(
         self,
         bucket: str,
@@ -380,7 +423,7 @@ class ObjectGateway:
         actor: str | None = None,
         version_id: str = "",
     ) -> dict:
-        await self._require_access(bucket, actor, "READ")
+        await self._object_access(bucket, key, actor, "READ")
         index = await self._load(self._index_oid(bucket))
         if key not in index:
             raise RgwError(ENOENT, "NoSuchKey", key)
@@ -444,6 +487,41 @@ class ObjectGateway:
         await self._store(self._index_oid(bucket), index)
         await self._data(bucket, key).remove()
         return ""
+
+    async def set_object_acl(
+        self, bucket: str, key: str, grants: dict, actor: str | None = None
+    ) -> None:
+        """PutObjectAcl: per-object grants, owner-gated (the object's
+        uploader or the bucket owner)."""
+        info = await self._require_access(bucket, actor, "READ")
+        index = await self._load(self._index_oid(bucket))
+        entry = index.get(key)
+        live = self._live(entry) if entry else None
+        if live is None:
+            raise RgwError(ENOENT, "NoSuchKey", key)
+        current = live.get("acl") or {"owner": live.get("owner", ""), "grants": {}}
+        admin = (
+            actor
+            and (
+                actor == current.get("owner")
+                or actor == info.get("owner")
+                or not info.get("owner")
+            )
+        )
+        if not admin:
+            raise RgwError(EPERM, "AccessDenied", f"{actor} cannot set acl")
+        live["acl"] = {"owner": current.get("owner") or (actor or ""), "grants": dict(grants)}
+        await self._store(self._index_oid(bucket), index)
+
+    async def get_object_acl(
+        self, bucket: str, key: str, actor: str | None = None
+    ) -> dict:
+        await self._object_access(bucket, key, actor, "READ")
+        index = await self._load(self._index_oid(bucket))
+        live = self._live(index.get(key, {}))
+        if live is None:
+            raise RgwError(ENOENT, "NoSuchKey", key)
+        return live.get("acl") or {"owner": "", "grants": {}}
 
     async def list_object_versions(
         self, bucket: str, prefix: str = "", actor: str | None = None

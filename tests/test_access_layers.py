@@ -391,6 +391,40 @@ class TestRgw:
 
         asyncio.run(run())
 
+    def test_object_level_acls(self):
+        """Per-object ACLs (verify_object_permission): an object policy
+        overrides the bucket's — a public-read object in a private
+        bucket serves to others, and the bucket owner retains control."""
+
+        async def run():
+            monmap, mons, osds, client, ioctx = await make_client("rgwoa")
+            gw = ObjectGateway(ioctx)
+            await gw.create_user("alice")
+            await gw.create_user("bob")
+            await gw.create_bucket("priv", owner="alice")
+            await gw.put_object("priv", "open.txt", b"shared", actor="alice")
+            await gw.put_object("priv", "closed.txt", b"secret", actor="alice")
+            with pytest.raises(RgwError):
+                await gw.get_object("priv", "open.txt", actor="bob")
+            await gw.set_object_acl(
+                "priv", "open.txt", {"*": "READ"}, actor="alice"
+            )
+            assert await gw.get_object("priv", "open.txt", actor="bob") == b"shared"
+            # the sibling object stays private
+            with pytest.raises(RgwError):
+                await gw.get_object("priv", "closed.txt", actor="bob")
+            # a grantee cannot administer the ACL
+            with pytest.raises(RgwError):
+                await gw.set_object_acl(
+                    "priv", "open.txt", {"*": ["READ", "WRITE"]}, actor="bob"
+                )
+            acl = await gw.get_object_acl("priv", "open.txt", actor="alice")
+            assert acl["owner"] == "alice" and acl["grants"] == {"*": "READ"}
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
     def test_s3_multipart_and_meta_over_http(self):
         """REST multipart (initiate/part/list/complete/abort) + stored
         Content-Type and x-amz-meta-* round-tripping (RGWInitMultipart /
