@@ -18,3 +18,4 @@ ENOTEMPTY = 39
 EOPNOTSUPP = 95
 ECANCELED = 125
 EDQUOT = 122
+ESHUTDOWN = 108

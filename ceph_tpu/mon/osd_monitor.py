@@ -210,6 +210,9 @@ class OSDMonitor:
             "osd pool create": (self._cmd_pool_create, True),
             "osd pool ls": (self._cmd_pool_ls, False),
             "osd pool get": (self._cmd_pool_get, False),
+            "osd blocklist add": (self._cmd_blocklist_add, True),
+            "osd blocklist rm": (self._cmd_blocklist_rm, True),
+            "osd blocklist ls": (self._cmd_blocklist_ls, False),
             "osd pool rm": (self._cmd_pool_rm, True),
             "osd dump": (self._cmd_dump, False),
             "osd out": (self._cmd_out, True),
@@ -408,6 +411,34 @@ class OSDMonitor:
                 return f"pool {name!r} {'full (quota)' if want else 'no longer full'}"
 
             self._queue(mutate, None)
+
+    def _cmd_blocklist_add(self, cmd, reply) -> None:
+        """`osd blocklist add <entity>` — fence a client instance
+        (OSDMonitor blocklist; OSDs refuse its ops from the next epoch)."""
+        entity = cmd.get("addr") or cmd.get("entity") or ""
+        if not entity:
+            reply(-EINVAL, "usage: osd blocklist add <entity>")
+            return
+
+        def mutate(m: OSDMap) -> str:
+            m.blocklist.add(entity)
+            return f"blocklisting {entity}"
+
+        self._queue(mutate, reply)
+
+    def _cmd_blocklist_rm(self, cmd, reply) -> None:
+        entity = cmd.get("addr") or cmd.get("entity") or ""
+
+        def mutate(m: OSDMap) -> str:
+            if entity not in m.blocklist:
+                raise KeyError(f"{entity} is not blocklisted")
+            m.blocklist.discard(entity)
+            return f"un-blocklisting {entity}"
+
+        self._queue(mutate, reply)
+
+    def _cmd_blocklist_ls(self, cmd, reply) -> None:
+        reply(0, "", json.dumps(sorted(self.osdmap.blocklist)).encode())
 
     def _cmd_pool_get(self, cmd, reply) -> None:
         """`osd pool get <pool> <var>|all` (OSDMonitor prepare_command

@@ -450,6 +450,28 @@ class OSD(Dispatcher):
             self._handle_ping(conn, msg)
             return
         if isinstance(msg, MOSDOp):
+            if msg.reqid.client in self.osdmap.blocklist:
+                # fenced client (OSDMap blocklist): its ops bounce with
+                # -EBLOCKLISTED so in-flight writers cannot land bytes
+                # after a failover fenced them (rbd-mirror / MDS eviction)
+                from ..common.errs import ESHUTDOWN
+
+                rep = MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=-ESHUTDOWN,
+                    outdata=[],
+                    version=0,
+                    epoch=self.osdmap.epoch,
+                )
+
+                async def _send(c=conn, r=rep):
+                    try:
+                        await c.send_message(r)
+                    except ConnectionError:
+                        pass
+
+                asyncio.get_event_loop().create_task(_send())
+                return
             self._enqueue_op(conn, msg)
             return
         if isinstance(msg, MBackfillReserve):

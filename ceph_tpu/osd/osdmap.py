@@ -112,6 +112,10 @@ class OSDMap(Encodable):
         self.pool_name_to_id: dict[str, int] = {}
         self.erasure_code_profiles: dict[str, dict[str, str]] = {}
         self.crush = CrushWrapper()
+        # fenced client instance ids (osdmap blocklist; OSDMap.h
+        # blocklist): OSDs refuse their ops — the fencing rbd-mirror /
+        # cephfs eviction build on
+        self.blocklist: set[str] = set()
         self._reweights_cache: dict[int, int] | None = None
 
     # -- queries -------------------------------------------------------------
@@ -231,7 +235,7 @@ class OSDMap(Encodable):
         # v3 the quota map), so older decoders skip the trailers via the
         # frame length (the reference's rolling-upgrade convention,
         # src/include/encoding.h ENCODE_START).
-        enc.start(3, 1)
+        enc.start(4, 1)
         enc.u32(self.epoch)
         enc.string(self.fsid)
         enc.map_(
@@ -302,12 +306,14 @@ class OSDMap(Encodable):
                 e.u64(p.quota_max_objects),
             ),
         )
+        # --- v4 trailer: client blocklist ---------------------------------
+        enc.list_(sorted(self.blocklist), lambda e, c: e.string(c))
         enc.finish()
 
     @classmethod
     def decode(cls, dec: Decoder) -> "OSDMap":
         m = cls()
-        struct_v = dec.start(2)
+        struct_v = dec.start(4)
         m.epoch = dec.u32()
         m.fsid = dec.string()
         m.osds = dec.map_(
@@ -369,6 +375,8 @@ class OSDMap(Encodable):
                 p = m.pools.get(pid)
                 if p is not None:
                     p.quota_max_bytes, p.quota_max_objects = qb, qo
+        if struct_v >= 4:
+            m.blocklist = set(dec.list_(lambda d: d.string()))
         dec.finish()
         return m
 

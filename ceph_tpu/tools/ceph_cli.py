@@ -36,6 +36,9 @@ _PREFIXES = [
     "osd tier remove",
     "osd tier cache-mode",
     "osd tier set-overlay",
+    "osd blocklist add",
+    "osd blocklist rm",
+    "osd blocklist ls",
     "osd reweight",
     "osd dump",
     "osd out",
@@ -85,6 +88,9 @@ def build_cmd(words: list[str]) -> dict:
                 cmd["pool"], cmd["overlaypool"] = rest[0], rest[1]
             elif prefix == "osd tier remove-overlay":
                 cmd["pool"] = rest[0]
+            elif prefix in ("osd blocklist add", "osd blocklist rm"):
+                if rest:
+                    cmd["entity"] = rest[0]
             elif prefix == "osd reweight":
                 cmd["id"], cmd["weight"] = rest[0], rest[1]
             elif prefix in ("osd out", "osd in"):

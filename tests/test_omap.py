@@ -207,3 +207,61 @@ def test_zero_and_writesame_ops():
         await stop_cluster(mons, osds)
 
     asyncio.run(run())
+
+
+def test_client_blocklist_fencing():
+    """osd blocklist (OSDMap blocklist): a fenced client instance's ops
+    bounce with -EBLOCKLISTED while other clients are untouched; rm
+    restores access — the fencing primitive failover flows build on."""
+
+    async def run():
+        monmap, mons, osds = await start_cluster(1, 3)
+        victim = Rados(monmap, name="client.victim")
+        other = Rados(monmap, name="client.other")
+        for c in (victim, other):
+            await c.connect()
+        await other.pool_create("bl", "replicated", size=2, pg_num=2)
+        vio = await victim.open_ioctx("bl")
+        oio = await other.open_ioctx("bl")
+        await vio.write_full("o", b"pre-fence")
+        entity = victim.objecter.reqid_name
+        rv, rs, _ = await other.mon_command(
+            {"prefix": "osd blocklist add", "entity": entity}
+        )
+        assert rv == 0, rs
+        await wait_until(
+            lambda: all(
+                entity in o.osdmap.blocklist for o in osds
+            ),
+            10.0,
+            "blocklist reaching the OSDs",
+        )
+        with pytest.raises((RadosError, TimeoutError)):
+            await vio.write_full("o", b"post-fence", )
+        # reads from the fenced instance bounce too
+        with pytest.raises((RadosError, TimeoutError)):
+            await vio.read("o")
+        # other clients unaffected; fenced bytes never landed
+        assert await oio.read("o") == b"pre-fence"
+        rv, _, out = await other.mon_command({"prefix": "osd blocklist ls"})
+        import json
+
+        assert entity in json.loads(out)
+        rv, _, _ = await other.mon_command(
+            {"prefix": "osd blocklist rm", "entity": entity}
+        )
+        assert rv == 0
+        await wait_until(
+            lambda: all(
+                entity not in o.osdmap.blocklist for o in osds
+            ),
+            10.0,
+            "un-blocklist reaching the OSDs",
+        )
+        await vio.write_full("o", b"restored")
+        assert await oio.read("o") == b"restored"
+        for c in (victim, other):
+            await c.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())
