@@ -370,8 +370,21 @@ class MirrorDaemon:
         self._running = False
 
 
-async def promote(rbd: RBD, name: str) -> None:
-    """`rbd mirror image promote` on the replica after failover."""
+async def promote(rbd: RBD, name: str, fence: bool = False) -> None:
+    """`rbd mirror image promote` on the replica after failover.
+
+    With `fence`, every exclusive-lock holder of the image is first
+    BLOCKLISTED (osdmap blocklist) and its lock broken — the reference's
+    promotion fencing, which guarantees a zombie old primary cannot land
+    late writes after the replica takes over."""
     img = await rbd.open(name)
+    if fence:
+        for holder in await img.lock_owners():
+            rv, rs, _ = await rbd.ioctx.rados.mon_command(
+                {"prefix": "osd blocklist add", "entity": holder["entity"]}
+            )
+            if rv:
+                raise RbdError(5, f"fencing {holder['entity']} failed: {rs}")
+            await img.break_lock(holder["entity"], holder["cookie"])
     img.header["primary"] = True
     await img._save_header()

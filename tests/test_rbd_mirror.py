@@ -6,7 +6,7 @@ import asyncio
 
 import pytest
 
-from ceph_tpu.client import Rados
+from ceph_tpu.client import Rados, RadosError
 from ceph_tpu.rbd import (
     RBD,
     JournaledImage,
@@ -321,7 +321,7 @@ class TestExclusiveLock:
         `rbd lock rm`)."""
 
         async def run():
-            from ceph_tpu.client import Rados
+            from ceph_tpu.client import Rados, RadosError
             from ceph_tpu.rbd.rbd import RBD, RbdError
 
             monmap, mons, osds = await start_cluster(1, 3)
@@ -356,6 +356,51 @@ class TestExclusiveLock:
             )
             await timg.lock_release(cookie="c-taker")
             await taker.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestFencedPromotion:
+    def test_promote_fence_blocklists_zombie_lock_holder(self):
+        """Promotion with fencing (the reference's rbd-mirror promote
+        flow): every exclusive-lock holder of the promoted image is
+        BLOCKLISTED before its lock breaks, so a zombie writer cannot
+        land bytes after the takeover — even writes already in flight
+        bounce at the OSD."""
+
+        async def run():
+            from ceph_tpu.rbd.mirror import promote
+            from test_cluster import wait_until
+
+            monmap, mons, osds, rados, a, b = await _two_sites()
+            rbd_b = RBD(b)
+            await rbd_b.create("vol", 1 << 18, order=16)
+            # a zombie client grabs the image's exclusive lock and stalls
+            zombie = Rados(monmap, name="client.zombie")
+            await zombie.connect()
+            zb = await zombie.open_ioctx("site_b")
+            zimg = await RBD(zb).open("vol")
+            await zimg.lock_acquire(cookie="z1")
+            entity = zombie.objecter.reqid_name
+
+            await promote(rbd_b, "vol", fence=True)
+            # the lock is broken and the zombie fenced cluster-wide
+            img = await rbd_b.open("vol")
+            assert await img.lock_owners() == []
+            assert img.header.get("primary") is True
+            await wait_until(
+                lambda: all(entity in o.osdmap.blocklist for o in osds),
+                10.0,
+                "fence reaching the OSDs",
+            )
+            with pytest.raises((RadosError, TimeoutError)):
+                await zimg.write(0, b"zombie bytes")
+            # the promoted side writes freely
+            await img.write(0, b"new primary")
+            assert await img.read(0, 11) == b"new primary"
+            await zombie.shutdown()
+            await rados.shutdown()
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
