@@ -373,18 +373,40 @@ class MirrorDaemon:
 async def promote(rbd: RBD, name: str, fence: bool = False) -> None:
     """`rbd mirror image promote` on the replica after failover.
 
-    With `fence`, every exclusive-lock holder of the image is first
-    BLOCKLISTED (osdmap blocklist) and its lock broken — the reference's
-    promotion fencing, which guarantees a zombie old primary cannot land
-    late writes after the replica takes over."""
+    With `fence`, every OTHER exclusive-lock holder of the image is
+    first BLOCKLISTED (osdmap blocklist) and its lock broken — the
+    reference's promotion fencing.  Enforcement begins as each OSD
+    applies the blocklist epoch (map propagation, the same eventual
+    semantics the reference has); the lock break cuts off lock-gated
+    I/O immediately, and the committed blocklist guarantees the zombie's
+    client instance can never re-acquire or write once the epoch lands.
+    The promoting client's own instance is never fenced."""
     img = await rbd.open(name)
     if fence:
+        rados = rbd.ioctx.rados
+        me = rados.objecter.reqid_name
+        fenced = []
         for holder in await img.lock_owners():
-            rv, rs, _ = await rbd.ioctx.rados.mon_command(
+            if holder["entity"] == me:
+                continue  # never fence the promoting instance itself
+            rv, rs, _ = await rados.mon_command(
                 {"prefix": "osd blocklist add", "entity": holder["entity"]}
             )
             if rv:
-                raise RbdError(5, f"fencing {holder['entity']} failed: {rs}")
+                raise RbdError(-rv, f"fencing {holder['entity']} failed: {rs}")
+            fenced.append(holder)
+        # wait for the blocklist epoch to reach our own map before
+        # breaking locks: break-then-propagate would reopen the window
+        # the fence exists to close
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while fenced and not all(
+            h["entity"] in rados.objecter.osdmap.blocklist for h in fenced
+        ):
+            if asyncio.get_event_loop().time() > deadline:
+                raise RbdError(110, "blocklist epoch did not propagate")
+            await asyncio.sleep(0.05)
+            await rados.objecter.monc.resubscribe()
+        for holder in fenced:
             await img.break_lock(holder["entity"], holder["cookie"])
     img.header["primary"] = True
     await img._save_header()

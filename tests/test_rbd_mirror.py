@@ -321,7 +321,7 @@ class TestExclusiveLock:
         `rbd lock rm`)."""
 
         async def run():
-            from ceph_tpu.client import Rados, RadosError
+            from ceph_tpu.client import Rados
             from ceph_tpu.rbd.rbd import RBD, RbdError
 
             monmap, mons, osds = await start_cluster(1, 3)
@@ -370,9 +370,6 @@ class TestFencedPromotion:
         bounce at the OSD."""
 
         async def run():
-            from ceph_tpu.rbd.mirror import promote
-            from test_cluster import wait_until
-
             monmap, mons, osds, rados, a, b = await _two_sites()
             rbd_b = RBD(b)
             await rbd_b.create("vol", 1 << 18, order=16)
