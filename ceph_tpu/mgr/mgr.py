@@ -143,8 +143,14 @@ class Mgr(Dispatcher):
                         name, {"stored": 0, "objects": 0, "used_raw": 0}
                     )
                     rec[field] += v
+        osds = {
+            daemon: sum((st.status or {}).get("pool_bytes", {}).values())
+            for daemon, st in self.daemons.items()
+            if daemon.startswith("osd.")
+        }
         return {
             "pools": pools,
+            "osds": osds,  # per-daemon raw bytes (`ceph osd df`)
             "total_used_raw": sum(p["used_raw"] for p in pools.values()),
         }
 

@@ -413,6 +413,28 @@ class Monitor(Dispatcher):
                 # OBJECTS / raw USED); empty until a mgr reports
                 reply(0, "", json.dumps(self.pg_digest).encode())
             return handler
+        if prefix == "osd df":
+            def handler(cmd, reply):
+                # `ceph osd df`: per-OSD raw usage from the same digest
+                reply(
+                    0, "",
+                    json.dumps(self.pg_digest.get("osds", {})).encode(),
+                )
+            return handler
+        if prefix == "health":
+            def handler(cmd, reply):
+                # `ceph health [detail]`: the status handler's checks,
+                # served standalone (ClusterHealth essence)
+                self._mon_command_handler("status")(
+                    cmd,
+                    lambda rv, rs, out=b"": reply(
+                        rv, rs,
+                        json.dumps(
+                            json.loads(out or b"{}").get("health", {})
+                        ).encode(),
+                    ),
+                )
+            return handler
         if prefix == "quorum_status":
             def handler(cmd, reply):
                 reply(0, "", json.dumps(self.quorum_status()).encode())

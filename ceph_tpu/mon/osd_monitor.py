@@ -210,6 +210,8 @@ class OSDMonitor:
             "osd pool create": (self._cmd_pool_create, True),
             "osd pool ls": (self._cmd_pool_ls, False),
             "osd pool get": (self._cmd_pool_get, False),
+            "osd pool application enable": (self._cmd_app_enable, True),
+            "osd pool application get": (self._cmd_app_get, False),
             "osd blocklist add": (self._cmd_blocklist_add, True),
             "osd blocklist rm": (self._cmd_blocklist_rm, True),
             "osd blocklist ls": (self._cmd_blocklist_ls, False),
@@ -411,6 +413,34 @@ class OSDMonitor:
                 return f"pool {name!r} {'full (quota)' if want else 'no longer full'}"
 
             self._queue(mutate, None)
+
+    def _cmd_app_enable(self, cmd, reply) -> None:
+        """`osd pool application enable <pool> <app>` (OSDMonitor
+        application metadata; rbd/cephfs/rgw tag their pools)."""
+        pool, app = cmd.get("pool"), cmd.get("app", "")
+        if not app:
+            reply(-EINVAL, "usage: osd pool application enable <pool> <app>")
+            return
+
+        def mutate(m: OSDMap) -> str:
+            p = m.get_pool(pool)
+            if p is None:
+                raise KeyError(f"pool {pool!r} does not exist")
+            if p.application and p.application != app:
+                raise ValueError(
+                    f"pool {pool!r} already tagged {p.application!r}"
+                )
+            p.application = app
+            return f"enabled application {app!r} on pool {pool!r}"
+
+        self._queue(mutate, reply)
+
+    def _cmd_app_get(self, cmd, reply) -> None:
+        p = self.osdmap.get_pool(cmd.get("pool"))
+        if p is None:
+            reply(-EINVAL, f"pool {cmd.get('pool')!r} does not exist")
+            return
+        reply(0, "", json.dumps({"application": p.application}).encode())
 
     def _cmd_blocklist_add(self, cmd, reply) -> None:
         """`osd blocklist add <entity>` — fence a client instance

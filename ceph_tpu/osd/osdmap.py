@@ -90,6 +90,9 @@ class PgPool:
     # flips FLAG_FULL_QUOTA from the mgr's PGMap digest when exceeded
     quota_max_bytes: int = 0
     quota_max_objects: int = 0
+    # application tag (pg_pool_t application_metadata; `osd pool
+    # application enable` — rbd/cephfs/rgw claim their pools)
+    application: str = ""
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE
@@ -235,7 +238,7 @@ class OSDMap(Encodable):
         # v3 the quota map), so older decoders skip the trailers via the
         # frame length (the reference's rolling-upgrade convention,
         # src/include/encoding.h ENCODE_START).
-        enc.start(4, 1)
+        enc.start(5, 1)
         enc.u32(self.epoch)
         enc.string(self.fsid)
         enc.map_(
@@ -308,12 +311,15 @@ class OSDMap(Encodable):
         )
         # --- v4 trailer: client blocklist ---------------------------------
         enc.list_(sorted(self.blocklist), lambda e, c: e.string(c))
+        # --- v5 trailer: pool application tags ----------------------------
+        apps = {pid: p.application for pid, p in self.pools.items() if p.application}
+        enc.map_(apps, lambda e, k: e.u32(k), lambda e, a: e.string(a))
         enc.finish()
 
     @classmethod
     def decode(cls, dec: Decoder) -> "OSDMap":
         m = cls()
-        struct_v = dec.start(4)
+        struct_v = dec.start(5)
         m.epoch = dec.u32()
         m.fsid = dec.string()
         m.osds = dec.map_(
@@ -377,6 +383,11 @@ class OSDMap(Encodable):
                     p.quota_max_bytes, p.quota_max_objects = qb, qo
         if struct_v >= 4:
             m.blocklist = set(dec.list_(lambda d: d.string()))
+        if struct_v >= 5:
+            apps = dec.map_(lambda d: d.u32(), lambda d: d.string())
+            for pid, app in apps.items():
+                if pid in m.pools:
+                    m.pools[pid].application = app
         dec.finish()
         return m
 

@@ -30,6 +30,10 @@ _PREFIXES = [
     "osd pool set",
     "osd pool ls",
     "osd pool get",
+    "osd pool application enable",
+    "osd pool application get",
+    "osd df",
+    "health",
     "osd pool rm",
     "osd tier add",
     "osd tier remove-overlay",
@@ -78,6 +82,10 @@ def build_cmd(words: list[str]) -> dict:
                     cmd["pool"] = rest[0]
             elif prefix == "osd pool get":
                 for i, k in enumerate(["pool", "var"]):
+                    if i < len(rest):
+                        cmd[k] = rest[i]
+            elif prefix.startswith("osd pool application"):
+                for i, k in enumerate(["pool", "app"]):
                     if i < len(rest):
                         cmd[k] = rest[i]
             elif prefix in ("osd tier add", "osd tier remove"):

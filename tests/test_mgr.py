@@ -97,6 +97,12 @@ class TestMgrDaemon:
             parsed = json.loads(out)
             assert parsed["pools"]["dfp"]["stored"] == 40_000
             assert parsed["total_used_raw"] >= 120_000
+            # `ceph osd df`: per-OSD raw usage sums to the pool total
+            rv, _, out = await client.mon_command({"prefix": "osd df"})
+            assert rv == 0
+            per_osd = json.loads(out)
+            assert set(per_osd) == {"osd.0", "osd.1", "osd.2"}
+            assert sum(per_osd.values()) >= 120_000
             await client.shutdown()
             await mgr.stop()
             await stop_cluster(mons, osds)

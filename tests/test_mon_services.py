@@ -305,3 +305,49 @@ def test_osd_pool_get():
         await stop_cluster(mons, osds)
 
     asyncio.run(run())
+
+
+def test_pool_application_and_health():
+    """`osd pool application enable/get` tagging and the standalone
+    `health` command (application_metadata + ClusterHealth essence)."""
+
+    async def run():
+        import json
+
+        from ceph_tpu.client import Rados
+        from test_cluster import start_cluster, stop_cluster
+
+        monmap, mons, osds = await start_cluster(1, 3)
+        client = Rados(monmap)
+        await client.connect()
+        await client.pool_create("appp", "replicated", size=2)
+        rv, rs, _ = await client.mon_command(
+            {"prefix": "osd pool application enable", "pool": "appp",
+             "app": "rbd"}
+        )
+        assert rv == 0, rs
+        rv, _, out = await client.mon_command(
+            {"prefix": "osd pool application get", "pool": "appp"}
+        )
+        assert json.loads(out) == {"application": "rbd"}
+        # retagging to a different app is refused
+        rv, _, _ = await client.mon_command(
+            {"prefix": "osd pool application enable", "pool": "appp",
+             "app": "rgw"}
+        )
+        assert rv != 0
+        # the tag propagates to clients through the map
+        def tagged():
+            p = client.objecter.osdmap.get_pool("appp")
+            return p is not None and p.application == "rbd"
+        from test_cluster import wait_until
+        await wait_until(tagged, 5.0, "application tag in client map")
+        # health: standalone check payload
+        rv, _, out = await client.mon_command({"prefix": "health"})
+        assert rv == 0
+        h = json.loads(out)
+        assert h["status"] in ("HEALTH_OK", "HEALTH_WARN")
+        await client.shutdown()
+        await stop_cluster(mons, osds)
+
+    asyncio.run(run())
