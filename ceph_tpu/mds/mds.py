@@ -72,7 +72,10 @@ class MDS(Dispatcher):
     def __init__(self, meta_ioctx=None, data_ioctx=None,
                  addr: str = "127.0.0.1:0",
                  layout: dict | None = None, stack: str = "posix",
-                 name: str = "0", monmap=None, rados=None):
+                 name: str = "0", monmap=None, rados=None,
+                 admin_socket: str = ""):
+        self._admin_socket_path = admin_socket
+        self.admin_socket = None
         self.meta = meta_ioctx
         self.data = data_ioctx
         self.name = name
@@ -121,6 +124,7 @@ class MDS(Dispatcher):
     async def start(self) -> None:
         await self.msgr.bind(self._bind_addr)
         self.addr = self.msgr.addr
+        await self._start_admin_socket()
         if self.monmap is None:
             # embedded/library use: no mon control plane, activate now
             await self._activate()
@@ -134,6 +138,55 @@ class MDS(Dispatcher):
         self.monc.msgr.add_dispatcher_tail(self)  # MMDSMap arrives here
         await self.monc.subscribe("mdsmap")
         self._beacon_task = asyncio.create_task(self._beacon_loop())
+
+    async def _start_admin_socket(self) -> None:
+        """MDS admin socket (MDSDaemon::asok_command): status, session
+        and cap introspection — what `ceph tell mds.<x> ...` reaches."""
+        if not self._admin_socket_path:
+            return
+        from ..common.admin_socket import AdminSocket
+
+        sock = AdminSocket(self._admin_socket_path)
+        sock.register(
+            "status",
+            lambda cmd: {
+                "name": self.name,
+                "state": f"up:{self.state}" if self.state != "boot" else "boot",
+                "fs": self.fs_name,
+                "mdsmap_epoch": self.mdsmap_epoch,
+                "journal_seq": self._journal_seq,
+                "dirty_dirfrags": len(self._dirty),
+            },
+            "this MDS's state (MDSDaemon::dump_status)",
+        )
+        sock.register(
+            "session ls",
+            lambda cmd: [
+                {
+                    "client": getattr(conn, "peer_name", ""),
+                    "caps": sum(
+                        1 for holders in self.caps.values() if conn in holders
+                    ),
+                }
+                for conn in {
+                    c for holders in self.caps.values() for c in holders
+                }
+            ],
+            "connected cap-holding sessions (Server::dump_sessions)",
+        )
+        sock.register(
+            "dump caps",
+            lambda cmd: {
+                str(ino): {
+                    getattr(c, "peer_name", "?"): mode
+                    for c, mode in holders.items()
+                }
+                for ino, holders in self.caps.items()
+            },
+            "granted capabilities per inode (Locker state)",
+        )
+        await sock.start()
+        self.admin_socket = sock
 
     async def _activate(self, fs: dict | None = None) -> None:
         """standby → replay → active (MDSDaemon::boot_start / replay_done):
@@ -223,6 +276,9 @@ class MDS(Dispatcher):
         self._flush_task = self._beacon_task = self._activate_task = None
         if was_active and flush:
             await self._flush()
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         if self.monc is not None:
             await self.monc.msgr.shutdown()
             self.monc = None

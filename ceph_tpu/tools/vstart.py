@@ -49,6 +49,7 @@ class DevCluster:
         n_osds: int = 3,
         with_mgr: bool = True,
         with_mds: bool = False,
+        with_rgw: bool = False,
         n_mds: int = 2,  # daemons to boot when with_mds (rank 0 + standby)
         conf_overrides: dict | None = None,
         asok_dir: str = "",  # enable daemon admin sockets under this dir
@@ -58,6 +59,7 @@ class DevCluster:
         self.n_osds = n_osds
         self.with_mgr = with_mgr
         self.with_mds = with_mds
+        self.with_rgw = with_rgw
         self.n_mds = n_mds
         self.conf_overrides = conf_overrides or {}
         self.monmap: MonMap | None = None
@@ -68,6 +70,9 @@ class DevCluster:
         self.mds_daemons: list = []
         self._mds_rados = None
         self._mds_radoses: list = []
+        self.rgw_s3 = None
+        self.rgw_swift = None
+        self._rgw_rados = None
 
     async def start(self) -> MonMap:
         # ms_type applies cluster-wide (every daemon + client must share a
@@ -170,6 +175,7 @@ class DevCluster:
                 self._mds_radoses.append(r)
                 d = MDS(
                     stack=self._stack, name=name, monmap=self.monmap, rados=r,
+                    admin_socket=self._asok(f"mds.{name}"),
                 )
                 await d.start()
                 self.mds_daemons.append(d)
@@ -182,6 +188,26 @@ class DevCluster:
             self.mds = next(
                 d for d in self.mds_daemons if d.state == "active"
             )
+        if self.with_rgw:
+            # RGW=1: the S3 + Swift personalities over one gateway pool
+            # (vstart.sh's radosgw boot)
+            from ..client import Rados
+            from ..rgw import ObjectGateway, S3Server, SwiftServer
+
+            self._rgw_rados = Rados(
+                self.monmap, name="client.rgw", stack=self._stack
+            )
+            await self._rgw_rados.connect()
+            await self._rgw_rados.pool_create(
+                "default.rgw.data", "replicated", size=min(2, self.n_osds),
+                pg_num=8,
+            )
+            io = await self._rgw_rados.open_ioctx("default.rgw.data")
+            gw = ObjectGateway(io)
+            self.rgw_s3 = S3Server(gw, lc_interval=1.0)
+            await self.rgw_s3.serve()
+            self.rgw_swift = SwiftServer(gw)
+            await self.rgw_swift.serve()
         return self.monmap
 
     def _asok(self, daemon: str) -> str:
@@ -189,6 +215,15 @@ class DevCluster:
         return f"{self.asok_dir}/{daemon}.asok" if self.asok_dir else ""
 
     async def stop(self) -> None:
+        if self.rgw_s3 is not None:
+            await self.rgw_s3.shutdown()
+            self.rgw_s3 = None
+        if self.rgw_swift is not None:
+            await self.rgw_swift.shutdown()
+            self.rgw_swift = None
+        if self._rgw_rados is not None:
+            await self._rgw_rados.shutdown()
+            self._rgw_rados = None
         for d in self.mds_daemons:
             await d.stop()
         self.mds_daemons.clear()
@@ -229,6 +264,16 @@ class DevCluster:
             info["admin_sockets"] = socks
         if self.mds is not None:
             info["mds_addr"] = self.mds.addr
+        socks = info.get("admin_sockets", {})
+        for d in self.mds_daemons:
+            if d._admin_socket_path:
+                socks[f"mds.{d.name}"] = d._admin_socket_path
+        if socks:
+            info["admin_sockets"] = socks
+        if self.rgw_s3 is not None:
+            info["rgw_s3_endpoint"] = self.rgw_s3.addr
+        if self.rgw_swift is not None:
+            info["rgw_swift_endpoint"] = self.rgw_swift.addr
         with open(path, "w") as f:
             json.dump(info, f)
 

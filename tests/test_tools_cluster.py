@@ -366,3 +366,54 @@ class TestCephTell:
             await cluster.stop()
 
         asyncio.run(run())
+
+
+class TestVstartRgw:
+    def test_rgw_topology_and_mds_admin_socket(self, tmp_path):
+        """vstart RGW=1: S3+Swift endpoints served and recorded in the
+        cluster file; the MDS daemons expose admin sockets reachable via
+        `ceph tell mds.<x> status` semantics."""
+
+        async def run():
+            import urllib.request
+
+            from ceph_tpu.common.admin_socket import admin_command
+
+            cluster = DevCluster(
+                n_mons=1, n_osds=3, with_mgr=False, with_mds=True,
+                with_rgw=True, asok_dir=str(tmp_path / "asok"),
+            )
+            await cluster.start()
+            cfile = str(tmp_path / "cluster.json")
+            cluster.write_cluster_file(cfile)
+            info = json.load(open(cfile))
+            assert info["rgw_s3_endpoint"] and info["rgw_swift_endpoint"]
+            assert any(k.startswith("mds.") for k in info["admin_sockets"])
+            # the recorded S3 endpoint serves (service-level list)
+            loop = asyncio.get_event_loop()
+            body = await loop.run_in_executor(
+                None,
+                lambda: urllib.request.urlopen(
+                    f"http://{info['rgw_s3_endpoint']}/", timeout=5
+                ).read(),
+            )
+            assert b"ListAllMyBucketsResult" in body
+            # MDS admin socket: status names the active's filesystem
+            active = cluster.mds
+            st = await loop.run_in_executor(
+                None,
+                lambda: admin_command(
+                    info["admin_sockets"][f"mds.{active.name}"], "status"
+                ),
+            )
+            assert st["state"] == "up:active" and st["fs"] == "cephfs"
+            sessions = await loop.run_in_executor(
+                None,
+                lambda: admin_command(
+                    info["admin_sockets"][f"mds.{active.name}"], "session ls"
+                ),
+            )
+            assert isinstance(sessions, list)
+            await cluster.stop()
+
+        asyncio.run(run())
