@@ -42,6 +42,20 @@ class PrometheusModule(HttpServedModule, MgrModule):
         out.append("# HELP ceph_tpu_osdmap_epoch current osdmap epoch")
         out.append("# TYPE ceph_tpu_osdmap_epoch counter")
         out.append(f"ceph_tpu_osdmap_epoch {osdmap.epoch}")
+        # pool stats from the PGMap digest (ceph_pool_stored/objects/
+        # bytes_used analogs of the reference exporter)
+        digest = mgr.pg_digest()
+        for metric, field_, help_ in (
+            ("pool_stored_bytes", "stored", "logical bytes stored (STORED)"),
+            ("pool_objects", "objects", "head objects"),
+            ("pool_used_raw_bytes", "used_raw", "raw bytes incl. replicas"),
+        ):
+            out.append(f"# HELP ceph_tpu_{metric} {help_}")
+            out.append(f"# TYPE ceph_tpu_{metric} gauge")
+            for pool, st in sorted(digest["pools"].items()):
+                out.append(
+                    f'ceph_tpu_{metric}{{pool="{pool}"}} {st[field_]}'
+                )
         # per-daemon perf counters
         seen_types: set[str] = set()
         for daemon in mgr.list_daemons():

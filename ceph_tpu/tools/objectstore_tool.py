@@ -21,12 +21,15 @@ import base64
 import json
 import sys
 
+from ..os.bluestore import BlueStore
 from ..os.filestore import FileStore
 from ..os.transaction import Transaction
 
 
-def _store(path: str) -> FileStore:
-    store = FileStore(path)
+def _store(path: str, kind: str = "filestore"):
+    """Mount the store at `path` (--type, like the reference tool's
+    objectstore selection)."""
+    store = BlueStore(path) if kind == "bluestore" else FileStore(path)
     store.mount()
     return store
 
@@ -126,13 +129,16 @@ def op_import(store: FileStore, path: str) -> None:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data-path", required=True)
+    p.add_argument("--type", default="filestore",
+                   choices=["filestore", "bluestore"],
+                   help="objectstore backend at --data-path")
     p.add_argument("--op", required=True,
                    help="list|dump|get-bytes|set-bytes|remove|export|import")
     p.add_argument("--coll")
     p.add_argument("--oid")
     p.add_argument("--file")
     args = p.parse_args(argv)
-    store = _store(args.data_path)
+    store = _store(args.data_path, args.type)
     try:
         if args.op == "list":
             op_list(store, args.coll)

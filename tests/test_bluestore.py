@@ -437,3 +437,24 @@ class TestBluestoreTool:
                          "--deep"]) == 1
         out = capsys.readouterr().out
         assert "1 error(s)" in out and "1.0s0/o2" in out
+
+    def test_objectstore_tool_reads_bluestore(self, tmp_path, capsys):
+        """ceph-objectstore-tool --type bluestore: list/dump work against
+        a BlueStore data path (the reference tool's backend selection)."""
+        from ceph_tpu.tools.objectstore_tool import main as ost_main
+
+        self._populate(tmp_path / "b")
+        assert ost_main([
+            "--data-path", str(tmp_path / "b"), "--type", "bluestore",
+            "--op", "list",
+        ]) == 0
+        out = capsys.readouterr().out
+        assert '["1.0s0", "o0"]' in out
+        assert ost_main([
+            "--data-path", str(tmp_path / "b"), "--type", "bluestore",
+            "--op", "dump", "--coll", "1.0s0", "--oid", "o1",
+        ]) == 0
+        import json as _json
+
+        dump = _json.loads(capsys.readouterr().out)
+        assert dump["size"] == BLOCK * 2

@@ -153,6 +153,7 @@ class TestPrometheus:
             assert 'ceph_tpu_osd_up{osd="1"} 1' in text
             assert "ceph_tpu_osdmap_epoch" in text
             assert 'ceph_tpu_op{daemon="osd.0"}' in text
+            assert "ceph_tpu_pool_stored_bytes" in text
             await prom.shutdown()
             await mgr.stop()
             await stop_cluster(mons, osds)
