@@ -327,3 +327,51 @@ class TestStatusHealth:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestMonRestart:
+    def test_restarted_mon_catches_up_via_paxos(self):
+        """A monitor restarting with an EMPTY store rejoins quorum and
+        catches up every committed version from its peers (Paxos
+        collect/LAST catch-up — the recovery path the reference drives
+        from MonitorDBStore + sync)."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.mon import Monitor
+
+            from test_cluster import start_cluster, stop_cluster, wait_until
+
+            monmap, mons, osds = await start_cluster(3, 2)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("before", "replicated", size=2)
+            # stop a PEON (killing the leader also works but re-elects)
+            victim = next(m for m in mons if not m.is_leader())
+            vname = victim.name
+            await victim.stop()
+            # state advances while it is gone
+            await client.pool_create("while-down", "replicated", size=2)
+            # restart with a FRESH Monitor (empty paxos store)
+            revived = Monitor(vname, monmap, election_timeout=0.3)
+            await revived.start()
+            mons[mons.index(victim)] = revived
+            await revived.wait_for_quorum()
+            await wait_until(
+                lambda: revived.osdmon.osdmap.get_pool("while-down")
+                is not None,
+                10.0,
+                "revived mon catching up committed state",
+            )
+            assert revived.osdmon.osdmap.get_pool("before") is not None
+            # and it participates in NEW commits
+            await client.pool_create("after", "replicated", size=2)
+            await wait_until(
+                lambda: revived.osdmon.osdmap.get_pool("after") is not None,
+                10.0,
+                "revived mon applying new commits",
+            )
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
