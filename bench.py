@@ -184,11 +184,38 @@ def run_child(platform: str) -> None:
     clog(f"measuring: batch={batch} iters={iters}")
     gbps = run_chain(batch, iters)
     clog(f"done: {gbps:.3f} GB/s at batch={batch}")
-    print(
-        json.dumps(
-            {"platform": got, "gbps": gbps, "batch": batch, "parity_ok": True}
-        )
-    )
+    result = {"platform": got, "gbps": gbps, "batch": batch, "parity_ok": True}
+    if os.environ.get("BENCH_TRACE"):
+        # One traced encode OUTSIDE the measured loop (BENCH_TRACE=1):
+        # per-stage spans (h2d / kernel_launch / kernel_wait+d2h from
+        # codec/tracing.py) so a regression in the headline number is
+        # attributable to a stage, not just observed end to end.
+        from ceph_tpu.common import tracer as tracer_mod
+        from ceph_tpu.common.tracer import Tracer
+
+        clog("BENCH_TRACE: sampling one traced encode")
+        tr = Tracer("bench", enabled=True)
+        root = tr.start_span("bench:encode")
+        root.keyval("batch", 2)
+        with tracer_mod.span_scope(root):
+            traced = ec.encode_array(
+                rng.integers(0, 256, (2, k, chunk), dtype=np.uint8)
+            )
+            with root.child("kernel_wait+d2h"):
+                np.asarray(traced)
+        root.finish()
+        result["trace"] = [
+            {
+                "name": s["name"],
+                "parent_id": s["parent_id"],
+                "span_id": s["span_id"],
+                "ms": None
+                if s["end"] is None
+                else round((s["end"] - s["start"]) * 1e3, 3),
+            }
+            for s in tr.export()
+        ]
+    print(json.dumps(result))
 
 
 def _child_env(platform: str) -> dict:
@@ -297,6 +324,8 @@ def main() -> None:
     }
     if tpu_error:
         out["tpu_error"] = tpu_error
+    if "trace" in result:
+        out["trace"] = result["trace"]
     print(json.dumps(out))
 
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from ..common import tracer as tracer_mod
 from ..common.errs import EAGAIN, ENOENT, ETIMEDOUT
 from ..common.log import dout
 from ..mon.client import MonClient
@@ -64,6 +65,11 @@ class Objecter(Dispatcher):
         self.msgr = Messenger(
             name, auth=auth, secure=secure, compress=compress, stack=stack
         )
+        # client end of the op trace (Objecter::op_submit's osd_trace root):
+        # disabled by default; bench/diag flips .enabled and every op's
+        # context rides the MOSDOp envelope so the OSD-side spans join it
+        self.tracer = tracer_mod.Tracer(service=name, enabled=False)
+        self.msgr.tracer = self.tracer
         self.monc = MonClient(name, monmap, msgr=self.msgr)
         self.msgr.add_dispatcher_head(self)
         self.osdmap = OSDMap()
@@ -176,6 +182,24 @@ class Objecter(Dispatcher):
         specific PG instead of hashing `oid` (pg ops like PGLS)."""
         self._tid += 1
         reqid = ReqId(client=self.reqid_name, tid=self._tid)
+        # trace root: ONE span per client op; every (re)send injects its
+        # context into the MOSDOp envelope, so the messenger/OSD/EC/codec
+        # spans downstream all share this trace id
+        span = self.tracer.start_span("client:op")
+        span.keyval("oid", oid)
+        span.keyval("reqid", lambda: reqid.key())
+        try:
+            return await self._op_submit(
+                pool_id, oid, ops, timeout, ps, snap_seq, snaps, snap_id,
+                reqid, span,
+            )
+        finally:
+            span.finish()
+
+    async def _op_submit(
+        self, pool_id, oid, ops, timeout, ps, snap_seq, snaps, snap_id,
+        reqid, span,
+    ) -> MOSDOpReply:
         deadline = time.monotonic() + timeout
         while True:
             remaining = deadline - time.monotonic()
@@ -206,9 +230,11 @@ class Objecter(Dispatcher):
                 snaps=list(snaps or []),
                 snap_id=snap_id,
             )
+            tracer_mod.inject(span, msg)
             fut: asyncio.Future = asyncio.get_event_loop().create_future()
             self._replies[reqid.tid] = fut
             try:
+                span.event(lambda: f"sent to osd.{primary}")
                 await self.msgr.send_to(info.addr, msg)
                 reply: MOSDOpReply = await asyncio.wait_for(
                     fut, min(remaining, 2.0)
@@ -216,13 +242,16 @@ class Objecter(Dispatcher):
             except (ConnectionError, asyncio.TimeoutError):
                 # Peer died or reply lost: re-target after a map change (or
                 # a short delay) and resend — Objecter's resend loop.
+                span.event("resend: connection lost or reply timed out")
                 self._replies.pop(reqid.tid, None)
                 await self._wait_map_change(min(remaining, 0.3))
                 continue
             if reply.result == -EAGAIN:
                 # Not primary / not yet active: refresh + retry.
+                span.event("resend: target not active (-EAGAIN)")
                 await self._wait_map_change(min(remaining, 0.3))
                 continue
+            span.event("reply received")
             return reply
 
     async def _wait_map_change(self, timeout: float) -> None:

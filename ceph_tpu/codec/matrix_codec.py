@@ -20,6 +20,7 @@ from typing import Mapping
 
 import jax.numpy as jnp
 import numpy as np
+from jax.core import Tracer as _JaxTracer
 
 from ceph_tpu.gf import expand_matrix, isa_decode_matrix
 from ceph_tpu.ops.pallas_gf import CodingPlan
@@ -29,6 +30,14 @@ from .base import EIO
 from .interface import EcError
 
 DECODE_LRU_CAPACITY = 2516
+
+
+def _trace_local(x) -> bool:
+    """True when `x` was created inside a jax.jit/vmap trace.  Trace-local
+    values must NEVER enter the process-wide cache: a cached tracer
+    poisons every later eager call with UnexpectedTracerError (first hit
+    by bench.py's jitted serial chain warming the encode cache)."""
+    return isinstance(x, _JaxTracer)
 
 _PLATFORM: str | None = None
 
@@ -102,6 +111,8 @@ class _GlobalPlanCache:
         if bm is not None:
             return bm
         bm = jnp.asarray(expand_matrix(coding_rows), dtype=jnp.uint8)
+        if _trace_local(bm):
+            return bm
         with self._lock:
             self._encode.setdefault(key, bm)
             return self._encode[key]
@@ -114,6 +125,8 @@ class _GlobalPlanCache:
         if coder is not None:
             return coder
         coder = self._make_coder(coding_rows, self.encode_bit_matrix(coding_rows))
+        if _trace_local(coder.bm):
+            return coder
         with self._lock:
             return self._encode_coders.setdefault(key, coder)
 
@@ -127,6 +140,8 @@ class _GlobalPlanCache:
                 self._decode_coders.move_to_end(key)
                 return coder
         coder = self._make_coder(matrix, self.lru_bit_matrix(matrix))
+        if _trace_local(coder.bm):
+            return coder
         with self._lock:
             self._lru_put_coder(key, coder)
         return coder
@@ -146,6 +161,8 @@ class _GlobalPlanCache:
                 self._decode.move_to_end(key)
                 return cached[0]
         bm = jnp.asarray(expand_matrix(matrix), dtype=jnp.uint8)
+        if _trace_local(bm):
+            return bm
         with self._lock:
             self._decode[key] = (bm, [])
             self._decode.move_to_end(key)
@@ -216,6 +233,8 @@ class _GlobalPlanCache:
             raise EcError(EIO, f"singular decode matrix for erasures {erasures}")
         c, decode_index = plan
         bitmat = jnp.asarray(expand_matrix(c), dtype=jnp.uint8)
+        if _trace_local(bitmat):
+            return bitmat, decode_index, c
         with self._lock:
             self._decode[key] = (bitmat, decode_index, c)
             self._decode.move_to_end(key)
@@ -257,6 +276,8 @@ class _GlobalPlanCache:
                 self._decode_coders.move_to_end(key)
                 return coder, decode_index
         coder = self._make_coder(c, bitmat)  # built outside the lock
+        if _trace_local(coder.bm):
+            return coder, decode_index
         with self._lock:
             self._lru_put_coder(key, coder)
         return coder, decode_index

@@ -37,11 +37,14 @@ def _get_lib():
 
 def _factory(profile):
     from ceph_tpu.codec.native_codec import ErasureCodeNative
+    from ceph_tpu.codec.tracing import instrument_codec
 
     technique = profile.get("technique") or "reed_sol_van"
     ec = ErasureCodeNative(_get_lib(), technique=technique)
     ec.init(profile)
-    return ec
+    # chunk-path calls (the C kernel) get a single `kernel` span; the
+    # inherited device paths get h2d/kernel_launch like the tpu plugin
+    return instrument_codec(ec, "native")
 
 
 def __erasure_code_init__(registry):

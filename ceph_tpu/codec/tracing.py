@@ -1,0 +1,99 @@
+"""Span instrumentation for codec plugins — stage attribution for encode.
+
+The headline claim (≥40 GB/s/chip RS encode) is only auditable when a
+trace shows where an encode's time actually goes: the host→device
+transfer (H2D), the kernel launch, and — on the reap side, in
+stripe/stripe.py — the kernel wait + device→host copy (D2H).
+`instrument_codec` wraps a codec instance's hot entry points with
+sub-spans attached to the ACTIVE span (common/tracer.py's contextvar),
+so a traced client write's `ec:write` span gains
+
+    codec:<plugin>:encode
+      ├─ h2d            jnp.asarray staging the input onto the device
+      └─ kernel_launch  the async dispatch (returns while the chip works)
+
+children, and the stripe driver's `PendingEncode.result()` adds the
+matching `kernel_wait+d2h` when the parity is materialized.  Host-only
+codecs (the C `native` plugin's chunk interface) get a single `kernel`
+span — the whole call is synchronous host compute.
+
+Zero-cost when tracing is off: with no recorded active span each wrapper
+is one contextvar read and a falsy check before tail-calling the
+original.
+"""
+
+from __future__ import annotations
+
+from ..common import tracer as tracer_mod
+
+
+def active_span():
+    """The active RECORDED span, or None (unrecorded spans would produce
+    children the dump never shows — skip the bookkeeping entirely)."""
+    sp = tracer_mod.current_span()
+    return sp if sp is not None and sp.recorded else None
+
+
+def instrument_codec(ec, plugin: str):
+    """Wrap the device-path (encode_array/decode_array) and chunk-path
+    (encode_chunks/decode_chunks) entry points of `ec` with codec-stage
+    sub-spans.  Idempotent; returns `ec` for factory tail-calls."""
+    if getattr(ec, "_codec_spans_installed", False):
+        return ec
+
+    if hasattr(ec, "encode_array"):
+        orig_encode_array = ec.encode_array
+
+        def encode_array(data):
+            parent = active_span()
+            if parent is None:
+                return orig_encode_array(data)
+            import jax.numpy as jnp
+
+            with parent.child(f"codec:{plugin}:encode") as sp:
+                sp.keyval("shape", lambda: str(getattr(data, "shape", len(data))))
+                with sp.child("h2d"):
+                    dev = jnp.asarray(data)
+                with sp.child("kernel_launch"):
+                    # async dispatch: this times the launch, not the kernel;
+                    # the reap side (PendingEncode.result) times the wait
+                    return orig_encode_array(dev)
+
+        ec.encode_array = encode_array
+
+    if hasattr(ec, "decode_array"):
+        orig_decode_array = ec.decode_array
+
+        def decode_array(erasures, survivors):
+            parent = active_span()
+            if parent is None:
+                return orig_decode_array(erasures, survivors)
+            import jax.numpy as jnp
+
+            with parent.child(f"codec:{plugin}:decode") as sp:
+                sp.keyval("erasures", lambda: ",".join(map(str, erasures)))
+                with sp.child("h2d"):
+                    dev = jnp.asarray(survivors)
+                with sp.child("kernel_launch"):
+                    return orig_decode_array(erasures, dev)
+
+        ec.decode_array = decode_array
+
+    # chunk-level interface: synchronous host (or C) compute — one span
+    for name in ("encode_chunks", "decode_chunks"):
+        orig = getattr(ec, name, None)
+        if orig is None:
+            continue
+
+        def wrapped(*args, _orig=orig, _name=name, **kwargs):
+            parent = active_span()
+            if parent is None:
+                return _orig(*args, **kwargs)
+            with parent.child(f"codec:{plugin}:{_name}") as sp:
+                sp.event("kernel")
+                return _orig(*args, **kwargs)
+
+        setattr(ec, name, wrapped)
+
+    ec._codec_spans_installed = True
+    return ec

@@ -1,0 +1,40 @@
+"""Shared health-check construction.
+
+The mon (`ceph health [detail]`) and the mgr (prometheus
+`ceph_tpu_healthcheck` gauge) both derive SLOW_OPS and OSD_DOWN from the
+same digest slices; building the wording in one place keeps the two
+surfaces in lockstep (the reference gets this from a single
+HealthMonitor check registry)."""
+
+from __future__ import annotations
+
+
+def slow_ops_summary(slow: dict[str, dict]) -> str | None:
+    """The SLOW_OPS check summary for a per-daemon slow-ops slice
+    ({daemon: {count, oldest_sec}}), or None when nothing is slow.
+    Wording matches the reference's `N slow ops, oldest one blocked for
+    S sec, daemons [...] have slow ops.`"""
+    total = sum(v.get("count", 0) for v in slow.values())
+    if not total:
+        return None
+    oldest = max(v.get("oldest_sec", 0.0) for v in slow.values())
+    return (
+        f"{total} slow ops, oldest one blocked for {oldest:.0f} sec, "
+        f"daemons {sorted(slow)} have slow ops."
+    )
+
+
+def slow_ops_detail(slow: dict[str, dict]) -> list[str]:
+    """Per-daemon breakdown lines (`health detail`)."""
+    return [
+        f"{d}: {v.get('count', 0)} slow ops, oldest "
+        f"{v.get('oldest_sec', 0.0):.0f} sec"
+        for d, v in sorted(slow.items())
+    ]
+
+
+def down_in_osds(osdmap) -> list:
+    """OSDs that are IN but not up — the OSD_DOWN population.  A
+    decommissioned (out) osd being down is healthy by design, as in the
+    reference's OSD_DOWN check."""
+    return sorted(o for o, i in osdmap.osds.items() if i.in_ and not i.up)

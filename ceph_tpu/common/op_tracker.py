@@ -56,6 +56,10 @@ class OpTracker:
         self._seq = 0
         self.history: deque[TrackedOp] = deque(maxlen=history_size)
         self.slow: deque[TrackedOp] = deque(maxlen=slow_size)
+        # in-flight ops older than this are "slow requests"
+        # (osd_op_complaint_time; OpTracker::check_ops_in_flight's
+        # complaint threshold) — counted into the SLOW_OPS health check
+        self.complaint_time = 30.0
 
     def resize_history(self, history_size: int) -> None:
         """Runtime osd_op_history_size change (config observer)."""
@@ -98,6 +102,19 @@ class OpTracker:
             if (op.duration or 0.0) > (fastest.duration or 0.0):
                 self.slow.remove(fastest)
                 self.slow.append(op)
+
+    def slow_ops(self) -> tuple[int, float]:
+        """(count, oldest age in seconds) of in-flight ops older than the
+        complaint time (OpTracker::check_ops_in_flight; feeds the OSD's
+        mgr report and, through the mgr digest, the SLOW_OPS health
+        check)."""
+        now = time.monotonic()
+        ages = [
+            now - op.start
+            for op in self._inflight.values()
+            if now - op.start >= self.complaint_time
+        ]
+        return len(ages), max(ages, default=0.0)
 
     # -- dumps (OpTracker::dump_ops_in_flight / dump_historic_ops) -----------
 

@@ -119,6 +119,10 @@ OPTIONS: dict[str, Option] = _opts(
     Option("osd_op_history_size", int, 20, A,
            "completed ops kept for dump_historic_ops (TrackedOp.h)",
            runtime=True),
+    Option("osd_op_complaint_time", float, 30.0, A,
+           "in-flight ops older than this count as slow requests "
+           "(osd.yaml.in osd_op_complaint_time; feeds SLOW_OPS health)",
+           runtime=True),
     Option("osd_op_num_threads_per_shard", int, 2, A, ""),
     Option("osd_heartbeat_interval", float, 1.0, A,
            "seconds between OSD->OSD pings (osd.yaml.in, scaled down)"),

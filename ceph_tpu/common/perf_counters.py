@@ -19,6 +19,109 @@ PERFCOUNTER_U64 = 1
 PERFCOUNTER_TIME = 2
 PERFCOUNTER_LONGRUNAVG = 4
 PERFCOUNTER_COUNTER = 8  # monotonic (vs gauge)
+PERFCOUNTER_HISTOGRAM = 16  # PerfHistogram axes (perf_histogram.h)
+
+
+class PerfHistogramAxis:
+    """One log2-scaled axis (perf_histogram.h axis_config_d with
+    SCALE_LOG2): bucket i covers (bounds[i-1], bounds[i]], where
+    bounds[i] = lowest * 2^i; the last bucket is the +Inf overflow."""
+
+    def __init__(self, lowest: float, buckets: int):
+        if buckets < 2:
+            raise ValueError("histogram needs >= 2 buckets")
+        self.lowest = lowest
+        self.buckets = buckets
+        # finite upper bounds; the final bucket is implicit +Inf
+        self.bounds: list[float] = [
+            lowest * (1 << i) for i in range(buckets - 1)
+        ]
+
+    def index(self, value: float) -> int:
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if value <= self.bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo  # == len(bounds) -> overflow bucket
+
+
+class PerfHistogram:
+    """1D log2-bucketed histogram (PerfHistogram<1>): per-bucket counts
+    plus sum/count so the export satisfies the Prometheus histogram
+    contract (_bucket/_sum/_count)."""
+
+    def __init__(self, axis: PerfHistogramAxis):
+        self.axis = axis
+        self.counts = [0] * axis.buckets
+        self.sum = 0.0
+        self.count = 0
+
+    def sample(self, value: float) -> None:
+        self.counts[self.axis.index(value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def dump(self) -> dict:
+        """JSON-safe cumulative bucket form: [[le, cumulative], ...] with
+        the literal string "+Inf" as the final bound."""
+        cum = 0
+        buckets: list[list] = []
+        for i, c in enumerate(self.counts):
+            cum += c
+            le = self.axis.bounds[i] if i < len(self.axis.bounds) else "+Inf"
+            buckets.append([le, cum])
+        return {
+            "histogram": {
+                "buckets": buckets,
+                "sum": self.sum,
+                "count": self.count,
+            }
+        }
+
+
+class PerfHistogram2D:
+    """2D histogram (PerfHistogram<2>, e.g. the reference's
+    op_w_latency_in_bytes_histogram): counts over size x latency so tail
+    latency can be attributed to op size, not just averaged away."""
+
+    def __init__(self, x_axis: PerfHistogramAxis, y_axis: PerfHistogramAxis):
+        self.x_axis = x_axis
+        self.y_axis = y_axis
+        self.counts = [[0] * y_axis.buckets for _ in range(x_axis.buckets)]
+        self.count = 0
+
+    def sample(self, x: float, y: float) -> None:
+        self.counts[self.x_axis.index(x)][self.y_axis.index(y)] += 1
+        self.count += 1
+
+    def dump(self) -> dict:
+        return {
+            "histogram2d": {
+                "x_le": list(self.x_axis.bounds) + ["+Inf"],
+                "y_le": list(self.y_axis.bounds) + ["+Inf"],
+                "counts": [list(row) for row in self.counts],
+                "count": self.count,
+            }
+        }
+
+
+def histogram_sample_lines(metric: str, h: dict, labels: str = "") -> list[str]:
+    """Prometheus histogram samples for a PerfHistogram.dump() payload:
+    cumulative `_bucket{le=...}` ending at +Inf, then `_sum`/`_count`.
+    `labels` is a pre-rendered `k="v"` list WITHOUT braces ('' for none).
+    Shared by every exporter so the exposition shape cannot diverge."""
+    sep = "," if labels else ""
+    lines = [
+        f'{metric}_bucket{{{labels}{sep}le="{le}"}} {cum}'
+        for le, cum in h["buckets"]
+    ]
+    suffix = f"{{{labels}}}" if labels else ""
+    lines.append(f"{metric}_sum{suffix} {h['sum']}")
+    lines.append(f"{metric}_count{suffix} {h['count']}")
+    return lines
 
 
 @dataclass
@@ -28,6 +131,7 @@ class _Counter:
     desc: str = ""
     value: float = 0.0
     avgcount: int = 0
+    hist: object = None  # PerfHistogram | PerfHistogram2D
 
 
 class PerfCounters:
@@ -59,6 +163,16 @@ class PerfCounters:
             c.value += seconds
             c.avgcount += 1
 
+    def hinc(self, name: str, value: float) -> None:
+        """Sample a 1D histogram counter (PerfCounters::hinc)."""
+        with self._lock:
+            self._counters[name].hist.sample(value)
+
+    def hinc2(self, name: str, x: float, y: float) -> None:
+        """Sample a 2D histogram counter."""
+        with self._lock:
+            self._counters[name].hist.sample(x, y)
+
     def get(self, name: str) -> float:
         with self._lock:
             return self._counters[name].value
@@ -73,11 +187,23 @@ class PerfCounters:
         with self._lock:
             out: dict[str, object] = {}
             for c in self._counters.values():
-                if c.type & PERFCOUNTER_LONGRUNAVG:
+                if c.type & PERFCOUNTER_HISTOGRAM:
+                    out[c.name] = c.hist.dump()
+                elif c.type & PERFCOUNTER_LONGRUNAVG:
                     out[c.name] = {"avgcount": c.avgcount, "sum": c.value}
                 else:
                     out[c.name] = c.value
             return out
+
+    def dump_histograms(self) -> dict[str, object]:
+        """Only the histogram counters (`perf histogram dump` /
+        `dump_histograms` admin-socket payload)."""
+        with self._lock:
+            return {
+                c.name: c.hist.dump()
+                for c in self._counters.values()
+                if c.type & PERFCOUNTER_HISTOGRAM
+            }
 
 
 class PerfCountersBuilder:
@@ -97,6 +223,45 @@ class PerfCountersBuilder:
     def add_time_avg(self, name: str, desc: str = "") -> "PerfCountersBuilder":
         self._pc._counters[name] = _Counter(
             name, PERFCOUNTER_TIME | PERFCOUNTER_LONGRUNAVG, desc
+        )
+        return self
+
+    def add_histogram(
+        self,
+        name: str,
+        desc: str = "",
+        lowest: float = 1e-6,
+        buckets: int = 25,
+    ) -> "PerfCountersBuilder":
+        """1D log2 histogram; the default axis covers 1 µs .. ~8.4 s of
+        latency before the +Inf overflow bucket."""
+        self._pc._counters[name] = _Counter(
+            name,
+            PERFCOUNTER_TIME | PERFCOUNTER_HISTOGRAM,
+            desc,
+            hist=PerfHistogram(PerfHistogramAxis(lowest, buckets)),
+        )
+        return self
+
+    def add_histogram_2d(
+        self,
+        name: str,
+        desc: str = "",
+        x_lowest: float = 4096,
+        x_buckets: int = 12,
+        y_lowest: float = 1e-6,
+        y_buckets: int = 25,
+    ) -> "PerfCountersBuilder":
+        """2D log2 histogram; defaults to size (4 KiB .. 8 MiB) x latency
+        (1 µs .. ~8.4 s) — the op_w_latency_in_bytes_histogram shape."""
+        self._pc._counters[name] = _Counter(
+            name,
+            PERFCOUNTER_U64 | PERFCOUNTER_HISTOGRAM,
+            desc,
+            hist=PerfHistogram2D(
+                PerfHistogramAxis(x_lowest, x_buckets),
+                PerfHistogramAxis(y_lowest, y_buckets),
+            ),
         )
         return self
 
@@ -134,7 +299,15 @@ class PerfCountersCollection:
         for logger, counters in sorted(self.dump().items()):
             for cname, val in sorted(counters.items()):
                 metric = f"ceph_tpu_{sanitize(logger)}_{sanitize(cname)}"
-                if isinstance(val, dict):
+                if isinstance(val, dict) and "histogram" in val:
+                    lines.append(f"# HELP {metric} perf histogram {cname}")
+                    lines.append(f"# TYPE {metric} histogram")
+                    lines.extend(
+                        histogram_sample_lines(metric, val["histogram"])
+                    )
+                elif isinstance(val, dict) and "histogram2d" in val:
+                    continue  # 2D grids have no prometheus family shape
+                elif isinstance(val, dict):
                     lines.append(f"{metric}_sum {val['sum']}")
                     lines.append(f"{metric}_count {val['avgcount']}")
                 else:

@@ -7,14 +7,37 @@ ZTracer::Trace, src/osd/ECBackend.h:64-87, with events like
 `trace.event("start ec write")`, ECBackend.cc:2020).  Spans here are
 in-process records with parent links, timed events, and keyvals,
 exportable as JSON for offline analysis.
+
+Cross-daemon propagation (the W3C traceparent / jspan-context analog):
+every span carries a 63-bit `trace_id` shared by the whole operation and
+a process-unique `span_id`.  `inject()` copies the pair into a message's
+envelope fields and `extract()` recovers a `TraceContext` on the far
+side, so one client write yields ONE trace spanning client → messenger →
+OSD dispatch → EC encode → codec kernel → commit, with every hop
+parent-linked across daemons.  `current_span()`/`span_scope()` expose
+the active span through a contextvar so deep layers (codec plugins, the
+stripe driver) can attach sub-spans without threading a parent through
+every signature.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import itertools
+import random
 import threading
 import time
 from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The propagated (trace_id, span_id) pair — what rides a message
+    envelope between daemons (jspan context / blkin trace info)."""
+
+    trace_id: int
+    span_id: int
 
 
 @dataclass
@@ -28,6 +51,7 @@ class Span:
     # not grow events on spans the dump will never show, nor attach
     # exported children to unexported parents.
     recorded: bool = False
+    trace_id: int = 0
     start: float = field(default_factory=time.monotonic)
     end: float | None = None
     events: list[tuple[float, str]] = field(default_factory=list)
@@ -48,6 +72,10 @@ class Span:
     def child(self, name: str) -> "Span":
         return self.tracer.start_span(name, parent=self)
 
+    def context(self) -> TraceContext:
+        """The propagatable identity of this span."""
+        return TraceContext(self.trace_id, self.span_id)
+
     def finish(self) -> None:
         self.end = time.monotonic()
 
@@ -59,6 +87,7 @@ class Span:
 
     def to_dict(self) -> dict:
         return {
+            "trace_id": self.trace_id,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
             "name": self.name,
@@ -80,22 +109,45 @@ class Tracer:
         self.service = service
         self.enabled = enabled
         self._ids = itertools.count(1)
+        # span ids must not collide across the daemons contributing to one
+        # trace: offset each tracer's counter by a random 63-bit base (the
+        # reference gets uniqueness from otel's random 64-bit span ids)
+        self._id_base = random.getrandbits(63) & ~0xFFFFF
         self._lock = threading.Lock()
         # ring buffer: the NEWEST max_spans survive — an operator dumping
         # traces to debug a current problem needs recent spans, not the
         # daemon's boot-time history
         self._spans: "deque[Span]" = deque(maxlen=max_spans)
 
-    def start_span(self, name: str, parent: Span | None = None) -> Span:
+    def start_span(
+        self,
+        name: str,
+        parent: Span | None = None,
+        remote: TraceContext | None = None,
+    ) -> Span:
+        """Start a span.  `parent` links within this process; `remote` is
+        an extracted cross-daemon context (takes effect only when no local
+        parent is given)."""
         # children of unrecorded parents stay unrecorded (no dangling
         # parent_id in the export after a mid-op enable flip)
         record = self.enabled and (parent is None or parent.recorded)
+        if parent is not None:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        elif remote is not None and remote.trace_id:
+            trace_id = remote.trace_id
+            parent_id = remote.span_id
+        else:
+            # new root: allocate a trace id only when it can be exported
+            trace_id = (random.getrandbits(63) | 1) if record else 0
+            parent_id = None
         span = Span(
             tracer=self,
-            span_id=next(self._ids),
-            parent_id=parent.span_id if parent else None,
+            span_id=self._id_base + next(self._ids),
+            parent_id=parent_id,
             name=name,
             recorded=record,
+            trace_id=trace_id,
         )
         if record:
             with self._lock:
@@ -105,6 +157,16 @@ class Tracer:
     def export(self) -> list[dict]:
         with self._lock:
             return [s.to_dict() for s in self._spans]
+
+    def export_traces(self) -> dict[str, list[dict]]:
+        """Spans grouped by trace id, each trace ordered by start time —
+        the `dump_tracing` admin-socket payload."""
+        traces: dict[str, list[dict]] = {}
+        for s in self.export():
+            traces.setdefault(str(s["trace_id"]), []).append(s)
+        for spans in traces.values():
+            spans.sort(key=lambda s: s["start"])
+        return traces
 
     def clear(self) -> None:
         with self._lock:
@@ -116,3 +178,44 @@ NULL_TRACER = Tracer(enabled=False)
 
 def null_span(name: str = "") -> Span:
     return NULL_TRACER.start_span(name)
+
+
+# -- context propagation helpers ----------------------------------------------
+
+_CURRENT: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+    "ceph_tpu_current_span", default=None
+)
+
+
+def current_span() -> Span | None:
+    """The active span in this execution context (if any)."""
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def span_scope(span: Span | None):
+    """Make `span` the current span for the duration of the block (the
+    otel Scope analog).  Does NOT finish the span."""
+    token = _CURRENT.set(span)
+    try:
+        yield span
+    finally:
+        _CURRENT.reset(token)
+
+
+def inject(span: Span | None, msg) -> None:
+    """Copy a span's context into a message's envelope fields (the
+    traceparent header write).  No-op for unrecorded spans, so disabled
+    tracers cost two attribute reads."""
+    if span is not None and span.recorded:
+        msg.trace_id = span.trace_id
+        msg.span_id = span.span_id
+
+
+def extract(msg) -> TraceContext | None:
+    """Recover the propagated context from a received message (the
+    traceparent header read); None when the sender wasn't tracing."""
+    trace_id = getattr(msg, "trace_id", 0)
+    if not trace_id:
+        return None
+    return TraceContext(trace_id, getattr(msg, "span_id", 0))

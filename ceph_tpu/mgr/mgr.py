@@ -152,7 +152,59 @@ class Mgr(Dispatcher):
             "pools": pools,
             "osds": osds,  # per-daemon raw bytes (`ceph osd df`)
             "total_used_raw": sum(p["used_raw"] for p in pools.values()),
+            # per-daemon slow-request counts (OpTracker complaint ages);
+            # the mon-side SLOW_OPS health check reads this slice
+            "slow_ops": self.slow_ops_by_daemon(),
         }
+
+    def slow_ops_by_daemon(self) -> dict[str, dict]:
+        """Daemons currently reporting slow requests (count + oldest age),
+        the DaemonServer side of the OSD's `N slow requests` complaint."""
+        out: dict[str, dict] = {}
+        for daemon, st in self.daemons.items():
+            slow = (st.status or {}).get("slow_ops") or {}
+            if not slow.get("count"):
+                continue
+            # a crashed daemon's LAST report would otherwise raise
+            # SLOW_OPS forever: a down osd has no in-flight ops, so its
+            # stale count must not survive into the digest
+            if daemon.startswith("osd."):
+                try:
+                    info = self.osdmap.osds.get(int(daemon[4:]))
+                except ValueError:
+                    info = None
+                if info is not None and not info.up:
+                    continue
+            out[daemon] = {
+                "count": int(slow["count"]),
+                "oldest_sec": float(slow.get("oldest_sec", 0.0)),
+            }
+        return out
+
+    def health_checks(self) -> dict[str, dict]:
+        """Mgr-visible health checks in the reference's check shape
+        ({code: {severity, summary}}): what the prometheus module exports
+        as the ceph_tpu_healthcheck gauge.  SLOW_OPS mirrors the mon-side
+        check computed from the same digest; module checks (e.g. the
+        autoscaler's POOL_PG_NUM) merge in."""
+        from ..common import health
+
+        checks: dict[str, dict] = {}
+        summary = health.slow_ops_summary(self.slow_ops_by_daemon())
+        if summary:
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": summary,
+            }
+        down = health.down_in_osds(self.osdmap)
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(down)} osds down",
+            }
+        for module in self.modules:
+            checks.update(getattr(module, "health_checks", {}) or {})
+        return checks
 
     def _on_osdmap(self, msg: MOSDMap) -> None:
         self.osdmap = advance_map(self.osdmap, msg)

@@ -9,6 +9,7 @@ the reference's cherrypy server (module.py StandbyModule/Module).
 
 from __future__ import annotations
 
+from ..common.perf_counters import histogram_sample_lines
 from .modules import HttpServedModule, MgrModule
 
 
@@ -26,22 +27,45 @@ class PrometheusModule(HttpServedModule, MgrModule):
     # -- exposition ------------------------------------------------------------
 
     def scrape(self) -> str:
-        """The /metrics payload (module.py collect)."""
-        out: list[str] = []
+        """The /metrics payload (module.py collect).
+
+        Exposition contract (validated by tests/test_metrics_lint.py):
+        every family gets exactly one HELP + TYPE block, families never
+        repeat, and histogram families carry cumulative `le` buckets
+        ending in +Inf plus `_sum`/`_count` — real Prometheus histograms,
+        so `histogram_quantile()` works on op latency out of the box."""
         mgr = self.mgr
+        # family name -> (type, help, [sample lines]); insertion-ordered so
+        # each family renders as one HELP/TYPE block with all its samples
+        families: dict[str, tuple[str, str, list[str]]] = {}
+
+        def family(name: str, ftype: str, help_: str) -> list[str]:
+            if name not in families:
+                families[name] = (ftype, help_, [])
+            return families[name][2]
+
         # cluster-level gauges (ceph_osd_up/ceph_osd_in analogs)
         osdmap = mgr.osdmap
-        out.append("# HELP ceph_tpu_osd_up OSD up state")
-        out.append("# TYPE ceph_tpu_osd_up gauge")
+        up = family("ceph_tpu_osd_up", "gauge", "OSD up state")
+        in_ = family("ceph_tpu_osd_in", "gauge", "OSD in state")
         for osd, info in sorted(osdmap.osds.items()):
-            out.append(f'ceph_tpu_osd_up{{osd="{osd}"}} {int(info.up)}')
-        out.append("# HELP ceph_tpu_osd_in OSD in state")
-        out.append("# TYPE ceph_tpu_osd_in gauge")
-        for osd, info in sorted(osdmap.osds.items()):
-            out.append(f'ceph_tpu_osd_in{{osd="{osd}"}} {int(info.in_)}')
-        out.append("# HELP ceph_tpu_osdmap_epoch current osdmap epoch")
-        out.append("# TYPE ceph_tpu_osdmap_epoch counter")
-        out.append(f"ceph_tpu_osdmap_epoch {osdmap.epoch}")
+            up.append(f'ceph_tpu_osd_up{{osd="{osd}"}} {int(info.up)}')
+            in_.append(f'ceph_tpu_osd_in{{osd="{osd}"}} {int(info.in_)}')
+        family("ceph_tpu_osdmap_epoch", "counter", "current osdmap epoch").append(
+            f"ceph_tpu_osdmap_epoch {osdmap.epoch}"
+        )
+        # health checks (ceph_health_detail analog): one gauge sample per
+        # ACTIVE check; absent when the check clears
+        checks = mgr.health_checks()
+        hc = family(
+            "ceph_tpu_healthcheck", "gauge",
+            "active cluster health checks (1 = raised)",
+        )
+        for code, info in sorted(checks.items()):
+            sev = info.get("severity", "HEALTH_WARN")
+            hc.append(
+                f'ceph_tpu_healthcheck{{name="{code}",severity="{sev}"}} 1'
+            )
         # pool stats from the PGMap digest (ceph_pool_stored/objects/
         # bytes_used analogs of the reference exporter)
         digest = mgr.pg_digest()
@@ -50,24 +74,50 @@ class PrometheusModule(HttpServedModule, MgrModule):
             ("pool_objects", "objects", "head objects"),
             ("pool_used_raw_bytes", "used_raw", "raw bytes incl. replicas"),
         ):
-            out.append(f"# HELP ceph_tpu_{metric} {help_}")
-            out.append(f"# TYPE ceph_tpu_{metric} gauge")
+            rows = family(f"ceph_tpu_{metric}", "gauge", help_)
             for pool, st in sorted(digest["pools"].items()):
-                out.append(
+                rows.append(
                     f'ceph_tpu_{metric}{{pool="{pool}"}} {st[field_]}'
                 )
-        # per-daemon perf counters
-        seen_types: set[str] = set()
+        # per-daemon perf counters, grouped into families across daemons
         for daemon in mgr.list_daemons():
             perf = mgr.get_daemon_perf(daemon)
             for counter, value in sorted(perf.items()):
                 metric = f"ceph_tpu_{_sanitize(counter)}"
+                if isinstance(value, dict) and "histogram" in value:
+                    family(
+                        metric, "histogram", f"perf histogram {counter}"
+                    ).extend(
+                        histogram_sample_lines(
+                            metric, value["histogram"], f'daemon="{daemon}"'
+                        )
+                    )
+                    continue
+                if isinstance(value, dict) and "histogram2d" in value:
+                    # 2D size x latency grids have no Prometheus family
+                    # shape; they stay on the admin socket (dump_histograms)
+                    continue
                 if isinstance(value, dict):  # long-run avg {avgcount, sum}
-                    value = value.get("sum", 0)
-                if metric not in seen_types:
-                    seen_types.add(metric)
-                    out.append(f"# TYPE {metric} counter")
-                out.append(f'{metric}{{daemon="{daemon}"}} {value}')
+                    family(
+                        f"{metric}_sum", "counter", f"perf counter {counter} sum"
+                    ).append(
+                        f'{metric}_sum{{daemon="{daemon}"}} {value.get("sum", 0)}'
+                    )
+                    family(
+                        f"{metric}_count", "counter",
+                        f"perf counter {counter} sample count",
+                    ).append(
+                        f'{metric}_count{{daemon="{daemon}"}} {value.get("avgcount", 0)}'
+                    )
+                    continue
+                family(metric, "counter", f"perf counter {counter}").append(
+                    f'{metric}{{daemon="{daemon}"}} {value}'
+                )
+        out: list[str] = []
+        for name, (ftype, help_, rows) in families.items():
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {ftype}")
+            out.extend(rows)
         return "\n".join(out) + "\n"
 
     # -- HTTP endpoint (scaffold in modules.HttpServedModule) ----------------

@@ -282,10 +282,22 @@ class Monitor(Dispatcher):
             if self.is_leader():
                 self.mdsmon.prepare_beacon(msg)
         elif isinstance(msg, MMonMgrReport):
-            try:
-                self.pg_digest = json.loads(msg.digest.decode() or "{}")
-            except json.JSONDecodeError:
-                pass
+            # Only the ACTIVE mgr (per the committed mgrmap) may supply the
+            # digest: it drives FLAG_FULL_QUOTA and SLOW_OPS, so a stats
+            # report from any other session (standby mgr, spoofed client)
+            # is dropped (the reference's DaemonServer gates the same way).
+            active = self.mgrmon.map.active_name
+            if active and conn.peer_name == f"mgr.{active}":
+                try:
+                    self.pg_digest = json.loads(msg.digest.decode() or "{}")
+                except json.JSONDecodeError:
+                    pass
+            else:
+                dout(
+                    "mon", 5,
+                    f"mon.{self.name}: dropping MMonMgrReport from "
+                    f"{conn.peer_name or msg.src!r} (active mgr: {active or 'none'})",
+                )
         elif isinstance(msg, MLog):
             # Daemon clog entries: the leader proposes them; a peon forwards
             # to the leader (Monitor::forward_request_leader).
@@ -406,6 +418,54 @@ class Monitor(Dispatcher):
         except Exception as e:  # command bugs must not kill the mon
             reply(-EINVAL, f"command failed: {e}")
 
+    def health_checks(self) -> tuple[dict[str, str], dict[str, list[str]]]:
+        """Mon-side cluster health (`ceph -s` HEALTH line / `ceph health
+        [detail]`): (checks, detail) where checks maps code -> summary
+        string and detail maps code -> per-entity breakdown lines.  Down
+        OSDs, missing quorum members, and dead filesystems come from the
+        mon's own committed maps; SLOW_OPS comes from the active mgr's
+        digest (the OSDs' OpTracker complaint counts, the reference's
+        OSDMap::check_health slow-request path)."""
+        from ..common import health
+
+        checks: dict[str, str] = {}
+        details: dict[str, list[str]] = {}
+        down = health.down_in_osds(self.osdmon.osdmap)
+        if down:
+            checks["OSD_DOWN"] = (
+                f"{len(down)} osds down: "
+                + ", ".join(f"osd.{o}" for o in sorted(down))
+            )
+            details["OSD_DOWN"] = [f"osd.{o} is down" for o in sorted(down)]
+        if len(self.quorum) < self.monmap.size():
+            out = self.monmap.size() - len(self.quorum)
+            checks["MON_DOWN"] = f"{out} monitor(s) out of quorum"
+            details["MON_DOWN"] = [
+                f"mon rank {r} not in quorum"
+                for r in range(self.monmap.size())
+                if r not in self.quorum
+            ]
+        down_fs = [
+            name
+            for name, fs in self.mdsmon.map.filesystems.items()
+            if not fs["active_name"]
+        ]
+        if down_fs:
+            # a filesystem with no rank 0 serves nothing
+            # (MDSMonitor MDS_ALL_DOWN health check)
+            checks["MDS_ALL_DOWN"] = (
+                f"fs {', '.join(sorted(down_fs))} has no active MDS"
+            )
+            details["MDS_ALL_DOWN"] = [
+                f"fs {name} has no active MDS" for name in sorted(down_fs)
+            ]
+        slow = self.pg_digest.get("slow_ops") or {}
+        summary = health.slow_ops_summary(slow)
+        if summary:
+            checks["SLOW_OPS"] = summary
+            details["SLOW_OPS"] = health.slow_ops_detail(slow)
+        return checks, details
+
     def _mon_command_handler(self, prefix: str):
         if prefix == "df":
             def handler(cmd, reply):
@@ -424,16 +484,16 @@ class Monitor(Dispatcher):
         if prefix == "health":
             def handler(cmd, reply):
                 # `ceph health [detail]`: the status handler's checks,
-                # served standalone (ClusterHealth essence)
-                self._mon_command_handler("status")(
-                    cmd,
-                    lambda rv, rs, out=b"": reply(
-                        rv, rs,
-                        json.dumps(
-                            json.loads(out or b"{}").get("health", {})
-                        ).encode(),
-                    ),
-                )
+                # served standalone (ClusterHealth essence); `detail`
+                # adds the per-daemon breakdown lines
+                checks, details = self.health_checks()
+                payload = {
+                    "status": "HEALTH_WARN" if checks else "HEALTH_OK",
+                    "checks": checks,
+                }
+                if cmd.get("detail"):
+                    payload["detail"] = details
+                reply(0, "", json.dumps(payload).encode())
             return handler
         if prefix == "quorum_status":
             def handler(cmd, reply):
@@ -442,34 +502,7 @@ class Monitor(Dispatcher):
         if prefix == "status":
             def handler(cmd, reply):
                 m = self.osdmon.osdmap
-                # mon-side health summary (`ceph -s` HEALTH line): down
-                # OSDs and missing quorum members are the checks the mon
-                # can see on its own; mgr modules add theirs via the
-                # dashboard's /api/health
-                checks = {}
-                # only IN osds count: a decommissioned (out) osd being
-                # down is healthy by design, as in the reference's
-                # OSD_DOWN check
-                down = [o for o, i in m.osds.items() if i.in_ and not i.up]
-                if down:
-                    checks["OSD_DOWN"] = (
-                        f"{len(down)} osds down: "
-                        + ", ".join(f"osd.{o}" for o in sorted(down))
-                    )
-                if len(self.quorum) < self.monmap.size():
-                    out = self.monmap.size() - len(self.quorum)
-                    checks["MON_DOWN"] = f"{out} monitor(s) out of quorum"
-                down_fs = [
-                    name
-                    for name, fs in self.mdsmon.map.filesystems.items()
-                    if not fs["active_name"]
-                ]
-                if down_fs:
-                    # a filesystem with no rank 0 serves nothing
-                    # (MDSMonitor MDS_ALL_DOWN health check)
-                    checks["MDS_ALL_DOWN"] = (
-                        f"fs {', '.join(sorted(down_fs))} has no active MDS"
-                    )
+                checks, _details = self.health_checks()
                 reply(
                     0,
                     "",

@@ -31,7 +31,10 @@ import os
 import struct
 import zlib
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # plaintext/compress paths work without the package
+    AESGCM = None
 
 MAGIC = b"CW"
 FLAG_SECURE = 1
@@ -75,6 +78,10 @@ class OnWireSession:
         self.secure = secure
         self.compress = compress
         if secure:
+            if AESGCM is None:
+                raise OnWireError(
+                    "secure mode requires the 'cryptography' package"
+                )
             c2s = derive_session_key(key, b"dir:c2s")
             s2c = derive_session_key(key, b"dir:s2c")
             tx, rx = (c2s, s2c) if initiator else (s2c, c2s)

@@ -115,6 +115,11 @@ class Message(Encodable):
     COMPAT = 1
     FIELDS: list[tuple[str, Any]] = []
     priority = PRIO_DEFAULT
+    # trace context (common/tracer.py inject/extract): rides the envelope
+    # like the reference's jspan/blkin trace info so one op's spans link
+    # across daemons; 0 = untraced
+    trace_id = 0
+    span_id = 0
 
     def __init__(self, **kwargs):
         self.src = ""
@@ -122,7 +127,9 @@ class Message(Encodable):
         for name, _ in self.FIELDS:
             setattr(self, name, None)
         for k, v in kwargs.items():
-            if k not in {n for n, _ in self.FIELDS} | {"src", "seq", "priority"}:
+            if k not in {n for n, _ in self.FIELDS} | {
+                "src", "seq", "priority", "trace_id", "span_id",
+            }:
                 raise TypeError(f"{type(self).__name__} has no field {k}")
             setattr(self, k, v)
 
@@ -158,6 +165,8 @@ def encode_message(msg: Message) -> tuple[bytes, bytes]:
         .string(msg.src)
         .u64(msg.seq)
         .u8(msg.priority)
+        .u64(msg.trace_id)
+        .u64(msg.span_id)
         .tobytes()
     )
     return env, msg.tobytes()
@@ -169,6 +178,8 @@ def decode_message(envelope: bytes, payload: bytes) -> Message:
     src = d.string()
     seq = d.u64()
     priority = d.u8()
+    trace_id = d.u64()
+    span_id = d.u64()
     cls = _REGISTRY.get(type_id)
     if cls is None:
         raise ValueError(f"unknown message type {type_id}")
@@ -176,4 +187,6 @@ def decode_message(envelope: bytes, payload: bytes) -> Message:
     msg.src = src
     msg.seq = seq
     msg.priority = priority
+    msg.trace_id = trace_id
+    msg.span_id = span_id
     return msg

@@ -23,6 +23,7 @@ import asyncio
 import random
 from dataclasses import dataclass
 
+from ..common import tracer as tracer_mod
 from ..common.log import dout
 from ..common.throttle import AsyncThrottle
 from .crypto import (
@@ -326,6 +327,10 @@ class Messenger:
         self.default_policy = Policy.lossy_client()
         self._accepted: list[Connection] = []
         self.auth = auth
+        # daemon-attached Tracer (common/tracer.py): when set and enabled,
+        # delivery of a trace-carrying message records a messenger span
+        # parent-linked to the sender's (the blkin "async messenger" hop)
+        self.tracer = None
 
     # -- setup ---------------------------------------------------------------
 
@@ -462,19 +467,30 @@ class Messenger:
         size = 64  # envelope floor; payload length dominates below
         if self._throttle is not None:
             await self._throttle.get(size)
+        span = None
+        if self.tracer is not None and self.tracer.enabled:
+            ctx = tracer_mod.extract(msg)
+            if ctx is not None:
+                span = self.tracer.start_span(
+                    f"msgr:{type(msg).__name__}", remote=ctx
+                )
+                span.keyval("src", msg.src)
         try:
-            for d in self.dispatchers:
-                if d.ms_can_fast_dispatch(msg):
-                    d.ms_fast_dispatch(conn, msg)
-                    return
-            for d in self.dispatchers:
-                handled = d.ms_dispatch(conn, msg)
-                if asyncio.iscoroutine(handled):
-                    handled = await handled
-                if handled:
-                    return
+            with tracer_mod.span_scope(span):
+                for d in self.dispatchers:
+                    if d.ms_can_fast_dispatch(msg):
+                        d.ms_fast_dispatch(conn, msg)
+                        return
+                for d in self.dispatchers:
+                    handled = d.ms_dispatch(conn, msg)
+                    if asyncio.iscoroutine(handled):
+                        handled = await handled
+                    if handled:
+                        return
             dout("ms", 0, f"{self.name}: unhandled message {msg!r} from {msg.src}")
         finally:
+            if span is not None:
+                span.finish()
             if self._throttle is not None:
                 await self._throttle.put(size)
 

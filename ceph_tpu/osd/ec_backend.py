@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Mapping
 
@@ -36,6 +37,7 @@ import numpy as np
 
 from ..codec.base import EIO
 from ..codec.interface import EcError, ErasureCodeInterface
+from ..common import tracer as tracer_mod
 from ..common.tracer import null_span
 from ..msg.messages import (
     MOSDECSubOpRead,
@@ -89,6 +91,7 @@ class Op:
     # pipeline reaps these FIFO so sub-writes fan out in tid order
     encode_stage: object | None = None
     drain_polls: int = 0
+    encode_t0: float = 0.0  # launch time; reap samples ec_encode_latency
     # ec:write span (ECBackend::Op::trace); null span unless a tracer is on
     trace: object = field(default_factory=lambda: null_span())
 
@@ -182,12 +185,24 @@ class ECBackend(PGBackend):
     def _span(self, name: str, parent=None):
         """Start a span on the daemon tracer (the ZTracer::Trace threaded
         through every handle_sub_* in the reference, ECBackend.h:64-87);
-        harnesses without a tracer get no-op spans."""
+        harnesses without a tracer get no-op spans.  With no explicit
+        parent, the active span (the OSD's osd:op, set by dispatch) is
+        adopted so the EC stages join the client's trace instead of
+        starting a disconnected root."""
         from ..common.tracer import NULL_TRACER
 
+        if parent is None:
+            parent = tracer_mod.current_span()
         if parent is not None:
             return parent.child(name)
         return (getattr(self.listener, "tracer", None) or NULL_TRACER).start_span(name)
+
+    def _perf_hist(self, name: str, value: float) -> None:
+        """Sample a daemon latency histogram through the listener (PGs
+        forward to the OSD's PerfCounters; harnesses without one drop it)."""
+        hook = getattr(self.listener, "perf_hist", None)
+        if hook is not None:
+            hook(name, value)
 
     def _next_tid(self) -> int:
         self._tid += 1
@@ -393,14 +408,19 @@ class ECBackend(PGBackend):
         out when the pipeline reaps the op (FIFO), so the next op's RMW
         reads overlap this op's device encode — the overlap the reference
         gets from queued AIO in front of ec_encode_data."""
-        op.encode_stage = launch_encode(
-            op.pgt,
-            op.plan,
-            self.sinfo,
-            self.ec,
-            op.obj_size,
-            op.read_results,
-        )
+        op.encode_t0 = time.monotonic()
+        # scope the launch under ec:write so codec h2d/kernel_launch
+        # sub-spans (codec/tracing.py) and the PendingEncode's reap span
+        # attach to this op's trace
+        with tracer_mod.span_scope(op.trace if op.trace.recorded else None):
+            op.encode_stage = launch_encode(
+                op.pgt,
+                op.plan,
+                self.sinfo,
+                self.ec,
+                op.obj_size,
+                op.read_results,
+            )
         op.encoded = True
         op.trace.event("encode launched")
         # Pin exactly the bytes that were encoded (host-side, available at
@@ -466,19 +486,26 @@ class ECBackend(PGBackend):
             hinfo = proj["hinfo"]
         else:
             hinfo = self.get_hash_info(op.pgt.oid)
-        txns, new_hinfo, merged = finish_transactions(
-            op.encode_stage,
-            op.pgt,
-            op.plan,
-            self.sinfo,
-            self.ec,
-            self._shard_colls(),
-            op.obj_size,
-            hinfo,
-            op.version.version,
-        )
+        # the reap may run from a bare event-loop callback (_drain_encode_pipe):
+        # re-enter the op's span scope so materialization sub-spans attach
+        with tracer_mod.span_scope(op.trace if op.trace.recorded else None):
+            txns, new_hinfo, merged = finish_transactions(
+                op.encode_stage,
+                op.pgt,
+                op.plan,
+                self.sinfo,
+                self.ec,
+                self._shard_colls(),
+                op.obj_size,
+                hinfo,
+                op.version.version,
+            )
         op.encode_stage = None
         op.trace.event("encoded")
+        if op.encode_t0:
+            # launch -> reap: what the OSD's ec_encode_latency histogram
+            # attributes to the encode stage
+            self._perf_hist("ec_encode_latency", time.monotonic() - op.encode_t0)
         if proj is not None:
             proj["hinfo"] = new_hinfo
             proj["hinfo_known"] = True
@@ -837,13 +864,17 @@ class ECBackend(PGBackend):
                     results[oid] = (e.errno, [])
 
         if not rop.want <= good:
+            t0 = time.monotonic()
             # decode path: spans make the degraded read visible end to end
             with rop.trace.child("ec:reconstruct") as sp:
                 sp.keyval("have", ",".join(map(str, sorted(good))))
                 sp.keyval("want", ",".join(map(str, sorted(rop.want))))
-                reconstruct_all()
+                with tracer_mod.span_scope(sp if sp.recorded else None):
+                    reconstruct_all()
+            self._perf_hist("ec_decode_latency", time.monotonic() - t0)
         else:
-            reconstruct_all()
+            with tracer_mod.span_scope(rop.trace if rop.trace.recorded else None):
+                reconstruct_all()
         rop.trace.event("read complete")
         rop.trace.finish()
         rop.on_complete(results)
@@ -958,6 +989,7 @@ class ECBackend(PGBackend):
                 fragmented = True
         rec.attrs = rop.attrs.get(rec.oid, {})
         want = set(rec.missing_on)
+        t0 = time.monotonic()
         try:
             if fragmented:
                 # CLAY repair: helpers supplied, per stripe-chunk, the
@@ -975,8 +1007,10 @@ class ECBackend(PGBackend):
                     for s in want:
                         rebuilt[s] += np.asarray(decoded[s]).tobytes()
             else:
-                decoded = stripe_mod.decode_shards(self.sinfo, self.ec, have, want)
+                with tracer_mod.span_scope(rec.trace if rec.trace.recorded else None):
+                    decoded = stripe_mod.decode_shards(self.sinfo, self.ec, have, want)
                 rebuilt = {s: np.asarray(decoded[s]).tobytes() for s in want}
+            self._perf_hist("ec_decode_latency", time.monotonic() - t0)
         except (EcError, KeyError) as e:
             del self.recovery_ops[rec.oid]
             rec.trace.event(f"decode failed ({e})")

@@ -135,6 +135,16 @@ class OSD(Dispatcher):
         for c in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
                   "recovery_ops", "heartbeat_failures", "backfill_pushes"):
             b.add_u64_counter(c)
+        # latency distributions (PerfHistogram; the reference's
+        # op_latency / op_w_latency_in_bytes_histogram family): log2
+        # buckets so the prometheus export is a real histogram, not an
+        # average that hides the tail
+        b.add_histogram("op_latency", "client op dispatch->reply (s)")
+        b.add_histogram_2d(
+            "op_size_latency", "op payload bytes x dispatch->reply (s)"
+        )
+        b.add_histogram("ec_encode_latency", "EC encode launch->reap (s)")
+        b.add_histogram("ec_decode_latency", "EC reconstruct decode (s)")
         self.perf = b.create_perf_counters()
         self.clog: list[str] = []
         self._pushed_config: set[str] = set()  # mon-managed option names
@@ -157,10 +167,15 @@ class OSD(Dispatcher):
         self.op_tracker = OpTracker(
             history_size=self.conf.get("osd_op_history_size")
         )
+        self.op_tracker.complaint_time = self.conf.get("osd_op_complaint_time")
         # runtime-mutable: resize the history ring on config push
         self.conf.add_observer(
             ["osd_op_history_size"],
             lambda _n, v: self.op_tracker.resize_history(int(v)),
+        )
+        self.conf.add_observer(
+            ["osd_op_complaint_time"],
+            lambda _n, v: setattr(self.op_tracker, "complaint_time", float(v)),
         )
         # span tracer threaded through the EC data path (common/tracer.py;
         # the reference's ZTracer/jaeger integration, dumped via the admin
@@ -175,6 +190,9 @@ class OSD(Dispatcher):
             ["jaeger_tracing_enable"],
             lambda _n, v: setattr(self.tracer, "enabled", bool(v)),
         )
+        # incoming trace-carrying messages get a messenger hop span
+        # parent-linked to the sender (tracer.py inject/extract)
+        self.msgr.tracer = self.tracer
         self.admin_socket = None
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
@@ -246,6 +264,17 @@ class OSD(Dispatcher):
             "dump_tracer",
             lambda cmd: {"spans": self.tracer.export()},
             "dump collected trace spans (EC data path)",
+        )
+        sock.register(
+            "dump_tracing",
+            lambda cmd: {"traces": self.tracer.export_traces()},
+            "spans grouped per trace id (cross-daemon op traces; "
+            "client/messenger/dispatch/encode/codec stages)",
+        )
+        sock.register(
+            "dump_histograms",
+            lambda cmd: self.perf.dump_histograms(),
+            "log2-bucketed latency (and size x latency) histograms",
         )
         def _pg_for_cmd(cmd):
             if "pool" not in cmd or "ps" not in cmd:
@@ -531,6 +560,8 @@ class OSD(Dispatcher):
 
     def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
         """enqueue_op (OSD.cc:9431): into the QoS scheduler."""
+        from ..common import tracer as tracer_mod
+
         cost = sum(len(op.data) for op in msg.ops) or 4096
         self.perf.inc("op")
         # OpTracker registration (OpRequest created at dispatch,
@@ -540,10 +571,23 @@ class OSD(Dispatcher):
             f"{msg.pgid.pool}.{msg.pgid.ps} {msg.oid} "
             f"[{','.join(str(op.op) for op in msg.ops)}])"
         )
+        # op span: child of the messenger hop span when the delivery is
+        # being traced, else adopted from the message's remote context
+        # (OpRequest's osd_trace in the reference)
+        span = self.tracer.start_span(
+            "osd:op",
+            parent=tracer_mod.current_span(),
+            remote=tracer_mod.extract(msg),
+        )
+        span.keyval("oid", msg.oid)
+        span.keyval("reqid", lambda: msg.reqid.key())
+        span.event("queued")
 
         def run() -> None:
             self.op_tracker.mark_event(token, "dequeued")
-            self._do_dispatch_op(conn, msg, token)
+            span.event("dequeued")
+            with tracer_mod.span_scope(span):
+                self._do_dispatch_op(conn, msg, token, span=span, cost=cost)
 
         self.sched.enqueue(
             WorkItem(run=run, klass=SchedClass.CLIENT, cost=cost)
@@ -551,13 +595,25 @@ class OSD(Dispatcher):
         self._sched_kick.set()
 
     def _do_dispatch_op(
-        self, conn: Connection, msg: MOSDOp, token: int = 0
+        self, conn: Connection, msg: MOSDOp, token: int = 0, span=None,
+        cost: int | None = None,
     ) -> None:
         """dequeue_op (OSD.cc:9491) → PG::do_op."""
+        from ..common.tracer import null_span
+
         pg = self._get_pg(msg.pgid)
+        op_span = span if span is not None else null_span()
+        t0 = time.monotonic()
+        if cost is None:
+            cost = sum(len(op.data) for op in msg.ops) or 4096
 
         def reply(rep: MOSDOpReply) -> None:
             self.op_tracker.finish(token)
+            lat = time.monotonic() - t0
+            self.perf.hinc("op_latency", lat)
+            self.perf.hinc2("op_size_latency", cost, lat)
+            op_span.event("reply sent")
+            op_span.finish()
 
             async def _send():
                 try:
@@ -583,12 +639,15 @@ class OSD(Dispatcher):
         for op in msg.ops:
             if op.data:
                 self.perf.inc("op_in_bytes", len(op.data))
+        op_span.event("reached_pg")
         try:
             pg.do_op(msg, reply, conn)
         except Exception:
             # a faulting op handler must not leak its tracker entry (the
             # reply closure, the only finish() site, will never run)
             self.op_tracker.finish(token)
+            op_span.event("op handler raised")
+            op_span.finish()
             raise
 
     async def _op_worker(self) -> None:
@@ -848,6 +907,7 @@ def _osd_status(osd: "OSD") -> dict:
     pool_bytes: dict[str, int] = {}
     pool_stored: dict[str, int] = {}
     pool_heads: dict[str, int] = {}
+    slow_count, slow_oldest = osd.op_tracker.slow_ops()
     for pg in osd.pgs.values():
         pid = str(pg.pool.id)
         pool_objects[pid] = pool_objects.get(pid, 0) + pg.local_object_count()
@@ -874,4 +934,7 @@ def _osd_status(osd: "OSD") -> dict:
         "pool_bytes": pool_bytes,
         "pool_stored": pool_stored,
         "pool_heads": pool_heads,
+        # in-flight ops older than osd_op_complaint_time (OpTracker) —
+        # aggregated by the mgr into the digest that raises SLOW_OPS
+        "slow_ops": {"count": slow_count, "oldest_sec": slow_oldest},
     }

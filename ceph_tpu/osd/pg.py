@@ -335,6 +335,17 @@ class PG(PGListener):
             t = NULL_TRACER
         return t
 
+    def perf_hist(self, name: str, value: float) -> None:
+        """EC stage latency -> the OSD's PerfHistogram counters
+        (ec_encode_latency / ec_decode_latency)."""
+        perf = getattr(self.osd, "perf", None)
+        if perf is None:
+            return
+        try:
+            perf.hinc(name, value)
+        except (KeyError, AttributeError):
+            pass  # harness OSD without the histogram declared
+
     def whoami_shard(self) -> int:
         if self.pool.type != POOL_TYPE_ERASURE:
             return -1

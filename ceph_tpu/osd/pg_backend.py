@@ -89,6 +89,10 @@ class PGListener(abc.ABC):
     def clog_error(self, msg: str) -> None:
         pass
 
+    def perf_hist(self, name: str, value: float) -> None:
+        """Sample a daemon latency histogram (PGs forward to the OSD's
+        PerfCounters; standalone harnesses drop the sample)."""
+
 
 def side_effect_log_entries(listener: PGListener, pgt) -> list:
     """PG-log entries for a transaction's side-effect objects: the snap

@@ -237,7 +237,10 @@ class ObjectGateway:
     ) -> None:
         if status not in ("Enabled", "Suspended"):
             raise RgwError(EINVAL, "IllegalVersioningConfigurationException", status)
-        await self._require_access(bucket, actor, "WRITE")
+        # S3 PutBucketVersioning is a bucket-configuration change: owner /
+        # FULL_CONTROL only, like set_lifecycle — a WRITE (object upload)
+        # grant must not be able to flip versioning off
+        await self._require_access(bucket, actor, "FULL_CONTROL")
         buckets = await self._load(BUCKETS_OID)
         buckets[bucket]["versioning"] = status
         await self._store(BUCKETS_OID, buckets)

@@ -88,6 +88,12 @@ class PendingEncode:
         self._k, self._m = k, m
         self._want = want
         self._result: dict[int, np.ndarray] | None = None
+        # the span active at LAUNCH time (codec/tracing.py active_span);
+        # the reap may run from an event-loop callback with no scope, so
+        # the D2H side must remember where it belongs in the trace
+        from ..codec.tracing import active_span
+
+        self._span = active_span()
 
     def ready(self) -> bool:
         if self._result is not None:
@@ -97,7 +103,12 @@ class PendingEncode:
 
     def result(self) -> dict[int, np.ndarray]:
         if self._result is None:
-            parity = np.asarray(self._parity)  # blocks until launch done
+            if self._span is not None and self._parity is not None:
+                with self._span.child("kernel_wait+d2h"):
+                    parity = np.asarray(self._parity)
+                self._span = None
+            else:
+                parity = np.asarray(self._parity)  # blocks until launch done
             out: dict[int, np.ndarray] = {}
             for i in range(self._k):
                 out[i] = np.ascontiguousarray(self._shaped[:, i, :]).reshape(-1)
@@ -197,7 +208,15 @@ def decode_concat(
             if any(i not in have for i in idx):
                 raise EcError(EIO, f"missing survivor shards {idx}")
             survivors = np.stack([have[i] for i in idx], axis=1)  # (S, k, cs)
-            rec = np.asarray(ec.decode_array(erasures, survivors))
+            from ..codec.tracing import active_span
+
+            parent = active_span()
+            rec_dev = ec.decode_array(erasures, survivors)
+            if parent is not None:
+                with parent.child("kernel_wait+d2h"):
+                    rec = np.asarray(rec_dev)
+            else:
+                rec = np.asarray(rec_dev)
             for p, e in enumerate(erasures):
                 if e < k:
                     data[:, e, :] = rec[:, p, :]

@@ -110,6 +110,10 @@ def build_cmd(words: list[str]) -> dict:
             elif prefix == "fs rm":
                 if rest:
                     cmd["fs_name"] = rest[0]
+            elif prefix == "health":
+                # `ceph health detail`: per-daemon breakdown of each check
+                if rest and rest[0] == "detail":
+                    cmd["detail"] = True
             elif prefix.startswith("osd erasure-code-profile"):
                 if rest:
                     cmd["name"] = rest[0]

@@ -1,0 +1,194 @@
+"""Metrics lint — exposition well-formedness for the mgr's prometheus
+module (the CI satellite of ISSUE 1).
+
+Scrapes `PrometheusModule.scrape()` from a running toy cluster and
+validates the text-format contract a real Prometheus server (and
+`promtool check metrics`) enforces: every family announced exactly once
+with HELP + TYPE before its samples, no duplicate families, and
+histogram families carrying monotonically non-decreasing cumulative
+`le` buckets ending at +Inf with consistent `_sum`/`_count`.
+"""
+
+import asyncio
+import re
+
+import pytest
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(?P<labels>[^}]*)\})? (?P<value>\S+)$'
+)
+
+
+def lint_exposition(text: str) -> dict:
+    """Parse and validate an exposition payload; returns
+    {family: {"type", "help", "samples": [(name, labels, value)]}}.
+    Raises AssertionError on any contract violation."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families: dict[str, dict] = {}
+    current = None  # family the last HELP/TYPE block opened
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            assert name not in families, f"line {lineno}: duplicate family {name}"
+            families[name] = {"type": None, "help": help_, "samples": []}
+            current = name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, ftype = rest.partition(" ")
+            assert name == current, (
+                f"line {lineno}: TYPE for {name} outside its HELP block"
+            )
+            assert families[name]["type"] is None, (
+                f"line {lineno}: duplicate TYPE for {name}"
+            )
+            assert ftype in ("counter", "gauge", "histogram", "summary", "untyped")
+            families[name]["type"] = ftype
+            continue
+        assert not line.startswith("#"), f"line {lineno}: unknown comment {line!r}"
+        m = _SAMPLE.match(line)
+        assert m, f"line {lineno}: malformed sample {line!r}"
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stripped = name.removesuffix(suffix)
+            if stripped != name and stripped in families and families[
+                stripped
+            ]["type"] == "histogram":
+                base = stripped
+                break
+        assert base in families, f"line {lineno}: sample {name} has no HELP/TYPE"
+        assert base == current, (
+            f"line {lineno}: sample {name} outside family {current} block"
+        )
+        float(m.group("value"))  # every value parses as a number
+        labels = {}
+        for part in (m.group("labels") or "").split(","):
+            if part:
+                k, _, v = part.partition("=")
+                labels[k] = v.strip('"')
+        families[base]["samples"].append((name, labels, float(m.group("value"))))
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"family {name} has HELP but no TYPE"
+        assert fam["help"].strip(), f"family {name} has empty HELP"
+        if fam["type"] == "histogram":
+            _check_histogram(name, fam["samples"])
+    return families
+
+
+def _check_histogram(name: str, samples: list) -> None:
+    """Per label-set (minus `le`): buckets cumulative and non-decreasing,
+    +Inf last, and _count == the +Inf bucket."""
+    series: dict[tuple, dict] = {}
+    for sname, labels, value in samples:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        rec = series.setdefault(key, {"buckets": [], "sum": None, "count": None})
+        if sname == f"{name}_bucket":
+            assert "le" in labels, f"{name}: bucket sample without le"
+            le = float("inf") if labels["le"] == "+Inf" else float(labels["le"])
+            rec["buckets"].append((le, value))
+        elif sname == f"{name}_sum":
+            rec["sum"] = value
+        elif sname == f"{name}_count":
+            rec["count"] = value
+    for key, rec in series.items():
+        assert rec["buckets"], f"{name}{dict(key)}: histogram without buckets"
+        les = [le for le, _ in rec["buckets"]]
+        assert les == sorted(les), f"{name}{dict(key)}: le bounds not sorted"
+        assert les[-1] == float("inf"), f"{name}{dict(key)}: missing +Inf bucket"
+        counts = [c for _, c in rec["buckets"]]
+        assert counts == sorted(counts), (
+            f"{name}{dict(key)}: cumulative bucket counts decrease"
+        )
+        assert rec["sum"] is not None and rec["count"] is not None, (
+            f"{name}{dict(key)}: missing _sum/_count"
+        )
+        assert rec["count"] == counts[-1], (
+            f"{name}{dict(key)}: _count != +Inf bucket"
+        )
+
+
+class TestLintHelper:
+    """The linter itself must catch the failure modes it exists for."""
+
+    def test_accepts_wellformed_histogram(self):
+        text = (
+            "# HELP h latency\n# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 3\n'
+            "h_sum 1.5\nh_count 3\n"
+        )
+        fam = lint_exposition(text)
+        assert fam["h"]["type"] == "histogram"
+
+    @pytest.mark.parametrize(
+        "text,why",
+        [
+            ("m 1\n", "sample without HELP/TYPE"),
+            ("# HELP m x\n# TYPE m gauge\n# HELP m x\n# TYPE m gauge\nm 1\n",
+             "duplicate family"),
+            ("# HELP h x\n# TYPE h histogram\n"
+             'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\nh_sum 1\nh_count 3\n',
+             "decreasing cumulative buckets"),
+            ("# HELP h x\n# TYPE h histogram\n"
+             'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n',
+             "missing +Inf bucket"),
+        ],
+    )
+    def test_rejects_malformed(self, text, why):
+        with pytest.raises(AssertionError):
+            lint_exposition(text)
+
+
+class TestClusterScrapeLint:
+    def test_scrape_from_toy_cluster_is_wellformed(self):
+        """Boot mon+OSDs+mgr, drive a few ops, and lint the full scrape:
+        the histogram families (op_latency et al.) must be real Prometheus
+        histograms and every family well-announced."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.mgr import Mgr
+            from ceph_tpu.mgr.prometheus import PrometheusModule
+
+            from test_cluster import start_cluster, stop_cluster, wait_until
+
+            monmap, mons, osds = await start_cluster(1, 2)
+            mgr = Mgr("x", monmap)
+            mgr.beacon_interval = 0.1
+            await mgr.start()
+            await mgr.wait_for_active()
+            prom = PrometheusModule()
+            mgr.register_module(prom)
+
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("lintp", "replicated", size=2, pg_num=2)
+            io = await client.open_ioctx("lintp")
+            for i in range(4):
+                await io.write_full(f"o{i}", b"x" * 4096)
+
+            def histograms_reported():
+                return "op_latency" in prom.scrape()
+
+            await wait_until(
+                histograms_reported, 5.0, "op_latency histogram in scrape"
+            )
+            families = lint_exposition(prom.scrape())
+
+            # the tentpole's promised families are present and typed right
+            assert families["ceph_tpu_op_latency"]["type"] == "histogram"
+            assert families["ceph_tpu_osd_up"]["type"] == "gauge"
+            assert families["ceph_tpu_healthcheck"]["type"] == "gauge"
+            # a daemon that sampled ops has a non-empty latency series
+            op_lat = families["ceph_tpu_op_latency"]["samples"]
+            assert any(n == "ceph_tpu_op_latency_count" and v > 0
+                       for n, _, v in op_lat)
+
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
