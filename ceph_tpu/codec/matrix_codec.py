@@ -20,7 +20,11 @@ from typing import Mapping
 
 import jax.numpy as jnp
 import numpy as np
-from jax.core import Tracer as _JaxTracer
+
+try:  # jax.core.Tracer is being removed from the public surface (jax >= 0.6)
+    from jax.core import Tracer as _JaxTracer
+except (ImportError, AttributeError):
+    from jax._src.core import Tracer as _JaxTracer
 
 from ceph_tpu.gf import expand_matrix, isa_decode_matrix
 from ceph_tpu.ops.pallas_gf import CodingPlan

@@ -24,6 +24,8 @@ original.
 
 from __future__ import annotations
 
+import contextlib
+
 from ..common import tracer as tracer_mod
 
 
@@ -32,6 +34,17 @@ def active_span():
     children the dump never shows — skip the bookkeeping entirely)."""
     sp = tracer_mod.current_span()
     return sp if sp is not None and sp.recorded else None
+
+
+def wait_span(parent):
+    """Context manager for the reap side of an async launch: times the
+    kernel wait + device→host copy as a `kernel_wait+d2h` child of
+    `parent`, or a no-op when the launch wasn't traced.  One name for
+    both the encode reap (PendingEncode.result) and the decode reap
+    (decode_concat) so trace tooling can match a single span name."""
+    if parent is None:
+        return contextlib.nullcontext()
+    return parent.child("kernel_wait+d2h")
 
 
 def instrument_codec(ec, plugin: str):

@@ -20,7 +20,7 @@ def slow_ops_summary(slow: dict[str, dict]) -> str | None:
     oldest = max(v.get("oldest_sec", 0.0) for v in slow.values())
     return (
         f"{total} slow ops, oldest one blocked for {oldest:.0f} sec, "
-        f"daemons {sorted(slow)} have slow ops."
+        f"daemons [{','.join(sorted(slow))}] have slow ops."
     )
 
 

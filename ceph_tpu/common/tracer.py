@@ -195,7 +195,10 @@ def current_span() -> Span | None:
 @contextlib.contextmanager
 def span_scope(span: Span | None):
     """Make `span` the current span for the duration of the block (the
-    otel Scope analog).  Does NOT finish the span."""
+    otel Scope analog).  Does NOT finish the span.  Unrecorded spans are
+    fine here: consumers filter on `.recorded` (codec/tracing.active_span)
+    or inherit unrecordedness through start_span, so callers need no
+    `if span.recorded` guard."""
     token = _CURRENT.set(span)
     try:
         yield span

@@ -412,7 +412,7 @@ class ECBackend(PGBackend):
         # scope the launch under ec:write so codec h2d/kernel_launch
         # sub-spans (codec/tracing.py) and the PendingEncode's reap span
         # attach to this op's trace
-        with tracer_mod.span_scope(op.trace if op.trace.recorded else None):
+        with tracer_mod.span_scope(op.trace):
             op.encode_stage = launch_encode(
                 op.pgt,
                 op.plan,
@@ -488,7 +488,7 @@ class ECBackend(PGBackend):
             hinfo = self.get_hash_info(op.pgt.oid)
         # the reap may run from a bare event-loop callback (_drain_encode_pipe):
         # re-enter the op's span scope so materialization sub-spans attach
-        with tracer_mod.span_scope(op.trace if op.trace.recorded else None):
+        with tracer_mod.span_scope(op.trace):
             txns, new_hinfo, merged = finish_transactions(
                 op.encode_stage,
                 op.pgt,
@@ -869,11 +869,11 @@ class ECBackend(PGBackend):
             with rop.trace.child("ec:reconstruct") as sp:
                 sp.keyval("have", ",".join(map(str, sorted(good))))
                 sp.keyval("want", ",".join(map(str, sorted(rop.want))))
-                with tracer_mod.span_scope(sp if sp.recorded else None):
+                with tracer_mod.span_scope(sp):
                     reconstruct_all()
             self._perf_hist("ec_decode_latency", time.monotonic() - t0)
         else:
-            with tracer_mod.span_scope(rop.trace if rop.trace.recorded else None):
+            with tracer_mod.span_scope(rop.trace):
                 reconstruct_all()
         rop.trace.event("read complete")
         rop.trace.finish()
@@ -1007,7 +1007,7 @@ class ECBackend(PGBackend):
                     for s in want:
                         rebuilt[s] += np.asarray(decoded[s]).tobytes()
             else:
-                with tracer_mod.span_scope(rec.trace if rec.trace.recorded else None):
+                with tracer_mod.span_scope(rec.trace):
                     decoded = stripe_mod.decode_shards(self.sinfo, self.ec, have, want)
                 rebuilt = {s: np.asarray(decoded[s]).tobytes() for s in want}
             self._perf_hist("ec_decode_latency", time.monotonic() - t0)

@@ -28,9 +28,11 @@ from __future__ import annotations
 import asyncio
 import time
 
+from ..common import tracer as tracer_mod
 from ..common.config import Config
 from ..common.log import dout
 from ..common.perf_counters import PerfCountersBuilder
+from ..common.tracer import Tracer, null_span
 from ..mon.client import MonClient
 from ..mon.monmap import MonMap
 from ..msg.message import Message
@@ -180,8 +182,6 @@ class OSD(Dispatcher):
         # span tracer threaded through the EC data path (common/tracer.py;
         # the reference's ZTracer/jaeger integration, dumped via the admin
         # socket's `dump_tracer`)
-        from ..common.tracer import Tracer
-
         self.tracer = Tracer(
             f"osd.{whoami}", enabled=self.conf.get("jaeger_tracing_enable")
         )
@@ -560,8 +560,6 @@ class OSD(Dispatcher):
 
     def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
         """enqueue_op (OSD.cc:9431): into the QoS scheduler."""
-        from ..common import tracer as tracer_mod
-
         cost = sum(len(op.data) for op in msg.ops) or 4096
         self.perf.inc("op")
         # OpTracker registration (OpRequest created at dispatch,
@@ -599,8 +597,6 @@ class OSD(Dispatcher):
         cost: int | None = None,
     ) -> None:
         """dequeue_op (OSD.cc:9491) → PG::do_op."""
-        from ..common.tracer import null_span
-
         pg = self._get_pg(msg.pgid)
         op_span = span if span is not None else null_span()
         t0 = time.monotonic()

@@ -103,12 +103,11 @@ class PendingEncode:
 
     def result(self) -> dict[int, np.ndarray]:
         if self._result is None:
-            if self._span is not None and self._parity is not None:
-                with self._span.child("kernel_wait+d2h"):
-                    parity = np.asarray(self._parity)
-                self._span = None
-            else:
+            from ..codec.tracing import wait_span
+
+            with wait_span(self._span):
                 parity = np.asarray(self._parity)  # blocks until launch done
+            self._span = None
             out: dict[int, np.ndarray] = {}
             for i in range(self._k):
                 out[i] = np.ascontiguousarray(self._shaped[:, i, :]).reshape(-1)
@@ -208,14 +207,10 @@ def decode_concat(
             if any(i not in have for i in idx):
                 raise EcError(EIO, f"missing survivor shards {idx}")
             survivors = np.stack([have[i] for i in idx], axis=1)  # (S, k, cs)
-            from ..codec.tracing import active_span
+            from ..codec.tracing import active_span, wait_span
 
-            parent = active_span()
             rec_dev = ec.decode_array(erasures, survivors)
-            if parent is not None:
-                with parent.child("kernel_wait+d2h"):
-                    rec = np.asarray(rec_dev)
-            else:
+            with wait_span(active_span()):
                 rec = np.asarray(rec_dev)
             for p, e in enumerate(erasures):
                 if e < k:
