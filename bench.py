@@ -46,10 +46,53 @@ NORTH_STAR_GBPS = 40.0
 TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_TIMEOUT", "240"))
 CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_TIMEOUT", "300"))
 TPU_RETRIES = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
+# Staged child warm-up: each early stage (jax import, backend init, tiny
+# compile probe) gets its own watchdog allowance, so a wedged backend
+# fails in tens of seconds (rc=5, attributable stage in stderr) instead
+# of silently eating the whole child deadline.
+STAGE_TIMEOUT_S = float(os.environ.get("BENCH_STAGE_TIMEOUT", "60"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
 
 
 def _log(msg: str) -> None:
     print(f"[bench] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr, flush=True)
+
+
+class _StageWatchdog:
+    """Child-side watchdog over the warm-up stages.  A stage that
+    overruns its allowance hard-exits the child with rc=5 (the parent
+    treats that like a deadline: a hang will hang again, don't retry)."""
+
+    def __init__(self, clog):
+        import threading
+
+        self._clog = clog
+        self._stage = None
+        self._deadline = None
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def stage(self, name: str, timeout_s: float) -> None:
+        with self._lock:
+            self._stage = name
+            self._deadline = time.monotonic() + timeout_s
+        self._clog(f"stage: {name} (allowance {timeout_s:.0f}s)")
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._stage = None
+            self._deadline = None
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(1.0)
+            with self._lock:
+                stage, deadline = self._stage, self._deadline
+            if deadline is not None and time.monotonic() > deadline:
+                self._clog(f"WATCHDOG: stage '{stage}' overran its allowance")
+                sys.stderr.flush()
+                os._exit(5)
 
 
 def run_child(platform: str) -> None:
@@ -65,6 +108,8 @@ def run_child(platform: str) -> None:
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
 
+    watchdog = _StageWatchdog(clog)
+    watchdog.stage("import_jax", STAGE_TIMEOUT_S)
     clog("importing jax")
     import functools
 
@@ -76,6 +121,7 @@ def run_child(platform: str) -> None:
     import jax.numpy as jnp
     import numpy as np
 
+    watchdog.stage("backend_init", STAGE_TIMEOUT_S)
     clog("initializing backend (jax.devices())")
     dev = jax.devices()[0]
     got = dev.platform
@@ -104,8 +150,12 @@ def run_child(platform: str) -> None:
         env_batch = 256
     if env_batch <= 0:
         env_batch = 256
-    batch_candidates = (env_batch,) if on_tpu else (2,)
-    iters = 40 if on_tpu else 3
+    # CPU fallback: deep batching matters here too — at batch=2 the
+    # serial chain is dominated by per-step dispatch/update overhead
+    # (~0.15 GB/s); batch=8 amortizes it (~1.8 GB/s measured with the
+    # packed-plane kernel) while keeping the child well inside deadline.
+    batch_candidates = (env_batch,) if on_tpu else (8,)
+    iters = 40 if on_tpu else 8
 
     # The SHIPPING path: the registered `tpu` plugin's device encode — the
     # same dispatch encode_chunks uses (on TPU backends the cached
@@ -116,6 +166,24 @@ def run_child(platform: str) -> None:
     rng = np.random.default_rng(0)
     gfm = isa_rs_vandermonde_matrix(k, m)[k:]
     parity_checked = False
+
+    # Tiny-batch compile probe BEFORE the tuned batch: exercises the whole
+    # backend/compile/dispatch chain on a seconds-scale shape, so a wedged
+    # backend trips the probe watchdog instead of hanging inside the big
+    # (minutes-scale on a cold remote-compile path) tuned compile.
+    watchdog.stage("warmup_probe", PROBE_TIMEOUT_S)
+    t_probe = time.perf_counter()
+    # 64 KiB: the smallest shape that takes the bulk kernel path (packed
+    # plane / Pallas), so the probe compiles the same kernel family the
+    # tuned batch will
+    probe_in = rng.integers(0, 256, (1, k, 8192), dtype=np.uint8)
+    probe_par = np.asarray(encode_fn(probe_in))
+    if not np.array_equal(probe_par[0], gf_matmul(gfm, probe_in[0])):
+        clog("PROBE PARITY MISMATCH vs host oracle")
+        sys.exit(4)
+    probe_s = time.perf_counter() - t_probe
+    clog(f"warm-up probe OK ({probe_s:.2f}s)")
+    watchdog.disarm()
 
     # Serial-chain methodology: each launch's input depends on the previous
     # launch's parity (a 128-byte patch, updated in place via donation), so
@@ -184,7 +252,49 @@ def run_child(platform: str) -> None:
     clog(f"measuring: batch={batch} iters={iters}")
     gbps = run_chain(batch, iters)
     clog(f"done: {gbps:.3f} GB/s at batch={batch}")
-    result = {"platform": got, "gbps": gbps, "batch": batch, "parity_ok": True}
+
+    # Per-stage breakdown (one un-chained encode, stages serialized with
+    # block_until_ready): attributes the headline to H2D staging, kernel,
+    # or D2H readback instead of a single number.  On TPU this reuses the
+    # PROBE shape — already compiled during warm-up — because a fresh
+    # standalone compile at the tuned geometry (~30 s through the remote
+    # compiler) after the measurement could blow the child deadline and
+    # discard a perfectly good result; on CPU compiles are cheap, so the
+    # breakdown runs at the measured geometry.  Guarded: losing the
+    # breakdown must never lose the headline.
+    stages = None
+    try:
+        stage_shape = (1, k, 8192) if on_tpu else (batch, k, chunk)
+        clog(f"sampling per-stage breakdown (h2d/kernel/d2h) at {stage_shape}")
+        stage_in = rng.integers(0, 256, stage_shape, dtype=np.uint8)
+        # warm the standalone-encode compile at this shape (the measured
+        # chain compiled it fused inside `step`) so it is steady-state
+        jax.block_until_ready(encode_fn(jax.device_put(stage_in)))
+        t0 = time.perf_counter()
+        stage_dev = jax.block_until_ready(jax.device_put(stage_in))
+        t1 = time.perf_counter()
+        stage_par = jax.block_until_ready(encode_fn(stage_dev))
+        t2 = time.perf_counter()
+        _ = np.asarray(stage_par)
+        t3 = time.perf_counter()
+        stages = {
+            "h2d_s": round(t1 - t0, 6),
+            "kernel_s": round(t2 - t1, 6),
+            "d2h_s": round(t3 - t2, 6),
+            "shape": list(stage_shape),
+        }
+        clog(f"stages: {stages}")
+    except Exception as e:  # headline survives a failed breakdown
+        clog(f"stage breakdown failed: {e!r}")
+    result = {
+        "platform": got,
+        "gbps": gbps,
+        "batch": batch,
+        "parity_ok": True,
+        "probe_s": round(probe_s, 3),
+    }
+    if stages is not None:
+        result["stages"] = stages
     if os.environ.get("BENCH_TRACE"):
         # One traced encode OUTSIDE the measured loop (BENCH_TRACE=1):
         # per-stage spans (h2d / kernel_launch / kernel_wait+d2h from
@@ -292,6 +402,10 @@ def main() -> None:
             break  # a hang will hang again; don't burn another deadline
         if "rc=3" in err:
             break  # no TPU on this host — deterministic, retry can't help
+        if "rc=4" in err:
+            break  # parity mismatch is deterministic too — fall back
+        if "rc=5" in err:
+            break  # stage watchdog caught a backend hang — same story
         if attempt < TPU_RETRIES:
             time.sleep(10)
 
@@ -322,6 +436,10 @@ def main() -> None:
         "vs_baseline": round(gbps / NORTH_STAR_GBPS, 4),
         "platform": result["platform"],
     }
+    if "stages" in result:
+        out["stages"] = result["stages"]
+    if "probe_s" in result:
+        out["probe_s"] = result["probe_s"]
     if tpu_error:
         out["tpu_error"] = tpu_error
     if "trace" in result:

@@ -27,6 +27,8 @@ except (ImportError, AttributeError):
     from jax._src.core import Tracer as _JaxTracer
 
 from ceph_tpu.gf import expand_matrix, isa_decode_matrix
+from ceph_tpu.ops.dispatch import record_launch
+from ceph_tpu.ops.packed_gf import PACKED_MIN_BYTES, PackedPlan
 from ceph_tpu.ops.pallas_gf import CodingPlan
 from ceph_tpu.ops.xor_mm import xor_matmul, xor_reduce
 
@@ -62,23 +64,35 @@ def _on_tpu() -> bool:
 
 class _DeviceCoder:
     """One cached coding operator: the fused Pallas kernel on TPU for
-    lane-aligned chunks, the jnp bitsliced matmul everywhere else.
+    lane-aligned chunks, the packed-bitplane jnp kernel for bulk work
+    everywhere else, the bitsliced matmul for tiny one-off matrices.
 
     This is the dispatch the reference does by linking `ec_encode_data` to
     the best SIMD flavor at plugin load (isa/ErasureCodeIsa.cc:83-91): the
     production `encode_chunks`/`decode_chunks` path and the bulk device path
     both land on the fast kernel — the benchmark measures what ships.
+
+    The small-input cutoff exists because the packed plan bakes its XOR
+    schedule into the compiled program (one compile per matrix), while
+    xor_matmul takes the bit-matrix as a runtime operand (one compile per
+    shape, any matrix): decode paths that invert a fresh matrix per
+    erasure pattern on small chunks stay on the shared kernel.
     """
 
-    __slots__ = ("bm", "plan")
+    __slots__ = ("bm", "plan", "packed")
 
-    def __init__(self, bm: jnp.ndarray, plan: CodingPlan | None):
+    def __init__(self, bm: jnp.ndarray, plan: CodingPlan | None, packed: PackedPlan):
         self.bm = bm
         self.plan = plan
+        self.packed = packed
 
-    def __call__(self, data: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, data: jnp.ndarray, out=None) -> jnp.ndarray:
         if self.plan is not None and data.shape[-1] % 128 == 0:
             return self.plan(data)
+        if int(np.prod(data.shape)) >= PACKED_MIN_BYTES:
+            return self.packed(data, out=out)
+        lead = data.shape[:-2]
+        record_launch(int(np.prod(lead)) if lead else 1, int(np.prod(data.shape)))
         return xor_matmul(self.bm, data)
 
 
@@ -95,10 +109,25 @@ class _GlobalPlanCache:
             OrderedDict()
         )
         self._decode_coders: OrderedDict[tuple, _DeviceCoder] = OrderedDict()
+        # coder lookup hit/miss totals; the perf-smoke tier-1 test asserts
+        # a steady-state hit rate so a regression to per-call plan builds
+        # fails fast instead of only dilating the bench number
+        self._hits = 0
+        self._misses = 0
 
     def _make_coder(self, gf_rows: np.ndarray, bm: jnp.ndarray) -> _DeviceCoder:
         plan = CodingPlan(gf_rows) if _on_tpu() else None
-        return _DeviceCoder(bm, plan)
+        return _DeviceCoder(bm, plan, PackedPlan(gf_rows))
+
+    def stats(self) -> dict[str, int]:
+        """Coder-cache hit/miss totals (encode + decode lookups)."""
+        with self._lock:
+            return {"hits": self._hits, "misses": self._misses}
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self._hits = 0
+            self._misses = 0
 
     def _lru_put_coder(self, key, coder: _DeviceCoder) -> None:
         self._decode_coders[key] = coder
@@ -126,6 +155,10 @@ class _GlobalPlanCache:
         key = (coding_rows.shape, coding_rows.tobytes())
         with self._lock:
             coder = self._encode_coders.get(key)
+            if coder is not None:
+                self._hits += 1
+            else:
+                self._misses += 1
         if coder is not None:
             return coder
         coder = self._make_coder(coding_rows, self.encode_bit_matrix(coding_rows))
@@ -141,8 +174,10 @@ class _GlobalPlanCache:
         with self._lock:
             coder = self._decode_coders.get(key)
             if coder is not None:
+                self._hits += 1
                 self._decode_coders.move_to_end(key)
                 return coder
+            self._misses += 1
         coder = self._make_coder(matrix, self.lru_bit_matrix(matrix))
         if _trace_local(coder.bm):
             return coder
@@ -277,8 +312,10 @@ class _GlobalPlanCache:
         with self._lock:
             coder = self._decode_coders.get(key)
             if coder is not None:
+                self._hits += 1
                 self._decode_coders.move_to_end(key)
                 return coder, decode_index
+            self._misses += 1
         coder = self._make_coder(c, bitmat)  # built outside the lock
         if _trace_local(coder.bm):
             return coder, decode_index
@@ -288,6 +325,319 @@ class _GlobalPlanCache:
 
 
 PLAN_CACHE = _GlobalPlanCache()
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (max(1, n) - 1).bit_length()
+
+
+class AggTicket:
+    """One submitted stripe-batch encode awaiting an aggregated launch.
+
+    Resolves to this submission's (stripes, m, L) parity.  Duck-types the
+    surface PendingEncode expects of a live device array: `is_ready()` for
+    non-blocking polls and `__array__` for materialization (np.asarray on
+    a ticket forces its group's launch and blocks until it finishes)."""
+
+    __slots__ = ("_agg", "_group", "_start", "_stripes", "_value")
+
+    def __init__(self, agg: "EncodeAggregator", group: "_AggGroup", start: int, stripes: int):
+        self._agg = agg
+        self._group = group
+        self._start = start
+        self._stripes = stripes
+        self._value: np.ndarray | None = None
+
+    @property
+    def launched(self) -> bool:
+        if self._value is not None:
+            return True
+        g = self._group
+        return g.host is not None or g.parity is not None or g.error is not None
+
+    def is_ready(self) -> bool:
+        if self._value is not None:
+            return True
+        g = self._group
+        if g.host is not None or g.error is not None:
+            return True  # a failed launch is "ready": the reap reports it
+        if g.parity is None:
+            return False  # still windowed; a flush will launch it
+        ready = getattr(g.parity, "is_ready", None)
+        return True if ready is None else bool(ready())
+
+    def result(self) -> np.ndarray:
+        if self._value is None:
+            self._agg._materialize(self)
+        return self._value
+
+    def __array__(self, dtype=None, copy=None):
+        out = self.result()
+        return out if dtype is None else out.astype(dtype)
+
+
+class _AggGroup:
+    """Pending submissions sharing one (matrix, chunk-length) geometry —
+    the unit that concatenates into a single padded device launch."""
+
+    __slots__ = (
+        "key", "ec", "arrays", "tickets", "stripes", "nbytes",
+        "parity", "host", "pad", "error", "donatable", "lock",
+    )
+
+    def __init__(self, key, ec):
+        self.key = key
+        self.ec = ec
+        self.arrays: list[np.ndarray] = []
+        self.tickets: list[AggTicket] = []
+        self.stripes = 0
+        self.nbytes = 0
+        self.parity = None  # live device array once launched
+        self.host: np.ndarray | None = None  # materialized parity
+        self.pad = 0
+        self.error: BaseException | None = None  # a failed launch, sticky
+        self.donatable = False  # launch path can reuse a donated buffer
+        # serializes THIS group's launch/materialization (the encode
+        # dispatch + blocking device wait) without stalling the
+        # aggregator-wide lock; RLock because a reap-forced launch runs
+        # inside the reap's own hold
+        self.lock = threading.RLock()
+
+
+class EncodeAggregator:
+    """Cross-write launch aggregation: coalesce concurrent small stripe
+    encodes (different ops, PGs, objects) into one padded device launch.
+
+    The storage-side analog of a training stack's bucketed all-reduce:
+    per-op launches under ~1 MiB are dominated by dispatch overhead, so
+    submissions queue in per-geometry groups and launch together when the
+    window fills (`ec_tpu_aggregate_window` submissions), the byte budget
+    trips (`ec_tpu_aggregate_max_bytes`), or a barrier drains the window
+    (ECBackend.flush_encodes — the commit barrier — or any ticket reap).
+    window <= 1 launches every submission immediately (aggregation off,
+    metrics still recorded).
+
+    In aggregating mode, stripe counts are padded to a bounded bucket set
+    (power of two up to 64, then multiples of 64 — capped waste, unlike
+    pure pow2) so the jit cache sees few geometries and the donation pool
+    can recycle parity buffers across launches (see docs/PERFORMANCE.md
+    for the donation caveats).  Tickets slice their own stripes back out,
+    in submission order.
+
+    Occupancy and launch-size distributions are PerfHistograms on
+    `self.perf`, exportable through the PR-1 prometheus layer
+    (PerfCountersCollection.add(agg.perf))."""
+
+    def __init__(self, window: int = 0, max_bytes: int = 64 << 20, pad_pow2: bool = True):
+        from ceph_tpu.common.perf_counters import PerfCountersBuilder
+
+        self.window = int(window)
+        self.max_bytes = int(max_bytes)
+        self.pad_pow2 = pad_pow2
+        # RLock: a reap (`_materialize`) forces its group's launch from
+        # inside the lock; lockdep's DebugLock is not reentrant
+        self._lock = threading.RLock()
+        self._groups: "OrderedDict[tuple, _AggGroup]" = OrderedDict()
+        self._donate_pool: dict[tuple, object] = {}  # shape -> dead parity buf
+        b = PerfCountersBuilder("ec_aggregator")
+        for c in ("submits", "launches", "flush_window", "flush_bytes",
+                  "flush_explicit", "flush_immediate", "flush_reap",
+                  "pad_stripes"):
+            b.add_u64_counter(c)
+        b.add_histogram("stripes_per_launch",
+                        "stripe-batch occupancy of each device launch",
+                        lowest=1, buckets=14)
+        b.add_histogram("tickets_per_launch",
+                        "submissions coalesced into each device launch",
+                        lowest=1, buckets=8)
+        b.add_histogram("launch_bytes",
+                        "input bytes per device launch",
+                        lowest=4096, buckets=18)
+        self.perf = b.create_perf_counters()
+
+    def configure(self, window: int | None = None, max_bytes: int | None = None) -> None:
+        """Apply live config (the OSD wires its Config + runtime observers
+        here, so `ec_tpu_aggregate_*` settings reach the shared instance)."""
+        if window is not None:
+            self.window = int(window)
+        if max_bytes is not None:
+            self.max_bytes = int(max_bytes)
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, ec: "MatrixCodecMixin", shaped: np.ndarray) -> AggTicket:
+        """Queue one (stripes, k, L) uint8 encode; returns its ticket.
+        May launch (this or earlier submissions) when a threshold trips."""
+        stripes, _k, L = shaped.shape
+        key = (ec.distribution_matrix().tobytes(), L)
+        reason = None
+        with self._lock:
+            self.perf.inc("submits")
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = _AggGroup(key, ec)
+            ticket = AggTicket(self, g, g.stripes, stripes)
+            g.arrays.append(shaped)
+            g.tickets.append(ticket)
+            g.stripes += stripes
+            g.nbytes += shaped.nbytes
+            if self.window <= 1:
+                reason = "flush_immediate"
+            elif g.nbytes >= self.max_bytes:
+                reason = "flush_bytes"
+            elif len(g.tickets) >= self.window:
+                reason = "flush_window"
+            if reason is not None:
+                self._groups.pop(key, None)  # detach under the lock...
+        if reason is not None:
+            try:
+                self._launch(g, reason)  # ...dispatch/compile outside it
+            except Exception:
+                # sticky on the group: every co-rider's reap reports it
+                # (raising here would blame an arbitrary submitter and
+                # tear down its unrelated write)
+                pass
+        return ticket
+
+    def pending(self) -> int:
+        """Submissions queued but not yet launched."""
+        with self._lock:
+            return sum(len(g.tickets) for g in self._groups.values())
+
+    def flush(self) -> None:
+        """Launch every windowed group, FIFO (the commit barrier)."""
+        with self._lock:
+            detached = list(self._groups.values())
+            self._groups.clear()
+        for g in detached:
+            try:
+                self._launch(g, "flush_explicit")
+            except Exception:
+                continue  # sticky on the group; other groups still launch
+
+    # -- launch + reap -------------------------------------------------------
+
+    def _pad_target(self, stripes: int) -> int:
+        """Launch-size bucket: pow2 up to 64 stripes, then multiples of 64.
+        Bounds both the jit-cache geometry count AND the padding waste
+        (pure pow2 would pad up to 2x on exactly the biggest launches the
+        byte budget exists to bound)."""
+        if stripes <= 64:
+            return _next_pow2(stripes)
+        return -(-stripes // 64) * 64
+
+    def _launch(self, g: _AggGroup, reason: str) -> None:
+        """Concatenate a (detached) group's submissions into one padded
+        device launch.  Runs OUTSIDE the aggregator-wide lock: the encode
+        dispatch — including a first-time jit compile, seconds on a
+        remote-compile TPU path — must not stall other geometries'
+        submits.  The group lock serializes against same-group reaps."""
+        with g.lock:
+            if g.parity is not None or g.host is not None or g.error is not None:
+                return
+            data = g.arrays[0] if len(g.arrays) == 1 else np.concatenate(g.arrays)
+            # pad only in aggregating mode: with the window off, every
+            # write would pay a concatenate copy + dead-stripe encode the
+            # direct path never did
+            pad = 0
+            if self.pad_pow2 and self.window > 1:
+                pad = self._pad_target(g.stripes) - g.stripes
+            if pad:
+                data = np.concatenate(
+                    [data, np.zeros((pad, *data.shape[1:]), dtype=np.uint8)]
+                )
+            out_shape = (
+                data.shape[0],
+                g.ec.get_chunk_count() - data.shape[1],
+                data.shape[2],
+            )
+            # the donation pool only pays off when the coder's dispatch
+            # will actually consume the donated buffer (the packed jnp
+            # path); on e.g. the Pallas path pooling would just hold dead
+            # device memory an extra launch
+            check = getattr(g.ec, "encode_donatable", None)
+            g.donatable = bool(check(data.shape)) if check is not None else False
+            donate = None
+            if g.donatable:
+                with self._lock:
+                    donate = self._donate_pool.pop(out_shape, None)
+            try:
+                parity = g.ec.encode_array(data, out=donate)
+            except BaseException as e:
+                # sticky: every co-rider's reap reports the launch failure
+                # instead of crashing on a half-torn group
+                g.error = e
+                raise
+            g.arrays = []
+            g.pad = pad
+            g.parity = parity
+        self.perf.inc("launches")
+        self.perf.inc(reason)
+        self.perf.inc("pad_stripes", pad)
+        self.perf.hinc("stripes_per_launch", g.stripes)
+        self.perf.hinc("tickets_per_launch", len(g.tickets))
+        self.perf.hinc("launch_bytes", data.nbytes)
+
+    def _materialize(self, ticket: AggTicket) -> None:
+        # Lock order: group lock -> aggregator lock (nothing acquires the
+        # other way).  The blocking device wait + D2H copy runs outside
+        # the aggregator-wide lock so other geometries never stall behind
+        # a kernel.
+        g = ticket._group
+        with g.lock:
+            if g.host is None and g.error is None and g.parity is None:
+                # still windowed: detach and launch it ourselves (a reap
+                # must never deadlock behind its own window).  Identity
+                # check: a newer group may have reused our key after we
+                # were detached by a concurrent flush — popping IT would
+                # orphan its window.
+                with self._lock:
+                    if self._groups.get(g.key) is g:
+                        del self._groups[g.key]
+                try:
+                    self._launch(g, "flush_reap")
+                except Exception:
+                    pass  # reported as EcError via g.error below
+            if g.error is not None:
+                raise EcError(EIO, f"aggregated encode launch failed: {g.error!r}")
+            if g.host is None:
+                parity = g.parity
+                if len(g.tickets) == 1 and not g.pad:
+                    # single-ticket unpadded group (the window<=1 default
+                    # path): hand the device result straight through —
+                    # no forced copy, no donation-pool recycling
+                    g.host = np.asarray(parity)
+                else:
+                    # when the buffer is headed for the donation pool the
+                    # copy MUST be forced (np.array): a zero-copy
+                    # CPU-backend view into a later-donated buffer would
+                    # corrupt silently
+                    host = np.array(parity) if g.donatable else np.asarray(parity)
+                    g.host = host[: g.stripes] if g.pad else host
+                    if g.donatable and not isinstance(parity, np.ndarray):
+                        with self._lock:
+                            self._donate_pool[tuple(parity.shape)] = parity
+                g.parity = None
+        ticket._value = g.host[ticket._start : ticket._start + ticket._stripes]
+
+
+_DEFAULT_AGGREGATOR: EncodeAggregator | None = None
+
+
+def default_encode_aggregator() -> EncodeAggregator:
+    """Process-wide aggregator shared by every ECBackend that isn't handed
+    its own — the sharing is what coalesces encodes ACROSS PGs.  Built
+    from the option-table defaults (common/options.py); daemons with a
+    live Config can construct and inject their own."""
+    global _DEFAULT_AGGREGATOR
+    if _DEFAULT_AGGREGATOR is None:
+        from ceph_tpu.common.options import OPTIONS
+
+        _DEFAULT_AGGREGATOR = EncodeAggregator(
+            window=int(OPTIONS["ec_tpu_aggregate_window"].default),
+            max_bytes=int(OPTIONS["ec_tpu_aggregate_max_bytes"].default),
+        )
+    return _DEFAULT_AGGREGATOR
 
 
 class EncodePipeline:
@@ -388,16 +738,37 @@ class MatrixCodecMixin:
 
     # -- device-native bulk paths ------------------------------------------
 
-    def encode_array(self, data) -> jnp.ndarray:
+    def encode_array(self, data, out=None) -> jnp.ndarray:
         """(..., k, L) uint8 -> (..., m, L) parity, stays on device.
 
         Dispatches through the cached _DeviceCoder, so on a TPU backend this
         IS the fused Pallas kernel — the production analog of the reference
-        plugin's `ec_encode_data` hot call (isa/ErasureCodeIsa.cc:83-91)."""
+        plugin's `ec_encode_data` hot call (isa/ErasureCodeIsa.cc:83-91).
+
+        `out`: optional dead device buffer of the parity's shape, donated
+        into the packed kernel so recurring aggregated launches reuse the
+        allocation (ignored on paths that cannot donate)."""
         mat = self.distribution_matrix()
         if self.m == 1 and self._xor_row_available():
-            return xor_reduce(jnp.asarray(data))[..., None, :]
-        return PLAN_CACHE.encode_coder(mat[self.k :])(jnp.asarray(data))
+            arr = jnp.asarray(data)
+            lead = arr.shape[:-2]
+            record_launch(int(np.prod(lead)) if lead else 1, int(np.prod(arr.shape)))
+            return xor_reduce(arr)[..., None, :]
+        return PLAN_CACHE.encode_coder(mat[self.k :])(jnp.asarray(data), out=out)
+
+    def encode_donatable(self, data_shape) -> bool:
+        """True when encode_array(data, out=...) at this input shape will
+        actually consume a donated parity buffer — i.e. the dispatch lands
+        on the packed jnp kernel.  The EncodeAggregator gates its donation
+        pool on this so it never hoards dead device memory for paths
+        (xor_reduce, Pallas, small-matmul) that ignore `out`."""
+        mat = self.distribution_matrix()
+        if self.m == 1 and self._xor_row_available():
+            return False
+        if int(np.prod(data_shape)) < PACKED_MIN_BYTES:
+            return False
+        coder = PLAN_CACHE.encode_coder(mat[self.k :])
+        return not (coder.plan is not None and data_shape[-1] % 128 == 0)
 
     def decode_array(self, erasures: list[int], survivors) -> jnp.ndarray:
         """survivors (..., k, L) in decode_index order -> (..., nerrs, L)."""
@@ -410,14 +781,25 @@ class MatrixCodecMixin:
 
     # -- chunk-level interface ---------------------------------------------
 
+    @staticmethod
+    def _as_u8(buf) -> np.ndarray:
+        """Normalize one chunk buffer to uint8 WITHOUT copying when
+        avoidable: contiguous uint8 arrays (every ECBackend call site)
+        pass through untouched, raw byte containers map zero-copy via
+        frombuffer, and everything else goes through np.asarray — views
+        stay views, so np.stack in the caller pays the gather's only
+        copy (ascontiguousarray here would copy a second time)."""
+        if type(buf) is np.ndarray and buf.dtype == np.uint8:
+            return buf
+        if isinstance(buf, (bytes, bytearray, memoryview)):
+            return np.frombuffer(buf, dtype=np.uint8)
+        return np.asarray(buf, dtype=np.uint8)
+
     def _gather(self, chunks: Mapping[int, np.ndarray]) -> np.ndarray:
         """Stack the k data chunks in encode order (shared by the sync
         interface and the EncodePipeline so the paths cannot drift)."""
         return np.stack(
-            [
-                np.asarray(chunks[self.chunk_index(i)], dtype=np.uint8)
-                for i in range(self.k)
-            ]
+            [self._as_u8(chunks[self.chunk_index(i)]) for i in range(self.k)]
         )
 
     def _scatter(self, chunks: Mapping[int, np.ndarray], parity: np.ndarray) -> None:

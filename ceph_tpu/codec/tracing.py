@@ -57,10 +57,10 @@ def instrument_codec(ec, plugin: str):
     if hasattr(ec, "encode_array"):
         orig_encode_array = ec.encode_array
 
-        def encode_array(data):
+        def encode_array(data, out=None):
             parent = active_span()
             if parent is None:
-                return orig_encode_array(data)
+                return orig_encode_array(data, out=out)
             import jax.numpy as jnp
 
             with parent.child(f"codec:{plugin}:encode") as sp:
@@ -70,7 +70,7 @@ def instrument_codec(ec, plugin: str):
                 with sp.child("kernel_launch"):
                     # async dispatch: this times the launch, not the kernel;
                     # the reap side (PendingEncode.result) times the wait
-                    return orig_encode_array(dev)
+                    return orig_encode_array(dev, out=out)
 
         ec.encode_array = encode_array
 

@@ -101,6 +101,31 @@ OPTIONS: dict[str, Option] = _opts(
         A,
         "default EC profile (global.yaml.in)",
     ),
+    Option(
+        "ec_tpu_aggregate_window",
+        int,
+        0,
+        A,
+        "EC encode launch aggregation window: submissions of one "
+        "(matrix, chunk-size) geometry held before a coalesced device "
+        "launch (codec/matrix_codec.py EncodeAggregator).  <= 1 launches "
+        "every submission immediately.  Commit barriers always drain the "
+        "window, so a value up to the encode queue depth trades no "
+        "durability, only launch count",
+        see_also=("ec_tpu_aggregate_max_bytes",),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_aggregate_max_bytes",
+        int,
+        64 << 20,
+        A,
+        "input-byte budget per aggregation group: a group launches as "
+        "soon as its queued stripe bytes reach this, whatever the window "
+        "(bounds device memory held by deferred encodes)",
+        see_also=("ec_tpu_aggregate_window",),
+        runtime=True,
+    ),
     # --- OSD ----------------------------------------------------------------
     Option("osd_recovery_max_chunk", int, 8 << 20, A,
            "max recovery push size; rounded to stripe (ECBackend.h:206)"),

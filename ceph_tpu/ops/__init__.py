@@ -1,5 +1,19 @@
-"""Device kernels: bitsliced GF(2^8) XOR-matmul (jnp + Pallas paths)."""
+"""Device kernels: packed-bitplane GF(2^8) coding (packed_gf), bitsliced
+XOR-matmul reference paths (xor_mm), the Pallas TPU kernel (pallas_gf),
+and the device-launch accounting tests batch-invariants against
+(dispatch)."""
 
+from .dispatch import LAUNCHES, record_launch
+from .packed_gf import PackedPlan, plane_schedule
 from .xor_mm import as_device_bit_matrix, encode_full, xor_matmul, xor_reduce
 
-__all__ = ["as_device_bit_matrix", "encode_full", "xor_matmul", "xor_reduce"]
+__all__ = [
+    "LAUNCHES",
+    "PackedPlan",
+    "as_device_bit_matrix",
+    "encode_full",
+    "plane_schedule",
+    "record_launch",
+    "xor_matmul",
+    "xor_reduce",
+]

@@ -1,0 +1,165 @@
+"""Packed-bitplane GF(2^8) coding — the device hot path without the 8x blow-up.
+
+The original jnp path (ceph_tpu.ops.xor_mm.xor_matmul) inflates every data
+byte into 8 int8 bit-planes before an (8m, 8k) int32 matmul: an 8x operand
+expansion plus a 4x-wide accumulator, exactly the operand blow-up where
+bitmatrix codecs lose their bandwidth ("Accelerating XOR-based Erasure
+Coding using Program Optimization Techniques", arXiv:2108.02692).  This
+module keeps the planes PACKED 8-per-byte and reorganizes the contraction
+around packed words ("Fast Xor-based Erasure Coding based on Polynomial
+Ring Transforms", arXiv:1701.07731):
+
+    byte j of a chunk already holds its own 8 bit-planes, packed.  The
+    GF(2)-linear action of a coefficient c decomposes over the bits of c:
+
+        c * x = XOR over set bits b of c of (x * 2^b)
+
+    and multiplication by 2 (`xtime`) is itself a packed GF(2) map:
+
+        x * 2 = (x << 1) ^ (0x1d if x & 0x80)      (poly 0x11d, ISA-L's)
+
+    so the whole encode is: build the k x 8 tower of packed power planes
+    (7 xtime steps per chunk, pure byte-wise shifts/XORs), then XOR the
+    planes selected by each output coefficient's bits.  Operand stays
+    (k, L) uint8 — 8x smaller than the bit-plane expansion — accumulators
+    stay uint8, and the schedule's XOR count is sum(popcount(c_ij)), a
+    fraction of the 8m x 8k bit-row schedule.
+
+The gather-reshape -> plane tower -> XOR schedule -> output stack chain is
+ONE jitted computation per (matrix, geometry); `PackedPlan.__call__`
+accepts an `out=` device buffer and routes through a `donate_argnums`
+variant so steady-state aggregated launches (codec/matrix_codec.py's
+EncodeAggregator) reuse the parity allocation instead of growing the heap.
+
+Byte-identical to `xor_matmul` and to the host oracle
+(gf.bitslice.xor_matmul_host) for every matrix — the schedule is an exact
+refactoring of the same GF(2) linear map, verified across geometries by
+tests/test_packed_gf.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.gf.tables import GF_MUL_TABLE
+
+from .dispatch import record_launch
+
+# xtime reduction byte: 2 * 0x80 in GF(2^8) == generator poly & 0xFF.
+# Derived from the table so the kernel can never drift from the host GF.
+_XTIME_RED = int(GF_MUL_TABLE[2, 0x80])
+
+# Below this many input bytes the one-kernel-per-(shape) bitsliced matmul
+# (matrix as a runtime operand) wins: the packed kernel bakes its XOR
+# schedule in at trace time, so every distinct matrix costs a compile —
+# fine for the handful of encode matrices and hot decode patterns, wasteful
+# for tiny one-off decodes (SHEC's searched inverses on 4 KiB chunks).
+PACKED_MIN_BYTES = 64 * 1024
+
+
+def plane_schedule(gf_matrix: np.ndarray) -> tuple[tuple[tuple[int, int], ...], ...]:
+    """(m, k) GF matrix -> per-output-row tuple of (chunk j, power b) terms.
+
+    Output byte i is the XOR of packed planes data[j] * 2^b for every set
+    bit b of coefficient gf_matrix[i, j]."""
+    gfm = np.asarray(gf_matrix, dtype=np.uint8)
+    m, k = gfm.shape
+    return tuple(
+        tuple(
+            (j, b)
+            for j in range(k)
+            for b in range(8)
+            if (int(gfm[i, j]) >> b) & 1
+        )
+        for i in range(m)
+    )
+
+
+def _xtime(x: jax.Array) -> jax.Array:
+    """Packed multiply-by-2 in GF(2^8): byte-wise, carry folded via the
+    reduction poly.  uint8 shift-left wraps mod 256, which is exactly the
+    discard of the top bit the reduction replaces."""
+    return (x << 1) ^ ((x >> 7) * jnp.uint8(_XTIME_RED))
+
+
+def _packed_code_impl(data: jax.Array, sched, k: int, m: int) -> jax.Array:
+    *lead, kk, L = data.shape
+    assert kk == k, (kk, k)
+    # Power towers only up to the highest bit any coefficient uses.
+    max_pow = [0] * k
+    for row in sched:
+        for j, b in row:
+            max_pow[j] = max(max_pow[j], b)
+    towers: list[list[jax.Array]] = []
+    for j in range(k):
+        t = [data[..., j, :]]
+        for _ in range(max_pow[j]):
+            t.append(_xtime(t[-1]))
+        towers.append(t)
+    outs = []
+    for i in range(m):
+        row = sched[i]
+        if not row:
+            outs.append(jnp.zeros((*lead, L), jnp.uint8))
+            continue
+        acc = towers[row[0][0]][row[0][1]]
+        for j, b in row[1:]:
+            acc = acc ^ towers[j][b]
+        outs.append(acc)
+    return jnp.stack(outs, axis=-2)
+
+
+@functools.partial(jax.jit, static_argnames=("sched", "k", "m"))
+def _packed_code(data: jax.Array, *, sched, k: int, m: int) -> jax.Array:
+    return _packed_code_impl(data, sched, k, m)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sched", "k", "m"), donate_argnums=(0,)
+)
+def _packed_code_into(out: jax.Array, data: jax.Array, *, sched, k: int, m: int) -> jax.Array:
+    """Donating variant: `out` is a dead parity buffer of the result's
+    exact (..., m, L) shape; XLA aliases the result into it, so launches
+    at a recurring aggregated geometry stop allocating.  The donated array
+    is INVALID after the call — callers own that discipline
+    (docs/PERFORMANCE.md, donation caveats)."""
+    return _packed_code_impl(data, sched, k, m)
+
+
+class PackedPlan:
+    """Host-built packed-plane plan: one fused jit per (matrix, geometry).
+
+    The packed analog of pallas_gf.CodingPlan — works on every backend
+    (pure jnp), no chunk-length alignment constraint, and the dispatch
+    unit the launch counter observes."""
+
+    __slots__ = ("k", "m", "sched")
+
+    def __init__(self, gf_matrix: np.ndarray):
+        gfm = np.asarray(gf_matrix, dtype=np.uint8)
+        self.m, self.k = gfm.shape
+        self.sched = plane_schedule(gfm)
+
+    def _stripes(self, shape) -> int:
+        lead = shape[:-2]
+        return int(np.prod(lead)) if lead else 1
+
+    def __call__(self, data: jax.Array, out: jax.Array | None = None) -> jax.Array:
+        """(..., k, L) uint8 -> (..., m, L) uint8 parity/coded output.
+
+        `out`: optional donated device buffer of the result shape (see
+        _packed_code_into); ignored when the shape/dtype does not match."""
+        record_launch(self._stripes(data.shape), int(np.prod(data.shape)))
+        kw = dict(sched=self.sched, k=self.k, m=self.m)
+        want_shape = (*data.shape[:-2], self.m, data.shape[-1])
+        if (
+            out is not None
+            and tuple(getattr(out, "shape", ())) == want_shape
+            and getattr(out, "dtype", None) == jnp.uint8
+        ):
+            return _packed_code_into(out, data, **kw)
+        return _packed_code(data, **kw)

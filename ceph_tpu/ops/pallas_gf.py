@@ -184,18 +184,29 @@ class CodingPlan:
         self.interpret = interpret
         self.sched = schedule_from_matrix(gf_matrix)
         self.bm = jnp.asarray(expand_matrix(gf_matrix), dtype=jnp.uint8)
+        self._gf = gf_matrix
+        self._packed = None  # lazy packed-plane fallback for unaligned L
 
     def __call__(self, data: jax.Array) -> jax.Array:
         """(..., k, L) uint8 -> (..., m, L) uint8 coded output."""
+        from .dispatch import record_launch
+
         *lead, k, L = data.shape
         assert k == self.k, (k, self.k)
         geom = pick_geometry(L)
+        stripes = int(np.prod(lead)) if lead else 1
         if geom is None:
+            from .packed_gf import PACKED_MIN_BYTES, PackedPlan
             from .xor_mm import xor_matmul
 
+            if int(np.prod(data.shape)) >= PACKED_MIN_BYTES:
+                if self._packed is None:
+                    self._packed = PackedPlan(self._gf)
+                return self._packed(data)
+            record_launch(stripes, int(np.prod(data.shape)))
             return xor_matmul(self.bm, data)
         rows, cols = geom
-        stripes = int(np.prod(lead)) if lead else 1
+        record_launch(stripes, int(np.prod(data.shape)))
         flat = data.reshape(stripes, k, L)
         out = _gf_code_swar(
             flat,

@@ -1,5 +1,13 @@
 """Device GF(2^8) coding as bitsliced XOR-matmuls (jax.numpy reference path).
 
+Role after the packed-bitplane rework (ceph_tpu.ops.packed_gf): this module
+is the byte-exact REFERENCE formulation and the small-input/one-off-matrix
+path.  Its bit-matrix is a runtime operand, so one compiled kernel serves
+every matrix at a given shape — the right trade for tiny decodes against
+freshly inverted matrices.  Bulk coding dispatches to packed_gf.PackedPlan
+(planes kept packed 8-per-byte; 8x smaller operand) or the Pallas kernel;
+see _DeviceCoder in codec/matrix_codec.py for the dispatch rule.
+
 This is the TPU replacement for the reference's SIMD hot loop
 (`ec_encode_data`, /root/reference/src/erasure-code/isa/ErasureCodeIsa.cc:129;
 `region_xor`, isa/xor_op.cc): the (m, k) GF coding matrix is expanded once on

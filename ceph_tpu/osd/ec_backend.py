@@ -157,12 +157,23 @@ class ECBackend(PGBackend):
         sinfo: StripeInfo,
         allows_overwrites: bool = False,
         fast_read: bool = False,
+        aggregator=None,
     ):
         super().__init__(listener, store)
         self.ec = ec
         self.sinfo = sinfo
         self.allows_overwrites = allows_overwrites
         self.fast_read = fast_read
+        # Cross-write launch aggregation: the default instance is shared
+        # process-wide, so concurrent small writes from DIFFERENT PGs on
+        # this OSD coalesce into one padded device launch (the bucketed
+        # all-reduce analog; window knobs in common/options.py).  The
+        # commit barrier (flush_encodes) and the pipe drain flush it.
+        from ..codec.matrix_codec import default_encode_aggregator
+
+        self.encode_aggregator = (
+            aggregator if aggregator is not None else default_encode_aggregator()
+        )
         self.extent_cache = ExtentCache()
         self._tid = 0
         self.in_flight: dict[int, Op] = {}  # write tid -> Op
@@ -387,6 +398,13 @@ class ECBackend(PGBackend):
         op.trace.event("issue rmw reads")
 
         def _on_read(results: dict) -> None:
+            if self.in_flight.get(op.tid) is not op:
+                # the op was aborted while its reads were in flight (an
+                # earlier same-object encode failure doomed it): a stale
+                # completion must not resurrect it — encoding it now
+                # would persist a write whose client already saw EIO,
+                # and the error branch would double-fire on_failure
+                return
             err, extents = results[op.pgt.oid]
             if err:
                 # The reference asserts here (a decodable PG cannot fail its
@@ -420,6 +438,7 @@ class ECBackend(PGBackend):
                 self.ec,
                 op.obj_size,
                 op.read_results,
+                aggregator=self.encode_aggregator,
             )
         op.encoded = True
         op.trace.event("encode launched")
@@ -457,6 +476,15 @@ class ECBackend(PGBackend):
         bounded staleness beats an unbounded poll loop."""
         while self._encode_pipe:
             op = self._encode_pipe[0]
+            # A head still sitting in the aggregation window gets the same
+            # re-poll grace as a computing one (~100 ms for co-riders to
+            # arrive and fill the window) — flushing on first sight would
+            # defeat ec_tpu_aggregate_window on the event-loop path, where
+            # this drain runs before the next write is even dispatched.
+            # After the grace, drain the window: no amount of polling
+            # launches a windowed encode.
+            if not op.encode_stage.launched() and op.drain_polls >= 50:
+                self.encode_aggregator.flush()
             if not op.encode_stage.ready() and op.drain_polls < 50:
                 op.drain_polls += 1
                 try:
@@ -470,7 +498,12 @@ class ECBackend(PGBackend):
 
     def flush_encodes(self) -> None:
         """Drain the whole encode pipeline (the barrier before commit
-        checks in synchronous harnesses; EncodePipeline.flush analog)."""
+        checks in synchronous harnesses; EncodePipeline.flush analog).
+        Drains the aggregation window first: a commit barrier must launch
+        everything still waiting for co-riders.  A failed aggregated
+        launch is sticky on its group — each affected op fails cleanly at
+        its own reap below — so the barrier itself never throws."""
+        self.encode_aggregator.flush()
         while self._encode_pipe:
             self._dispatch_encoded(self._encode_pipe.pop(0))
 
@@ -489,17 +522,25 @@ class ECBackend(PGBackend):
         # the reap may run from a bare event-loop callback (_drain_encode_pipe):
         # re-enter the op's span scope so materialization sub-spans attach
         with tracer_mod.span_scope(op.trace):
-            txns, new_hinfo, merged = finish_transactions(
-                op.encode_stage,
-                op.pgt,
-                op.plan,
-                self.sinfo,
-                self.ec,
-                self._shard_colls(),
-                op.obj_size,
-                hinfo,
-                op.version.version,
-            )
+            try:
+                txns, new_hinfo, merged = finish_transactions(
+                    op.encode_stage,
+                    op.pgt,
+                    op.plan,
+                    self.sinfo,
+                    self.ec,
+                    self._shard_colls(),
+                    op.obj_size,
+                    hinfo,
+                    op.version.version,
+                )
+            except EcError as e:
+                # a failed (aggregated) encode launch surfaces here, at
+                # the op that owns the ticket: fail the op cleanly —
+                # release its pin, reset projected state, abort dependent
+                # writes — instead of leaking it from a drain callback
+                self._fail_encoded_op(op, e)
+                return
         op.encode_stage = None
         op.trace.event("encoded")
         if op.encode_t0:
@@ -551,6 +592,59 @@ class ECBackend(PGBackend):
         for osd, msg in sends:
             self.listener.send_shard(osd, msg)
         # Unblock readers that were waiting on our pin.
+        self._kick_waiting_reads()
+
+    def _fail_encoded_op(self, op: Op, err: EcError) -> None:
+        """Fail an op whose LAUNCHED encode could not be materialized.
+
+        Unlike the RMW-read failure path (where later same-object ops are
+        necessarily still un-encoded), by reap time later ops may have
+        ALREADY encoded — against projected state embedding this op's
+        bytes (their merges read our pin).  Letting one of those commit
+        would persist a write the client was told failed, so the abort
+        dooms every later same-object op that has not yet dispatched its
+        sub-writes, encoded or not.  Negative errno, matching the
+        read-failure convention."""
+        oid = op.pgt.oid
+        errno = -abs(err.errno or EIO)
+        doomed = [op] + [
+            o
+            for o in list(self.in_flight.values()) + self.waiting_reads
+            if o.pgt.oid == oid and o.tid > op.tid and not o.pending_commits
+        ]
+        for o in doomed:
+            self.in_flight.pop(o.tid, None)
+        self.waiting_reads = [o for o in self.waiting_reads if o not in doomed]
+        self._encode_pipe = [o for o in self._encode_pipe if o not in doomed]
+        # Projected state: earlier same-object ops may be DISPATCHED but
+        # uncommitted — dropping the projection entirely would let the
+        # next write plan against the stale on-disk size while their
+        # commits are still landing.  Roll the projection back to the
+        # newest survivor's planned state (its reap already set the hinfo
+        # chain); only a survivor-free object resets to disk.
+        proj = self._projected.get(oid)
+        if proj is not None:
+            proj["refs"] -= len(doomed)
+            survivors = [
+                o for o in self.in_flight.values() if o.pgt.oid == oid
+            ]
+            if proj["refs"] <= 0 or not survivors:
+                self._projected.pop(oid, None)
+            else:
+                proj["size"] = max(survivors, key=lambda o: o.tid).plan.new_size
+        self.listener.clog_error(
+            f"{self.listener.pgid}: encode launch for {oid} failed ({errno}); "
+            f"aborting {len(doomed)} queued write(s)"
+        )
+        for o in doomed:
+            if o.pin is not None:
+                self.extent_cache.release_pin(o.pin)
+                o.pin = None
+            o.encode_stage = None
+            o.trace.event(f"aborted: encode launch failed ({errno})")
+            o.trace.finish()
+            if o.on_failure is not None:
+                o.on_failure(errno)
         self._kick_waiting_reads()
 
     def _kick_waiting_reads(self) -> None:

@@ -182,6 +182,11 @@ class EncodeStage:
     def ready(self) -> bool:
         return all(p.ready() for p in self.pending.values())
 
+    def launched(self) -> bool:
+        """False while any region's encode still sits in an aggregation
+        window (a flush, not time, will make it ready)."""
+        return all(p.launched() for p in self.pending.values())
+
 
 def launch_encode(
     pgt: PGTransaction,
@@ -190,10 +195,14 @@ def launch_encode(
     ec: ErasureCodeInterface,
     obj_size: int,
     read_data: dict[int, bytes],
+    aggregator=None,
 ) -> EncodeStage:
     """Merge RMW inputs with the new bytes and LAUNCH the device encodes
     (one batched launch per contiguous region) without materializing
-    parity — phase one of generate_transactions."""
+    parity — phase one of generate_transactions.  An `aggregator` routes
+    the launches through the cross-write aggregation window (ECBackend
+    passes its shared EncodeAggregator; the sync composition below does
+    not)."""
     merged: dict[int, bytearray] = {}
     if pgt.delete:
         return EncodeStage(merged=merged, pending={})
@@ -217,7 +226,9 @@ def launch_encode(
             if off <= t < off + len(buf):
                 buf[t - off :] = b"\x00" * (off + len(buf) - t)
     pending = {
-        off: stripe_mod.encode_launch(sinfo, ec, bytes(merged[off]))
+        off: stripe_mod.encode_launch(
+            sinfo, ec, bytes(merged[off]), aggregator=aggregator
+        )
         for off in sorted(merged)
     }
     return EncodeStage(merged=merged, pending=pending)

@@ -193,6 +193,24 @@ class OSD(Dispatcher):
         # incoming trace-carrying messages get a messenger hop span
         # parent-linked to the sender (tracer.py inject/extract)
         self.msgr.tracer = self.tracer
+        # EC encode launch aggregation: this OSD's PGs share the
+        # process-wide aggregator; apply the daemon's config to it and
+        # keep it in sync on runtime sets (both options are runtime=True)
+        from ..codec.matrix_codec import default_encode_aggregator
+
+        self.encode_aggregator = default_encode_aggregator()
+        self.encode_aggregator.configure(
+            window=self.conf.get("ec_tpu_aggregate_window"),
+            max_bytes=self.conf.get("ec_tpu_aggregate_max_bytes"),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_aggregate_window"],
+            lambda _n, v: self.encode_aggregator.configure(window=int(v)),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_aggregate_max_bytes"],
+            lambda _n, v: self.encode_aggregator.configure(max_bytes=int(v)),
+        )
         self.admin_socket = None
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
@@ -254,8 +272,15 @@ class OSD(Dispatcher):
         from ..common.admin_socket import AdminSocket
 
         sock = AdminSocket(path)
-        sock.register("perf dump", lambda cmd: self.perf.dump(),
-                      "dump perf counters")
+        # the OSD's encode aggregator (the shared instance this daemon
+        # configured at init) exports its occupancy/launch-size
+        # distributions alongside the daemon counters
+        agg_perf = self.encode_aggregator.perf
+        sock.register(
+            "perf dump",
+            lambda cmd: {**self.perf.dump(), "ec_aggregator": agg_perf.dump()},
+            "dump perf counters",
+        )
         sock.register("config show", lambda cmd: self.conf.show(),
                       "dump current config")
         sock.register("config diff", lambda cmd: self.conf.diff(),
@@ -273,7 +298,10 @@ class OSD(Dispatcher):
         )
         sock.register(
             "dump_histograms",
-            lambda cmd: self.perf.dump_histograms(),
+            lambda cmd: {
+                **self.perf.dump_histograms(),
+                "ec_aggregator": agg_perf.dump_histograms(),
+            },
             "log2-bucketed latency (and size x latency) histograms",
         )
         def _pg_for_cmd(cmd):
@@ -454,11 +482,17 @@ class OSD(Dispatcher):
 
         if not self.mgr_addr:
             return
+        # the encode aggregator's occupancy/launch-size histograms ride
+        # the report (namespaced), so the mgr prometheus scrape exports
+        # them like any daemon counter — not just the local admin socket
+        perf = dict(self.perf.dump())
+        for name, val in self.encode_aggregator.perf.dump().items():
+            perf[f"ec_aggregator.{name}"] = val
         self._send_addr(
             self.mgr_addr,
             MMgrReport(
                 daemon=f"osd.{self.whoami}",
-                perf=json.dumps(self.perf.dump()).encode(),
+                perf=json.dumps(perf).encode(),
                 status=json.dumps(_osd_status(self)).encode(),
             ),
         )

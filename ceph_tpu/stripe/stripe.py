@@ -101,6 +101,14 @@ class PendingEncode:
         is_ready = getattr(self._parity, "is_ready", None)
         return True if is_ready is None else bool(is_ready())
 
+    def launched(self) -> bool:
+        """False while the parity sits in an EncodeAggregator window (the
+        device hasn't been asked yet — only a flush will make it ready).
+        Plain device arrays are launched by construction."""
+        if self._result is not None:
+            return True
+        return bool(getattr(self._parity, "launched", True))
+
     def result(self) -> dict[int, np.ndarray]:
         if self._result is None:
             from ..codec.tracing import wait_span
@@ -123,13 +131,20 @@ def encode_launch(
     ec: ErasureCodeInterface,
     data: bytes | np.ndarray,
     want: set[int] | None = None,
+    aggregator=None,
 ) -> PendingEncode:
     """Launch a batched stripe encode WITHOUT materializing the parity.
 
     Matrix codecs dispatch one device launch and return immediately with a
     live handle; layered/array codecs (lrc, clay) compute eagerly (their
     chunk-level interfaces materialize internally) and the PendingEncode is
-    born ready."""
+    born ready.
+
+    With an `aggregator` (codec.matrix_codec.EncodeAggregator), the stripe
+    batch is SUBMITTED instead of launched: concurrent small encodes from
+    different writes coalesce into one padded device dispatch when the
+    aggregation window fills or a barrier flushes (the PendingEncode's
+    handle is the aggregator ticket, same poll/materialize surface)."""
     raw = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8).ravel()
     if raw.size % sinfo.stripe_width:
         raise EcError(EINVAL, f"length {raw.size} not stripe aligned")
@@ -142,6 +157,8 @@ def encode_launch(
     if want is None:
         want = set(range(n))
     if _matrix_fast_path(ec) and m > 0:
+        if aggregator is not None:
+            return PendingEncode(shaped, aggregator.submit(ec, shaped), k, m, want)
         return PendingEncode(shaped, ec.encode_array(shaped), k, m, want)
     shards = [np.empty((stripes, sinfo.chunk_size), dtype=np.uint8) for _ in range(n)]
     for s in range(stripes):
