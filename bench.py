@@ -286,6 +286,95 @@ def run_child(platform: str) -> None:
         clog(f"stages: {stages}")
     except Exception as e:  # headline survives a failed breakdown
         clog(f"stage breakdown failed: {e!r}")
+    # Decode stage: same RS(8,3) geometry, three erasures (two data + one
+    # parity) — the recovery/degraded-read-shaped workload (ISSUE 5).
+    # The warm-up probe and the chain compile run under their own
+    # watchdog allowances so a backend that survives encode but wedges on
+    # the decode kernel family fails fast with rc=5 (attributable stage
+    # in stderr) instead of silently eating the child deadline.  Bytes
+    # first: the probe reconstruction is checked against the host GF
+    # oracle before anything is timed.  Throughput counts survivor input
+    # bytes per second, symmetrical with the encode metric.
+    decode_result = None
+    decode_err = ""
+    try:
+        erasures = [0, 5, 9]
+        idx = ec.decode_index(erasures)
+        watchdog.stage("decode_probe", PROBE_TIMEOUT_S)
+        clog(f"decode probe: erasures {erasures}, survivors {idx}")
+        # probe shape reuses the encode probe's compiled parity kernel;
+        # only the decode coder itself compiles here (seconds-scale)
+        probe_full = np.concatenate([probe_in[0], gf_matmul(gfm, probe_in[0])])
+        probe_surv = jnp.concatenate(
+            [jnp.asarray(probe_in), encode_fn(jnp.asarray(probe_in))], axis=1
+        )[:, idx, :]
+        probe_rec = np.asarray(ec.decode_array(erasures, probe_surv))
+        if not np.array_equal(probe_rec[0], probe_full[erasures]):
+            clog("DECODE PROBE MISMATCH vs host oracle")
+            sys.exit(4)
+        clog("decode probe vs host oracle OK")
+
+        # Serial-chain methodology, mirroring the encode loop: each
+        # launch's survivors depend on the previous reconstruction.
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def dstep(s, r):
+            patch = (r[:1, :1, :128] ^ jnp.uint8(1)).reshape(1, 1, 128)
+            s2 = jax.lax.dynamic_update_slice(s, patch, (0, 0, 0))
+            return s2, ec.decode_array(erasures, s2)
+
+        watchdog.stage("decode_warmup", PROBE_TIMEOUT_S)
+        clog(f"decode warm-up at batch={batch}")
+        d_host = rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8)
+        d_data = jnp.asarray(d_host)
+        surv = jnp.concatenate([d_data, encode_fn(d_data)], axis=1)[:, idx, :]
+        del d_data
+        r = jnp.zeros((batch, len(erasures), chunk), jnp.uint8)
+        surv, r = dstep(surv, r)  # compile + warm
+        jax.block_until_ready((surv, r))
+        watchdog.disarm()
+        d_iters = iters
+        clog(f"decode measuring: batch={batch} iters={d_iters}")
+        t0 = time.perf_counter()
+        for _ in range(d_iters):
+            surv, r = dstep(surv, r)
+        jax.block_until_ready((surv, r))
+        _ = np.asarray(r[0, 0, :8])
+        d_elapsed = time.perf_counter() - t0
+        d_gbps = batch * k * chunk * d_iters / d_elapsed / 1e9
+        del surv, r
+        clog(f"decode done: {d_gbps:.3f} GB/s at batch={batch}")
+        decode_result = {"gbps": d_gbps, "batch": batch, "parity_ok": True}
+        # per-stage h2d/kernel/d2h breakdown for the decode launch,
+        # guarded like the encode one: losing the breakdown must never
+        # lose the decode (or encode) headline
+        try:
+            # host-side copy staged BEFORE the timing window, so h2d_s
+            # times only the put (symmetry with the encode breakdown)
+            host_surv = np.asarray(probe_surv)
+            jax.block_until_ready(ec.decode_array(erasures, jax.device_put(probe_surv)))
+            t0 = time.perf_counter()
+            d_dev = jax.block_until_ready(jax.device_put(host_surv))
+            t1 = time.perf_counter()
+            d_rec = jax.block_until_ready(ec.decode_array(erasures, d_dev))
+            t2 = time.perf_counter()
+            _ = np.asarray(d_rec)
+            t3 = time.perf_counter()
+            decode_result["stages"] = {
+                "h2d_s": round(t1 - t0, 6),
+                "kernel_s": round(t2 - t1, 6),
+                "d2h_s": round(t3 - t2, 6),
+                "shape": list(probe_surv.shape),
+            }
+            clog(f"decode stages: {decode_result['stages']}")
+        except Exception as e:
+            clog(f"decode stage breakdown failed: {e!r}")
+    except SystemExit:
+        raise
+    except Exception as e:  # encode headline survives a failed decode stage
+        watchdog.disarm()
+        decode_err = repr(e)
+        clog(f"decode stage failed: {decode_err}")
+
     result = {
         "platform": got,
         "gbps": gbps,
@@ -293,6 +382,10 @@ def run_child(platform: str) -> None:
         "parity_ok": True,
         "probe_s": round(probe_s, 3),
     }
+    if decode_result is not None:
+        result["decode"] = decode_result
+    elif decode_err:
+        result["decode_error"] = decode_err
     if stages is not None:
         result["stages"] = stages
     if os.environ.get("BENCH_TRACE"):
@@ -436,6 +529,20 @@ def main() -> None:
         "vs_baseline": round(gbps / NORTH_STAR_GBPS, 4),
         "platform": result["platform"],
     }
+    # decode twin metric rides the same line (the driver parses one JSON
+    # object): survivor-input GB/s of the recovery-shaped RS(8,3) decode
+    if "decode" in result:
+        d = result["decode"]
+        out["decode"] = {
+            "metric": "rs_8_3_decode_GBps_per_chip",
+            "value": round(d["gbps"], 3),
+            "unit": "GB/s",
+            "vs_encode": round(d["gbps"] / gbps, 4) if gbps else 0,
+        }
+        if "stages" in d:
+            out["decode"]["stages"] = d["stages"]
+    elif "decode_error" in result:
+        out["decode_error"] = result["decode_error"]
     if "stages" in result:
         out["stages"] = result["stages"]
     if "probe_s" in result:

@@ -408,6 +408,24 @@ class ErasureCodeClay(ErasureCode):
             return self._repair(want_to_read, chunks, chunk_size)
         return super().decode(want_to_read, chunks, chunk_size)
 
+    def decode_fragments_batch(
+        self,
+        want_to_read: set[int],
+        helper_chunks: Mapping[int, np.ndarray],
+        chunk_size: int,
+    ) -> dict[int, np.ndarray]:
+        """Vectorized repair across a whole shard's stripes at once.
+
+        Helper values are (stripes, fragment) uint8 arrays — stripe s's
+        repair-plane fragment in row s — and the result maps the lost
+        chunk to a (stripes, chunk_size) array.  Each score round runs
+        ONE inner-MDS launch over (planes, stripes, k+nu, sc) instead of
+        the per-stripe Python loop the OSD recovery path used to drive
+        (ISSUE 5 tentpole: stripes are just another batch axis)."""
+        if not self.is_repair(want_to_read, set(helper_chunks)):
+            raise EcError(EIO, "fragment decode requires a repair-plan read")
+        return self._repair(want_to_read, helper_chunks, chunk_size)
+
     def _repair(
         self,
         want_to_read: set[int],
@@ -423,6 +441,11 @@ class ErasureCodeClay(ErasureCode):
         rounds; each round uncouples helpers, runs one batched inner-MDS
         decode, and re-couples — recovering q lost sub-chunks per repair
         plane (the dot plus q-1 shifted partners).
+
+        Helper buffers may be flat (one fragment) or (stripes, fragment)
+        2-D (decode_fragments_batch): every transform below is
+        elementwise over the trailing axes and the inner-MDS coder takes
+        arbitrary leading batch dims, so the stripe axis rides along.
         """
         assert len(want_to_read) == 1 and len(helper_chunks) == self.d
         lost_ext = next(iter(want_to_read))
@@ -442,13 +465,25 @@ class ErasureCodeClay(ErasureCode):
 
         # Scatter helper fragments into full-size C/U tensors (only repair
         # planes are populated); aloof = alive nodes that sent nothing.
-        C = np.zeros((qt, self.sub_chunk_no, sc), dtype=np.uint8)
+        first = np.asarray(next(iter(helper_chunks.values())), dtype=np.uint8)
+        lead = first.shape[:-1] if first.ndim == 2 else ()
+        C = np.zeros((qt, self.sub_chunk_no, *lead, sc), dtype=np.uint8)
         helpers: set[int] = set()
         for i, buf in helper_chunks.items():
             buf = np.asarray(buf, dtype=np.uint8)
-            assert buf.size == repair_blocksize, (buf.size, repair_blocksize)
             node = self._ext(i)
-            C[node, repair_planes] = buf.reshape(n_rep, sc)
+            if lead:
+                assert buf.shape == (*lead, repair_blocksize), (
+                    buf.shape, lead, repair_blocksize,
+                )
+                # (S, n_rep, sc) -> plane-major (n_rep, S, sc) for the
+                # C[node, planes] scatter
+                C[node, repair_planes] = buf.reshape(
+                    *lead, n_rep, sc
+                ).transpose(1, 0, 2)
+            else:
+                assert buf.size == repair_blocksize, (buf.size, repair_blocksize)
+                C[node, repair_planes] = buf.reshape(n_rep, sc)
             helpers.add(node)
         helpers |= set(range(self.k, self.k + self.nu))  # shortening zeros
         aloof = {
@@ -470,7 +505,7 @@ class ErasureCodeClay(ErasureCode):
         coder, decode_index = PLAN_CACHE.decode_coder(
             dist, erased_sorted, self.k + self.nu
         )
-        out = np.zeros((self.sub_chunk_no, sc), dtype=np.uint8)
+        out = np.zeros((self.sub_chunk_no, *lead, sc), dtype=np.uint8)
         P, Pinv = self._pft, self._pft_inv
         max_order = int(order[repair_planes].max())
         min_order = int(order[repair_planes].min())
@@ -532,13 +567,15 @@ class ErasureCodeClay(ErasureCode):
                             U[node, zs] = _gf_scale(int(P[1, 1]), cs) ^ _gf_scale(
                                 int(P[1, 0]), cp
                             )
-            # 2. batched inner MDS decode for erased U's.
+            # 2. batched inner MDS decode for erased U's: (|planes|[, S],
+            # k+nu, sc) — contraction axis at -2, stripes ride as a
+            # leading batch dim.
             survivors = U[decode_index][:, planes]
             rec = np.asarray(
-                coder(np.ascontiguousarray(survivors.transpose(1, 0, 2)))
+                coder(np.ascontiguousarray(np.moveaxis(survivors, 0, -2)))
             )
             for p, e in enumerate(erased_sorted):
-                U[e, planes] = rec[:, p]
+                U[e, planes] = rec[..., p, :]
             # 3. recover lost C sub-chunks: the dot (plane itself) plus the
             # shifted partners via helpers in the lost row.
             out[planes] = U[lost, planes]  # dot: repair planes have
@@ -567,4 +604,7 @@ class ErasureCodeClay(ErasureCode):
                         gf_inv(int(P[1, 0])), us ^ _gf_scale(int(P[1, 1]), cs)
                     )
                     out[z_sw] = ca
+        if lead:
+            # plane-major (sub_chunk_no, S, sc) -> per-stripe chunks
+            return {lost_ext: out.transpose(1, 0, 2).reshape(*lead, -1)}
         return {lost_ext: out.reshape(-1)}

@@ -79,12 +79,21 @@ class _DeviceCoder:
     erasure pattern on small chunks stay on the shared kernel.
     """
 
-    __slots__ = ("bm", "plan", "packed")
+    __slots__ = ("bm", "plan", "packed", "decode")
 
-    def __init__(self, bm: jnp.ndarray, plan: CodingPlan | None, packed: PackedPlan):
+    def __init__(
+        self,
+        bm: jnp.ndarray,
+        plan: CodingPlan | None,
+        packed: PackedPlan,
+        decode: bool = False,
+    ):
         self.bm = bm
         self.plan = plan
         self.packed = packed
+        # decode-kind coders (built from PLAN_CACHE.decode_coder/lru_coder)
+        # also count their dispatches on ops.dispatch.DECODE_LAUNCHES
+        self.decode = decode
 
     def __call__(self, data: jnp.ndarray, out=None) -> jnp.ndarray:
         if self.plan is not None and data.shape[-1] % 128 == 0:
@@ -92,7 +101,11 @@ class _DeviceCoder:
         if int(np.prod(data.shape)) >= PACKED_MIN_BYTES:
             return self.packed(data, out=out)
         lead = data.shape[:-2]
-        record_launch(int(np.prod(lead)) if lead else 1, int(np.prod(data.shape)))
+        record_launch(
+            int(np.prod(lead)) if lead else 1,
+            int(np.prod(data.shape)),
+            decode=self.decode,
+        )
         return xor_matmul(self.bm, data)
 
 
@@ -115,9 +128,11 @@ class _GlobalPlanCache:
         self._hits = 0
         self._misses = 0
 
-    def _make_coder(self, gf_rows: np.ndarray, bm: jnp.ndarray) -> _DeviceCoder:
-        plan = CodingPlan(gf_rows) if _on_tpu() else None
-        return _DeviceCoder(bm, plan, PackedPlan(gf_rows))
+    def _make_coder(
+        self, gf_rows: np.ndarray, bm: jnp.ndarray, decode: bool = False
+    ) -> _DeviceCoder:
+        plan = CodingPlan(gf_rows, decode=decode) if _on_tpu() else None
+        return _DeviceCoder(bm, plan, PackedPlan(gf_rows, decode=decode), decode=decode)
 
     def stats(self) -> dict[str, int]:
         """Coder-cache hit/miss totals (encode + decode lookups)."""
@@ -178,7 +193,7 @@ class _GlobalPlanCache:
                 self._decode_coders.move_to_end(key)
                 return coder
             self._misses += 1
-        coder = self._make_coder(matrix, self.lru_bit_matrix(matrix))
+        coder = self._make_coder(matrix, self.lru_bit_matrix(matrix), decode=True)
         if _trace_local(coder.bm):
             return coder
         with self._lock:
@@ -316,7 +331,7 @@ class _GlobalPlanCache:
                 self._decode_coders.move_to_end(key)
                 return coder, decode_index
             self._misses += 1
-        coder = self._make_coder(c, bitmat)  # built outside the lock
+        coder = self._make_coder(c, bitmat, decode=True)  # built outside the lock
         if _trace_local(coder.bm):
             return coder, decode_index
         with self._lock:
@@ -332,16 +347,18 @@ def _next_pow2(n: int) -> int:
 
 
 class AggTicket:
-    """One submitted stripe-batch encode awaiting an aggregated launch.
+    """One submitted stripe-batch coding launch awaiting aggregation.
 
-    Resolves to this submission's (stripes, m, L) parity.  Duck-types the
-    surface PendingEncode expects of a live device array: `is_ready()` for
-    non-blocking polls and `__array__` for materialization (np.asarray on
-    a ticket forces its group's launch and blocks until it finishes)."""
+    Resolves to this submission's (stripes, rows, L) output — parity for
+    an encode submission, reconstructed chunks for a decode submission.
+    Duck-types the surface PendingEncode/PendingDecode expect of a live
+    device array: `is_ready()` for non-blocking polls and `__array__` for
+    materialization (np.asarray on a ticket forces its group's launch and
+    blocks until it finishes)."""
 
     __slots__ = ("_agg", "_group", "_start", "_stripes", "_value")
 
-    def __init__(self, agg: "EncodeAggregator", group: "_AggGroup", start: int, stripes: int):
+    def __init__(self, agg: "LaunchAggregator", group: "_AggGroup", start: int, stripes: int):
         self._agg = agg
         self._group = group
         self._start = start
@@ -381,13 +398,14 @@ class _AggGroup:
     the unit that concatenates into a single padded device launch."""
 
     __slots__ = (
-        "key", "ec", "arrays", "tickets", "stripes", "nbytes",
+        "key", "ec", "ctx", "arrays", "tickets", "stripes", "nbytes",
         "parity", "host", "pad", "error", "donatable", "lock",
     )
 
-    def __init__(self, key, ec):
+    def __init__(self, key, ec, ctx=None):
         self.key = key
         self.ec = ec
+        self.ctx = ctx  # per-kind dispatch context (decode: erasure tuple)
         self.arrays: list[np.ndarray] = []
         self.tickets: list[AggTicket] = []
         self.stripes = 0
@@ -404,29 +422,33 @@ class _AggGroup:
         self.lock = threading.RLock()
 
 
-class EncodeAggregator:
-    """Cross-write launch aggregation: coalesce concurrent small stripe
-    encodes (different ops, PGs, objects) into one padded device launch.
+class LaunchAggregator:
+    """Cross-op launch aggregation: coalesce concurrent small stripe-batch
+    coding calls (different ops, PGs, objects) into one padded device
+    launch.  Shared machinery of the encode and decode aggregators; the
+    subclasses supply the group key and the device dispatch.
 
     The storage-side analog of a training stack's bucketed all-reduce:
     per-op launches under ~1 MiB are dominated by dispatch overhead, so
     submissions queue in per-geometry groups and launch together when the
-    window fills (`ec_tpu_aggregate_window` submissions), the byte budget
-    trips (`ec_tpu_aggregate_max_bytes`), or a barrier drains the window
-    (ECBackend.flush_encodes — the commit barrier — or any ticket reap).
+    window fills, the byte budget trips, or a barrier drains the window
+    (ECBackend.flush_encodes / flush_decodes — or any ticket reap).
     window <= 1 launches every submission immediately (aggregation off,
     metrics still recorded).
 
     In aggregating mode, stripe counts are padded to a bounded bucket set
     (power of two up to 64, then multiples of 64 — capped waste, unlike
     pure pow2) so the jit cache sees few geometries and the donation pool
-    can recycle parity buffers across launches (see docs/PERFORMANCE.md
+    can recycle output buffers across launches (see docs/PERFORMANCE.md
     for the donation caveats).  Tickets slice their own stripes back out,
     in submission order.
 
     Occupancy and launch-size distributions are PerfHistograms on
     `self.perf`, exportable through the PR-1 prometheus layer
     (PerfCountersCollection.add(agg.perf))."""
+
+    PERF_NAME = "ec_aggregator"
+    WHAT = "encode"  # used in error reports
 
     def __init__(self, window: int = 0, max_bytes: int = 64 << 20, pad_pow2: bool = True):
         from ceph_tpu.common.perf_counters import PerfCountersBuilder
@@ -438,8 +460,8 @@ class EncodeAggregator:
         # inside the lock; lockdep's DebugLock is not reentrant
         self._lock = threading.RLock()
         self._groups: "OrderedDict[tuple, _AggGroup]" = OrderedDict()
-        self._donate_pool: dict[tuple, object] = {}  # shape -> dead parity buf
-        b = PerfCountersBuilder("ec_aggregator")
+        self._donate_pool: dict[tuple, object] = {}  # shape -> dead output buf
+        b = PerfCountersBuilder(self.PERF_NAME)
         for c in ("submits", "launches", "flush_window", "flush_bytes",
                   "flush_explicit", "flush_immediate", "flush_reap",
                   "pad_stripes"):
@@ -457,25 +479,36 @@ class EncodeAggregator:
 
     def configure(self, window: int | None = None, max_bytes: int | None = None) -> None:
         """Apply live config (the OSD wires its Config + runtime observers
-        here, so `ec_tpu_aggregate_*` settings reach the shared instance)."""
+        here, so the aggregate_* settings reach the shared instance)."""
         if window is not None:
             self.window = int(window)
         if max_bytes is not None:
             self.max_bytes = int(max_bytes)
 
+    # -- subclass hooks ------------------------------------------------------
+
+    def _dispatch(self, g: _AggGroup, data: np.ndarray, donate):
+        raise NotImplementedError
+
+    def _out_shape(self, g: _AggGroup, data_shape) -> tuple:
+        raise NotImplementedError
+
+    def _donate_ok(self, g: _AggGroup, data_shape) -> bool:
+        raise NotImplementedError
+
     # -- submission ----------------------------------------------------------
 
-    def submit(self, ec: "MatrixCodecMixin", shaped: np.ndarray) -> AggTicket:
-        """Queue one (stripes, k, L) uint8 encode; returns its ticket.
-        May launch (this or earlier submissions) when a threshold trips."""
-        stripes, _k, L = shaped.shape
-        key = (ec.distribution_matrix().tobytes(), L)
+    def _submit(self, key, ec, ctx, shaped: np.ndarray) -> AggTicket:
+        """Queue one (stripes, k, L) uint8 batch under `key`; returns its
+        ticket.  May launch (this or earlier submissions) when a threshold
+        trips."""
+        stripes = shaped.shape[0]
         reason = None
         with self._lock:
             self.perf.inc("submits")
             g = self._groups.get(key)
             if g is None:
-                g = self._groups[key] = _AggGroup(key, ec)
+                g = self._groups[key] = _AggGroup(key, ec, ctx)
             ticket = AggTicket(self, g, g.stripes, stripes)
             g.arrays.append(shaped)
             g.tickets.append(ticket)
@@ -546,23 +579,18 @@ class EncodeAggregator:
                 data = np.concatenate(
                     [data, np.zeros((pad, *data.shape[1:]), dtype=np.uint8)]
                 )
-            out_shape = (
-                data.shape[0],
-                g.ec.get_chunk_count() - data.shape[1],
-                data.shape[2],
-            )
+            out_shape = self._out_shape(g, data.shape)
             # the donation pool only pays off when the coder's dispatch
             # will actually consume the donated buffer (the packed jnp
             # path); on e.g. the Pallas path pooling would just hold dead
             # device memory an extra launch
-            check = getattr(g.ec, "encode_donatable", None)
-            g.donatable = bool(check(data.shape)) if check is not None else False
+            g.donatable = self._donate_ok(g, data.shape)
             donate = None
             if g.donatable:
                 with self._lock:
                     donate = self._donate_pool.pop(out_shape, None)
             try:
-                parity = g.ec.encode_array(data, out=donate)
+                parity = self._dispatch(g, data, donate)
             except BaseException as e:
                 # sticky: every co-rider's reap reports the launch failure
                 # instead of crashing on a half-torn group
@@ -599,7 +627,9 @@ class EncodeAggregator:
                 except Exception:
                     pass  # reported as EcError via g.error below
             if g.error is not None:
-                raise EcError(EIO, f"aggregated encode launch failed: {g.error!r}")
+                raise EcError(
+                    EIO, f"aggregated {self.WHAT} launch failed: {g.error!r}"
+                )
             if g.host is None:
                 parity = g.parity
                 if len(g.tickets) == 1 and not g.pad:
@@ -621,6 +651,76 @@ class EncodeAggregator:
         ticket._value = g.host[ticket._start : ticket._start + ticket._stripes]
 
 
+class EncodeAggregator(LaunchAggregator):
+    """Cross-write launch aggregation: concurrent stripe encodes of one
+    (matrix, chunk-size) geometry coalesce into one padded device launch
+    (knobs `ec_tpu_aggregate_window` / `ec_tpu_aggregate_max_bytes`)."""
+
+    PERF_NAME = "ec_aggregator"
+    WHAT = "encode"
+
+    def submit(self, ec: "MatrixCodecMixin", shaped: np.ndarray) -> AggTicket:
+        """Queue one (stripes, k, L) uint8 encode; returns its ticket."""
+        return self._submit(
+            (ec.distribution_matrix().tobytes(), shaped.shape[-1]), ec, None, shaped
+        )
+
+    def _dispatch(self, g: _AggGroup, data: np.ndarray, donate):
+        return g.ec.encode_array(data, out=donate)
+
+    def _out_shape(self, g: _AggGroup, data_shape) -> tuple:
+        return (
+            data_shape[0],
+            g.ec.get_chunk_count() - data_shape[1],
+            data_shape[2],
+        )
+
+    def _donate_ok(self, g: _AggGroup, data_shape) -> bool:
+        check = getattr(g.ec, "encode_donatable", None)
+        return bool(check(data_shape)) if check is not None else False
+
+
+class DecodeAggregator(LaunchAggregator):
+    """Cross-op DECODE launch aggregation — the recovery/degraded-read
+    twin of EncodeAggregator (knobs `ec_tpu_decode_aggregate_window` /
+    `ec_tpu_decode_aggregate_max_bytes`).
+
+    Submissions are (stripes, k, L) survivor batches in decode_index
+    order, keyed by the cached decode-plan signature + chunk length: the
+    common case during recovery/backfill is ONE erasure pattern repeating
+    across every object in the PG, so per-object decodes coalesce into
+    one padded launch exactly like concurrent writes do on the encode
+    side.  Tickets resolve to (stripes, len(erasures), L) reconstructed
+    chunks, rows in erasure order; a failed launch is sticky on its group
+    and reported at every co-rider's reap."""
+
+    PERF_NAME = "ec_decode_aggregator"
+    WHAT = "decode"
+
+    def submit(
+        self, ec: "MatrixCodecMixin", erasures: list[int], survivors: np.ndarray
+    ) -> AggTicket:
+        """Queue one (stripes, k, L) uint8 survivor batch (decode_index
+        order); returns its ticket.  Co-riders share a group only when
+        their decode-plan signature matches, so every ticket in a group
+        agrees on the erasure row order."""
+        erasures = list(erasures)
+        key = PLAN_CACHE._decode_key(
+            ec.distribution_matrix(), erasures, ec.k
+        ) + (survivors.shape[-1],)
+        return self._submit(key, ec, tuple(erasures), survivors)
+
+    def _dispatch(self, g: _AggGroup, data: np.ndarray, donate):
+        return g.ec.decode_array(list(g.ctx), data, out=donate)
+
+    def _out_shape(self, g: _AggGroup, data_shape) -> tuple:
+        return (data_shape[0], len(g.ctx), data_shape[2])
+
+    def _donate_ok(self, g: _AggGroup, data_shape) -> bool:
+        check = getattr(g.ec, "decode_donatable", None)
+        return bool(check(list(g.ctx), data_shape)) if check is not None else False
+
+
 _DEFAULT_AGGREGATOR: EncodeAggregator | None = None
 
 
@@ -638,6 +738,24 @@ def default_encode_aggregator() -> EncodeAggregator:
             max_bytes=int(OPTIONS["ec_tpu_aggregate_max_bytes"].default),
         )
     return _DEFAULT_AGGREGATOR
+
+
+_DEFAULT_DECODE_AGGREGATOR: DecodeAggregator | None = None
+
+
+def default_decode_aggregator() -> DecodeAggregator:
+    """Process-wide decode aggregator shared by every ECBackend that isn't
+    handed its own, so recovery/degraded-read decodes coalesce ACROSS PGs
+    on one OSD (the backfill case: one erasure pattern, many objects)."""
+    global _DEFAULT_DECODE_AGGREGATOR
+    if _DEFAULT_DECODE_AGGREGATOR is None:
+        from ceph_tpu.common.options import OPTIONS
+
+        _DEFAULT_DECODE_AGGREGATOR = DecodeAggregator(
+            window=int(OPTIONS["ec_tpu_decode_aggregate_window"].default),
+            max_bytes=int(OPTIONS["ec_tpu_decode_aggregate_max_bytes"].default),
+        )
+    return _DEFAULT_DECODE_AGGREGATOR
 
 
 class EncodePipeline:
@@ -770,10 +888,28 @@ class MatrixCodecMixin:
         coder = PLAN_CACHE.encode_coder(mat[self.k :])
         return not (coder.plan is not None and data_shape[-1] % 128 == 0)
 
-    def decode_array(self, erasures: list[int], survivors) -> jnp.ndarray:
-        """survivors (..., k, L) in decode_index order -> (..., nerrs, L)."""
+    def decode_array(self, erasures: list[int], survivors, out=None) -> jnp.ndarray:
+        """survivors (..., k, L) in decode_index order -> (..., nerrs, L).
+
+        The decode twin of encode_array: dispatches through the cached
+        erasure-pattern _DeviceCoder (Pallas on TPU-aligned chunks, packed
+        planes for bulk work, bitsliced matmul for small one-off
+        patterns).  `out`: optional dead device buffer of the
+        reconstruction's shape, donated into the packed kernel so
+        recurring aggregated recovery launches reuse the allocation."""
         coder, _ = PLAN_CACHE.decode_coder(self.distribution_matrix(), erasures, self.k)
-        return coder(jnp.asarray(survivors))
+        return coder(jnp.asarray(survivors), out=out)
+
+    def decode_donatable(self, erasures: list[int], data_shape) -> bool:
+        """True when decode_array(erasures, data, out=...) at this input
+        shape will actually consume a donated output buffer — the decode
+        twin of encode_donatable, gating the DecodeAggregator's pool."""
+        if int(np.prod(data_shape)) < PACKED_MIN_BYTES:
+            return False
+        coder, _ = PLAN_CACHE.decode_coder(
+            self.distribution_matrix(), list(erasures), self.k
+        )
+        return not (coder.plan is not None and data_shape[-1] % 128 == 0)
 
     def decode_index(self, erasures: list[int]) -> list[int]:
         _, idx = PLAN_CACHE.decode_plan(self.distribution_matrix(), erasures, self.k)
@@ -833,15 +969,11 @@ class MatrixCodecMixin:
             raise EcError(EIO, f"{len(erasures)} erasures > m={m}")
         if self._use_xor_decode(erasures):
             sources = [i for i in range(k + m) if raw_of(i) in chunks][:k]
-            stack = np.stack(
-                [np.asarray(decoded[raw_of(i)], dtype=np.uint8) for i in sources]
-            )
+            stack = np.stack([self._as_u8(decoded[raw_of(i)]) for i in sources])
             np.copyto(decoded[raw_of(erasures[0])], np.asarray(xor_reduce(stack)))
             return
         idx = self.decode_index(erasures)
-        survivors = np.stack(
-            [np.asarray(decoded[raw_of(i)], dtype=np.uint8) for i in idx]
-        )
+        survivors = np.stack([self._as_u8(decoded[raw_of(i)]) for i in idx])
         rec = np.asarray(self.decode_array(erasures, survivors))
         for p, e in enumerate(erasures):
             np.copyto(decoded[raw_of(e)], rec[p])

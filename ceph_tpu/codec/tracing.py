@@ -77,10 +77,10 @@ def instrument_codec(ec, plugin: str):
     if hasattr(ec, "decode_array"):
         orig_decode_array = ec.decode_array
 
-        def decode_array(erasures, survivors):
+        def decode_array(erasures, survivors, out=None):
             parent = active_span()
             if parent is None:
-                return orig_decode_array(erasures, survivors)
+                return orig_decode_array(erasures, survivors, out=out)
             import jax.numpy as jnp
 
             with parent.child(f"codec:{plugin}:decode") as sp:
@@ -88,7 +88,7 @@ def instrument_codec(ec, plugin: str):
                 with sp.child("h2d"):
                     dev = jnp.asarray(survivors)
                 with sp.child("kernel_launch"):
-                    return orig_decode_array(erasures, dev)
+                    return orig_decode_array(erasures, dev, out=out)
 
         ec.decode_array = decode_array
 

@@ -126,6 +126,34 @@ OPTIONS: dict[str, Option] = _opts(
         see_also=("ec_tpu_aggregate_window",),
         runtime=True,
     ),
+    Option(
+        "ec_tpu_decode_aggregate_window",
+        int,
+        0,
+        A,
+        "EC decode launch aggregation window: recovery/degraded-read "
+        "decodes of one (decode-matrix, chunk-size) signature held before "
+        "a coalesced device launch (codec/matrix_codec.py "
+        "DecodeAggregator).  <= 1 launches every submission immediately.  "
+        "Recovery drains its decode pipeline at every barrier, so a value "
+        "up to the decode queue depth trades no correctness, only launch "
+        "count during backfill/recovery",
+        see_also=("ec_tpu_decode_aggregate_max_bytes",
+                  "ec_tpu_aggregate_window"),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_decode_aggregate_max_bytes",
+        int,
+        64 << 20,
+        A,
+        "survivor-byte budget per decode aggregation group: a group "
+        "launches as soon as its queued survivor bytes reach this, "
+        "whatever the window (bounds device memory held by deferred "
+        "recovery decodes)",
+        see_also=("ec_tpu_decode_aggregate_window",),
+        runtime=True,
+    ),
     # --- OSD ----------------------------------------------------------------
     Option("osd_recovery_max_chunk", int, 8 << 20, A,
            "max recovery push size; rounded to stripe (ECBackend.h:206)"),

@@ -3,11 +3,12 @@ XOR-matmul reference paths (xor_mm), the Pallas TPU kernel (pallas_gf),
 and the device-launch accounting tests batch-invariants against
 (dispatch)."""
 
-from .dispatch import LAUNCHES, record_launch
+from .dispatch import DECODE_LAUNCHES, LAUNCHES, record_launch
 from .packed_gf import PackedPlan, plane_schedule
 from .xor_mm import as_device_bit_matrix, encode_full, xor_matmul, xor_reduce
 
 __all__ = [
+    "DECODE_LAUNCHES",
     "LAUNCHES",
     "PackedPlan",
     "as_device_bit_matrix",

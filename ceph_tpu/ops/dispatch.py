@@ -1,11 +1,15 @@
 """Device-launch accounting for the coding hot path.
 
-One counter, incremented exactly once per host->device kernel dispatch by
+Two counters, incremented exactly once per host->device kernel dispatch by
 the lowest-level python wrapper of each coding path (PackedPlan, the
-Pallas CodingPlan, the jnp bitsliced fallback, xor_reduce).  Tests assert
-batching invariants against it — "encoding N stripes cost 1 dispatch" —
-so a regression back to per-stripe launches fails tier-1 instead of only
-showing up as a bench number (ISSUE 3 launch-counter contract).
+Pallas CodingPlan, the jnp bitsliced fallback, xor_reduce): `LAUNCHES`
+totals every coding dispatch, `DECODE_LAUNCHES` additionally totals the
+dispatches issued on behalf of a decode (recovery / degraded read).
+Tests assert batching invariants against them — "encoding N stripes cost
+1 dispatch", "recovering N same-pattern objects cost O(1) decode
+dispatches" — so a regression back to per-stripe launches fails tier-1
+instead of only showing up as a bench number (ISSUE 3 / ISSUE 5
+launch-counter contracts).
 
 Caveat: counting happens at python dispatch time.  A coding call traced
 inside an OUTER jax.jit (bench.py's serial chain) runs the wrapper once
@@ -54,8 +58,19 @@ class LaunchCounter:
 
 LAUNCHES = LaunchCounter()
 
+# Decode-only dispatches (recovery / degraded reads).  Every decode
+# dispatch is counted here AND in LAUNCHES: LAUNCHES stays the
+# process-wide total every existing invariant is written against, while
+# this counter isolates the read/recovery half so "N objects recovered
+# in one window = O(1) decode launches" is assertable on its own.
+DECODE_LAUNCHES = LaunchCounter()
 
-def record_launch(stripes: int, nbytes: int) -> None:
+
+def record_launch(stripes: int, nbytes: int, decode: bool = False) -> None:
     """Record one device dispatch carrying `stripes` stripes / `nbytes`
-    input bytes on the global counter."""
+    input bytes on the global counter(s).  `decode=True` marks a dispatch
+    issued on behalf of a decode (the coder's kind, threaded down from
+    PLAN_CACHE.decode_coder) so it also lands on DECODE_LAUNCHES."""
     LAUNCHES.record(stripes, nbytes)
+    if decode:
+        DECODE_LAUNCHES.record(stripes, nbytes)

@@ -137,12 +137,15 @@ class PackedPlan:
     (pure jnp), no chunk-length alignment constraint, and the dispatch
     unit the launch counter observes."""
 
-    __slots__ = ("k", "m", "sched")
+    __slots__ = ("k", "m", "sched", "decode")
 
-    def __init__(self, gf_matrix: np.ndarray):
+    def __init__(self, gf_matrix: np.ndarray, decode: bool = False):
         gfm = np.asarray(gf_matrix, dtype=np.uint8)
         self.m, self.k = gfm.shape
         self.sched = plane_schedule(gfm)
+        # decode-kind plans additionally count on DECODE_LAUNCHES so
+        # recovery batching invariants are assertable on their own
+        self.decode = decode
 
     def _stripes(self, shape) -> int:
         lead = shape[:-2]
@@ -153,7 +156,9 @@ class PackedPlan:
 
         `out`: optional donated device buffer of the result shape (see
         _packed_code_into); ignored when the shape/dtype does not match."""
-        record_launch(self._stripes(data.shape), int(np.prod(data.shape)))
+        record_launch(
+            self._stripes(data.shape), int(np.prod(data.shape)), decode=self.decode
+        )
         kw = dict(sched=self.sched, k=self.k, m=self.m)
         want_shape = (*data.shape[:-2], self.m, data.shape[-1])
         if (

@@ -178,7 +178,9 @@ class CodingPlan:
     fall back to the jnp bitsliced matmul.
     """
 
-    def __init__(self, gf_matrix: np.ndarray, *, interpret: bool = False):
+    def __init__(
+        self, gf_matrix: np.ndarray, *, interpret: bool = False, decode: bool = False
+    ):
         gf_matrix = np.asarray(gf_matrix, dtype=np.uint8)
         self.m, self.k = gf_matrix.shape
         self.interpret = interpret
@@ -186,6 +188,7 @@ class CodingPlan:
         self.bm = jnp.asarray(expand_matrix(gf_matrix), dtype=jnp.uint8)
         self._gf = gf_matrix
         self._packed = None  # lazy packed-plane fallback for unaligned L
+        self.decode = decode  # decode-kind plans also count DECODE_LAUNCHES
 
     def __call__(self, data: jax.Array) -> jax.Array:
         """(..., k, L) uint8 -> (..., m, L) uint8 coded output."""
@@ -201,12 +204,12 @@ class CodingPlan:
 
             if int(np.prod(data.shape)) >= PACKED_MIN_BYTES:
                 if self._packed is None:
-                    self._packed = PackedPlan(self._gf)
+                    self._packed = PackedPlan(self._gf, decode=self.decode)
                 return self._packed(data)
-            record_launch(stripes, int(np.prod(data.shape)))
+            record_launch(stripes, int(np.prod(data.shape)), decode=self.decode)
             return xor_matmul(self.bm, data)
         rows, cols = geom
-        record_launch(stripes, int(np.prod(data.shape)))
+        record_launch(stripes, int(np.prod(data.shape)), decode=self.decode)
         flat = data.reshape(stripes, k, L)
         out = _gf_code_swar(
             flat,

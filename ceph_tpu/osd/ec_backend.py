@@ -128,13 +128,18 @@ class ReadOp:
 
 RECOVERY_IDLE = "IDLE"
 RECOVERY_READING = "READING"
+RECOVERY_DECODING = "DECODING"
 RECOVERY_WRITING = "WRITING"
 RECOVERY_COMPLETE = "COMPLETE"
 
 
 @dataclass
 class RecoveryOp:
-    """ECBackend::RecoveryOp (ECBackend.h:249-289)."""
+    """ECBackend::RecoveryOp (ECBackend.h:249-289), extended with a
+    DECODING stage: the device decode is LAUNCHED (or aggregator-windowed)
+    when the reads complete, and the pushes fan out when the decode
+    pipeline reaps it — so multiple in-flight objects' decodes share one
+    aggregated launch during recovery/backfill."""
 
     oid: str
     missing_on: set[int]  # shard indices to rebuild
@@ -143,6 +148,10 @@ class RecoveryOp:
     shard_data: dict[int, bytes] = field(default_factory=dict)
     attrs: dict[str, bytes] = field(default_factory=dict)
     pending_pushes: set[int] = field(default_factory=set)
+    # LAUNCHED device decode awaiting reap (stripe.PendingDecode)
+    pending_decode: object | None = None
+    decode_polls: int = 0
+    decode_t0: float = 0.0  # launch time; reap samples ec_decode_latency
     trace: object = field(default_factory=lambda: null_span())  # ec:recover
 
 
@@ -158,6 +167,7 @@ class ECBackend(PGBackend):
         allows_overwrites: bool = False,
         fast_read: bool = False,
         aggregator=None,
+        decode_aggregator=None,
     ):
         super().__init__(listener, store)
         self.ec = ec
@@ -169,10 +179,21 @@ class ECBackend(PGBackend):
         # this OSD coalesce into one padded device launch (the bucketed
         # all-reduce analog; window knobs in common/options.py).  The
         # commit barrier (flush_encodes) and the pipe drain flush it.
-        from ..codec.matrix_codec import default_encode_aggregator
+        from ..codec.matrix_codec import (
+            default_decode_aggregator,
+            default_encode_aggregator,
+        )
 
         self.encode_aggregator = (
             aggregator if aggregator is not None else default_encode_aggregator()
+        )
+        # Decode twin: recovery / degraded-read decodes from different
+        # PGs coalesce per erasure-pattern signature (the backfill case —
+        # one pattern, many objects; ec_tpu_decode_aggregate_* knobs).
+        self.decode_aggregator = (
+            decode_aggregator
+            if decode_aggregator is not None
+            else default_decode_aggregator()
         )
         self.extent_cache = ExtentCache()
         self._tid = 0
@@ -190,6 +211,13 @@ class ECBackend(PGBackend):
         # encode_depth (the AIO queue-depth analog).
         self._encode_pipe: list[Op] = []
         self.encode_depth = 8
+        # Decode pipeline: RecoveryOps whose device decode is LAUNCHED (or
+        # windowed in the decode aggregator) but whose pushes have not
+        # fanned out yet.  _continue_recovery reaps FIFO; bounded by
+        # decode_depth — the small window of in-flight RecoveryOps whose
+        # decodes share an aggregated launch.
+        self._decode_pipe: list[RecoveryOp] = []
+        self.decode_depth = 8
 
     # -- helpers -------------------------------------------------------------
 
@@ -502,10 +530,24 @@ class ECBackend(PGBackend):
         Drains the aggregation window first: a commit barrier must launch
         everything still waiting for co-riders.  A failed aggregated
         launch is sticky on its group — each affected op fails cleanly at
-        its own reap below — so the barrier itself never throws."""
+        its own reap below — so the barrier itself never throws.
+
+        Also drains the recovery DECODE pipeline: synchronous harnesses
+        (the test clusters' pump loops) use this as their only barrier,
+        and a windowed recovery decode must never outlive it."""
         self.encode_aggregator.flush()
         while self._encode_pipe:
             self._dispatch_encoded(self._encode_pipe.pop(0))
+        self.flush_decodes()
+
+    def flush_decodes(self) -> None:
+        """Drain the recovery decode pipeline: launch every windowed
+        decode group and reap every in-flight RecoveryOp decode, fanning
+        out its pushes (or failing it cleanly — a failed aggregated
+        decode is sticky on its group and surfaces at each op's reap)."""
+        self.decode_aggregator.flush()
+        while self._decode_pipe:
+            self._finish_recovery_decode(self._decode_pipe[0])
 
     def _dispatch_encoded(self, op: Op) -> None:
         """Reap one launched encode and fan out its sub-writes
@@ -948,12 +990,22 @@ class ECBackend(PGBackend):
         results: dict[str, tuple[int, list[bytes]]] = {}
 
         def reconstruct_all() -> None:
+            # Two-phase: SUBMIT every object's decode as a ticket first,
+            # then materialize.  With the decode window open (window > 1)
+            # same-pattern objects in this ReadOp land in one aggregation
+            # group and the first materialization reaps it as one padded
+            # launch; at the default window (<= 1, immediate mode) each
+            # submission dispatches on its own, exactly like the direct
+            # path always did.
+            launched: dict[str, list] = {}
             for oid, req in rop.requests.items():
                 try:
-                    results[oid] = (
-                        0,
-                        self._reconstruct_object(rop, oid, req, good),
-                    )
+                    launched[oid] = self._launch_reconstruct(rop, oid, req, good)
+                except EcError as e:
+                    results[oid] = (e.errno, [])
+            for oid, pends in launched.items():
+                try:
+                    results[oid] = (0, self._finish_reconstruct(pends))
                 except EcError as e:
                     results[oid] = (e.errno, [])
 
@@ -977,7 +1029,17 @@ class ECBackend(PGBackend):
         self, rop: ReadOp, oid: str, req: ReadRequest, good: set[int]
     ) -> list[bytes]:
         """Decode one object's extents from gathered shard buffers."""
-        out: list[bytes] = []
+        return self._finish_reconstruct(
+            self._launch_reconstruct(rop, oid, req, good)
+        )
+
+    def _launch_reconstruct(
+        self, rop: ReadOp, oid: str, req: ReadRequest, good: set[int]
+    ) -> list[tuple[int, int, int, "stripe_mod.PendingDecode"]]:
+        """SUBMIT one object's extent decodes (tickets via the shared
+        DecodeAggregator) without materializing — phase one of the
+        reconstruct, so concurrent objects coalesce into one launch."""
+        out = []
         for off, ln in req.to_read:
             s_off, s_len = self.sinfo.offset_len_to_stripe_bounds(off, ln)
             c_off, c_len = self._logical_range_to_chunk_extent(s_off, s_len)
@@ -990,8 +1052,26 @@ class ECBackend(PGBackend):
                 if buf is not None:
                     shards[s] = np.frombuffer(buf, dtype=np.uint8)
             if not self._decodable(set(range(self.k)), set(shards)):
+                # drain this object's already-submitted extents: an
+                # abandoned ticket would otherwise ride its group to the
+                # next flush as device work nobody materializes
+                for *_rest, pend in out:
+                    try:
+                        pend.result()
+                    except EcError:
+                        pass
                 raise EcError(EIO, f"cannot reconstruct {oid}")
-            logical = stripe_mod.decode_concat(self.sinfo, self.ec, shards)
+            pend = stripe_mod.decode_concat_launch(
+                self.sinfo, self.ec, shards, aggregator=self.decode_aggregator
+            )
+            out.append((off, ln, s_off, pend))
+        return out
+
+    def _finish_reconstruct(self, launched) -> list[bytes]:
+        """Materialize phase-one tickets into the requested extents."""
+        out: list[bytes] = []
+        for off, ln, s_off, pend in launched:
+            logical = pend.result()
             lo = off - s_off
             out.append(logical[lo : lo + ln].tobytes())
         return out
@@ -1023,7 +1103,14 @@ class ECBackend(PGBackend):
         self._continue_recovery(rec)
 
     def _continue_recovery(self, rec: RecoveryOp) -> None:
-        """continue_recovery_op (ECBackend.cc:591-746)."""
+        """continue_recovery_op (ECBackend.cc:591-746), plus the DECODING
+        stage: reaping a launched (possibly aggregated) device decode and
+        fanning out the pushes.  The decode pipeline keeps a small window
+        of RecoveryOps in this state so concurrent objects' decodes share
+        one padded launch."""
+        if rec.state == RECOVERY_DECODING:
+            self._finish_recovery_decode(rec)
+            return
         if rec.state == RECOVERY_IDLE:
             rec.state = RECOVERY_READING
             avail = self._available_shards(rec.oid)
@@ -1055,8 +1142,9 @@ class ECBackend(PGBackend):
         oi = self.get_object_info(oid)
         if oi is not None:
             return self.sinfo.logical_to_next_stripe_offset(oi.size)
-        # primary itself missing: size discovered from survivor attrs later;
-        # read to the largest shard size among survivors
+        # primary itself missing: size discovered from survivor attrs later.
+        # A survivor shard hosted locally (co-located collections) gives the
+        # exact extent...
         for s in sorted(avail):
             coll = shard_coll(self.listener.pgid, s)
             try:
@@ -1065,10 +1153,22 @@ class ECBackend(PGBackend):
                 )
             except StoreError:
                 continue
-        return self.sinfo.stripe_width
+        # ...otherwise over-ask: shard-side reads clamp to the actual
+        # shard size (handle_sub_read), so a generous stripe-aligned cover
+        # recovers the WHOLE object instead of silently truncating it to
+        # one stripe (multi-stripe objects whose primary lost its shard).
+        return self.sinfo.logical_to_next_stripe_offset(1 << 30)
 
     def _handle_recovery_read_complete(self, rec: RecoveryOp, rop: ReadOp) -> None:
-        """Decode missing shards, then push (ECBackend.cc:435-501)."""
+        """LAUNCH the decode of the missing shards (ECBackend.cc:435-501).
+
+        The bulk matrix path submits the decode to the shared
+        DecodeAggregator as a ticket and parks the RecoveryOp on the
+        decode pipeline (state DECODING) instead of blocking — concurrent
+        objects with the same erasure pattern share one padded launch;
+        pushes fan out at the reap (_finish_recovery_decode).  The CLAY
+        fragmented path is one batched (stripes, ...) launch already and
+        completes inline."""
         sub_count = self.ec.get_sub_chunk_count()
         have: dict[int, np.ndarray] = {}
         fragmented = False
@@ -1076,8 +1176,14 @@ class ECBackend(PGBackend):
             exts = per_oid.get(rec.oid)
             if not exts or rop.errors.get(s):
                 continue
-            buf = b"".join(data for _off, data in exts)
-            have[s] = np.frombuffer(buf, dtype=np.uint8)
+            if len(exts) == 1:
+                # common whole-shard single-extent reply: wrap the payload
+                # zero-copy (np.stack in the decode gather pays the one
+                # unavoidable copy)
+                have[s] = np.frombuffer(exts[0][1], dtype=np.uint8)
+            else:
+                buf = b"".join(data for _off, data in exts)
+                have[s] = np.frombuffer(buf, dtype=np.uint8)
             runs = [tuple(r) for r in rop.subchunks.get(s, [(0, sub_count)])]
             if runs != [(0, sub_count)]:
                 fragmented = True
@@ -1086,24 +1192,22 @@ class ECBackend(PGBackend):
         t0 = time.monotonic()
         try:
             if fragmented:
-                # CLAY repair: helpers supplied, per stripe-chunk, the
-                # concatenated repair-plane fragments; decode stripe by
-                # stripe with the true chunk size.
-                cs = self.sinfo.chunk_size
-                stripes = self._full_shard_len(rec) // cs
-                rebuilt = {s: b"" for s in want}
-                for s_idx in range(stripes):
-                    frag_chunks = {}
-                    for s, arr in have.items():
-                        frag = arr.size // stripes
-                        frag_chunks[s] = arr[s_idx * frag : (s_idx + 1) * frag]
-                    decoded = self.ec.decode(want, frag_chunks, chunk_size=cs)
-                    for s in want:
-                        rebuilt[s] += np.asarray(decoded[s]).tobytes()
+                rebuilt = self._decode_fragmented(rec, have, want)
             else:
                 with tracer_mod.span_scope(rec.trace):
-                    decoded = stripe_mod.decode_shards(self.sinfo, self.ec, have, want)
-                rebuilt = {s: np.asarray(decoded[s]).tobytes() for s in want}
+                    rec.pending_decode = stripe_mod.decode_shards_launch(
+                        self.sinfo, self.ec, have, want,
+                        aggregator=self.decode_aggregator,
+                    )
+                rec.decode_t0 = t0
+                rec.state = RECOVERY_DECODING
+                rec.trace.event("decode launched")
+                self._decode_pipe.append(rec)
+                # Backpressure: past the window, reap the head (blocking).
+                while len(self._decode_pipe) > self.decode_depth:
+                    self._finish_recovery_decode(self._decode_pipe[0])
+                self._schedule_decode_drain()
+                return
             self._perf_hist("ec_decode_latency", time.monotonic() - t0)
         except (EcError, KeyError) as e:
             del self.recovery_ops[rec.oid]
@@ -1112,6 +1216,110 @@ class ECBackend(PGBackend):
             rec.on_complete(getattr(e, "errno", -EIO))
             return
         rec.shard_data = rebuilt
+        self._push_recovered(rec)
+
+    def _decode_fragmented(
+        self, rec: RecoveryOp, have: dict[int, np.ndarray], want: set[int]
+    ) -> dict[int, bytes]:
+        """CLAY repair: helpers supplied, per stripe-chunk, the
+        concatenated repair-plane fragments; rebuild with the true chunk
+        size.  One batched (stripes, helpers, frag) launch when the codec
+        vectorizes fragment repair; the per-stripe loop stays as the
+        fallback for codecs (or plans) that don't."""
+        cs = self.sinfo.chunk_size
+        stripes = self._full_shard_len(rec) // cs
+        batch = getattr(self.ec, "decode_fragments_batch", None)
+        if (
+            batch is not None
+            and stripes > 0
+            and all(arr.size % stripes == 0 for arr in have.values())
+        ):
+            frags = {
+                s: arr.reshape(stripes, arr.size // stripes)
+                for s, arr in have.items()
+            }
+            try:
+                with tracer_mod.span_scope(rec.trace):
+                    decoded = batch(want, frags, cs)
+                return {
+                    s: np.ascontiguousarray(decoded[s]).tobytes() for s in want
+                }
+            except EcError:
+                pass  # not a batchable repair plan: per-stripe fallback
+        pieces: dict[int, list[bytes]] = {s: [] for s in want}
+        for s_idx in range(stripes):
+            frag_chunks = {}
+            for s, arr in have.items():
+                frag = arr.size // stripes
+                frag_chunks[s] = arr[s_idx * frag : (s_idx + 1) * frag]
+            decoded = self.ec.decode(want, frag_chunks, chunk_size=cs)
+            for s in want:
+                pieces[s].append(np.asarray(decoded[s]).tobytes())
+        # join once: += bytes concatenation is O(n^2) in stripe count
+        return {s: b"".join(pieces[s]) for s in want}
+
+    def _finish_recovery_decode(self, rec: RecoveryOp) -> None:
+        """Reap one launched recovery decode and fan out its pushes (the
+        completion half of the DECODING stage).  A failed (aggregated)
+        launch surfaces here, at the op that owns the ticket."""
+        if rec in self._decode_pipe:
+            self._decode_pipe.remove(rec)
+        want = set(rec.missing_on)
+        try:
+            with tracer_mod.span_scope(rec.trace):
+                decoded = rec.pending_decode.result()
+            rebuilt = {s: np.asarray(decoded[s]).tobytes() for s in want}
+        except (EcError, KeyError) as e:
+            del self.recovery_ops[rec.oid]
+            rec.pending_decode = None
+            rec.trace.event(f"decode failed ({e})")
+            rec.trace.finish()
+            rec.on_complete(getattr(e, "errno", -EIO))
+            return
+        rec.pending_decode = None
+        if rec.decode_t0:
+            self._perf_hist("ec_decode_latency", time.monotonic() - rec.decode_t0)
+        rec.shard_data = rebuilt
+        self._push_recovered(rec)
+
+    def _schedule_decode_drain(self) -> None:
+        """Reap finished recovery decodes from a running event loop;
+        without one (synchronous harnesses) the barrier drains via
+        flush_decodes()."""
+        if not self._decode_pipe:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        loop.call_soon(self._drain_decode_pipe)
+
+    def _drain_decode_pipe(self) -> None:
+        """Push out every RecoveryOp whose decode finished, strictly FIFO.
+        A head still windowed/computing gets the same re-poll grace as the
+        encode pipe (~100 ms for same-pattern co-riders to arrive), then
+        the window is drained — no amount of polling launches a windowed
+        decode."""
+        while self._decode_pipe:
+            rec = self._decode_pipe[0]
+            pend = rec.pending_decode
+            if not pend.launched() and rec.decode_polls >= 50:
+                self.decode_aggregator.flush()
+            if not pend.ready() and rec.decode_polls < 50:
+                rec.decode_polls += 1
+                try:
+                    asyncio.get_running_loop().call_later(
+                        0.002, self._drain_decode_pipe
+                    )
+                except RuntimeError:
+                    pass
+                return
+            self._finish_recovery_decode(rec)
+
+    def _push_recovered(self, rec: RecoveryOp) -> None:
+        """Fan out PushOps for the rebuilt shards (the WRITING stage)."""
+        want = set(rec.missing_on)
+        rebuilt = rec.shard_data
         rec.state = RECOVERY_WRITING
         rec.trace.event(f"decoded; pushing to shards {sorted(want)}")
         acting = self.listener.acting()

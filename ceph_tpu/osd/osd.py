@@ -193,10 +193,14 @@ class OSD(Dispatcher):
         # incoming trace-carrying messages get a messenger hop span
         # parent-linked to the sender (tracer.py inject/extract)
         self.msgr.tracer = self.tracer
-        # EC encode launch aggregation: this OSD's PGs share the
-        # process-wide aggregator; apply the daemon's config to it and
-        # keep it in sync on runtime sets (both options are runtime=True)
-        from ..codec.matrix_codec import default_encode_aggregator
+        # EC encode/decode launch aggregation: this OSD's PGs share the
+        # process-wide aggregators; apply the daemon's config to them and
+        # keep them in sync on runtime sets (all four options are
+        # runtime=True)
+        from ..codec.matrix_codec import (
+            default_decode_aggregator,
+            default_encode_aggregator,
+        )
 
         self.encode_aggregator = default_encode_aggregator()
         self.encode_aggregator.configure(
@@ -210,6 +214,19 @@ class OSD(Dispatcher):
         self.conf.add_observer(
             ["ec_tpu_aggregate_max_bytes"],
             lambda _n, v: self.encode_aggregator.configure(max_bytes=int(v)),
+        )
+        self.decode_aggregator = default_decode_aggregator()
+        self.decode_aggregator.configure(
+            window=self.conf.get("ec_tpu_decode_aggregate_window"),
+            max_bytes=self.conf.get("ec_tpu_decode_aggregate_max_bytes"),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_decode_aggregate_window"],
+            lambda _n, v: self.decode_aggregator.configure(window=int(v)),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_decode_aggregate_max_bytes"],
+            lambda _n, v: self.decode_aggregator.configure(max_bytes=int(v)),
         )
         self.admin_socket = None
         # heartbeat state: peer -> last reply rx time
@@ -272,13 +289,18 @@ class OSD(Dispatcher):
         from ..common.admin_socket import AdminSocket
 
         sock = AdminSocket(path)
-        # the OSD's encode aggregator (the shared instance this daemon
-        # configured at init) exports its occupancy/launch-size
+        # the OSD's encode/decode aggregators (the shared instances this
+        # daemon configured at init) export their occupancy/launch-size
         # distributions alongside the daemon counters
         agg_perf = self.encode_aggregator.perf
+        dec_perf = self.decode_aggregator.perf
         sock.register(
             "perf dump",
-            lambda cmd: {**self.perf.dump(), "ec_aggregator": agg_perf.dump()},
+            lambda cmd: {
+                **self.perf.dump(),
+                "ec_aggregator": agg_perf.dump(),
+                "ec_decode_aggregator": dec_perf.dump(),
+            },
             "dump perf counters",
         )
         sock.register("config show", lambda cmd: self.conf.show(),
@@ -301,6 +323,7 @@ class OSD(Dispatcher):
             lambda cmd: {
                 **self.perf.dump_histograms(),
                 "ec_aggregator": agg_perf.dump_histograms(),
+                "ec_decode_aggregator": dec_perf.dump_histograms(),
             },
             "log2-bucketed latency (and size x latency) histograms",
         )
@@ -482,12 +505,15 @@ class OSD(Dispatcher):
 
         if not self.mgr_addr:
             return
-        # the encode aggregator's occupancy/launch-size histograms ride
-        # the report (namespaced), so the mgr prometheus scrape exports
-        # them like any daemon counter — not just the local admin socket
+        # the encode/decode aggregators' occupancy/launch-size histograms
+        # ride the report (namespaced), so the mgr prometheus scrape
+        # exports them like any daemon counter — not just the local
+        # admin socket
         perf = dict(self.perf.dump())
         for name, val in self.encode_aggregator.perf.dump().items():
             perf[f"ec_aggregator.{name}"] = val
+        for name, val in self.decode_aggregator.perf.dump().items():
+            perf[f"ec_decode_aggregator.{name}"] = val
         self._send_addr(
             self.mgr_addr,
             MMgrReport(

@@ -187,13 +187,60 @@ def encode(
     return encode_launch(sinfo, ec, data, want).result()
 
 
-def decode_concat(
+class PendingDecode:
+    """A LAUNCHED (or aggregator-windowed) batched stripe decode whose
+    device work may still be running — the decode twin of PendingEncode.
+
+    `handle` is a live device array or a DecodeAggregator ticket;
+    `assemble(rec)` turns the materialized (stripes, nerrs, chunk) rows
+    into the caller's result shape.  Codecs without a device fast path
+    decode eagerly and the PendingDecode is born ready (`result=`)."""
+
+    def __init__(self, handle, assemble, result=None):
+        self._handle = handle
+        self._assemble = assemble
+        self._result = result
+        # the span active at LAUNCH time, so a reap from an event-loop
+        # callback attributes its wait to the right place in the trace
+        from ..codec.tracing import active_span
+
+        self._span = active_span() if handle is not None else None
+
+    def ready(self) -> bool:
+        if self._result is not None:
+            return True
+        is_ready = getattr(self._handle, "is_ready", None)
+        return True if is_ready is None else bool(is_ready())
+
+    def launched(self) -> bool:
+        """False while the decode still sits in a DecodeAggregator window
+        (only a flush will make it ready)."""
+        if self._result is not None:
+            return True
+        return bool(getattr(self._handle, "launched", True))
+
+    def result(self):
+        if self._result is None:
+            from ..codec.tracing import wait_span
+
+            with wait_span(self._span):
+                rec = np.asarray(self._handle)  # blocks until launch done
+            self._result = self._assemble(rec)
+            self._handle = self._assemble = self._span = None
+        return self._result
+
+
+def decode_concat_launch(
     sinfo: StripeInfo,
     ec: ErasureCodeInterface,
     shards: Mapping[int, np.ndarray],
-) -> np.ndarray:
-    """Batched client-read decode: per-shard chunk streams -> logical bytes
-    (mirror of ECUtil::decode's concat overload, ECUtil.cc:12-48)."""
+    aggregator=None,
+) -> PendingDecode:
+    """Launch a batched client-read decode WITHOUT materializing the
+    reconstruction; resolves to the logical bytes.  With an `aggregator`
+    (codec.matrix_codec.DecodeAggregator) the survivor batch is SUBMITTED
+    instead of launched, so concurrent same-erasure-pattern degraded
+    reads coalesce into one padded device dispatch."""
     lengths = {len(v) for v in shards.values()}
     if len(lengths) != 1:
         raise EcError(EINVAL, "shards must have equal length")
@@ -215,43 +262,61 @@ def decode_concat(
     for i, r in enumerate(data_raw):
         if r in have:
             data[:, i, :] = have[r]
-    if missing_raw:
-        # The decode plan needs the full erasure set (every shard we don't
-        # have), not just the wanted data shards.
-        erasures = [i for i in range(n) if i not in have]
-        if _matrix_fast_path(ec):
-            idx = ec.decode_index(erasures)
-            if any(i not in have for i in idx):
-                raise EcError(EIO, f"missing survivor shards {idx}")
-            survivors = np.stack([have[i] for i in idx], axis=1)  # (S, k, cs)
-            from ..codec.tracing import active_span, wait_span
+    if not missing_raw:
+        return PendingDecode(None, None, result=data.reshape(-1))
+    # The decode plan needs the full erasure set (every shard we don't
+    # have), not just the wanted data shards.
+    erasures = [i for i in range(n) if i not in have]
+    if _matrix_fast_path(ec):
+        idx = ec.decode_index(erasures)
+        if any(i not in have for i in idx):
+            raise EcError(EIO, f"missing survivor shards {idx}")
+        survivors = np.stack([have[i] for i in idx], axis=1)  # (S, k, cs)
+        if aggregator is not None:
+            handle = aggregator.submit(ec, erasures, survivors)
+        else:
+            handle = ec.decode_array(erasures, survivors)
 
-            rec_dev = ec.decode_array(erasures, survivors)
-            with wait_span(active_span()):
-                rec = np.asarray(rec_dev)
+        def _assemble(rec: np.ndarray) -> np.ndarray:
             for p, e in enumerate(erasures):
                 if e < k:
                     data[:, e, :] = rec[:, p, :]
-        else:
-            for s in range(stripes):
-                decoded = ec.decode(
-                    set(missing_raw), {i: buf[s] for i, buf in have.items()}
-                )
-                for i, r in enumerate(data_raw):
-                    if r in decoded:
-                        data[s, i, :] = decoded[r]
-    return data.reshape(-1)
+            return data.reshape(-1)
+
+        return PendingDecode(handle, _assemble)
+    for s in range(stripes):
+        decoded = ec.decode(
+            set(missing_raw), {i: buf[s] for i, buf in have.items()}
+        )
+        for i, r in enumerate(data_raw):
+            if r in decoded:
+                data[s, i, :] = decoded[r]
+    return PendingDecode(None, None, result=data.reshape(-1))
 
 
-def decode_shards(
+def decode_concat(
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    shards: Mapping[int, np.ndarray],
+) -> np.ndarray:
+    """Batched client-read decode: per-shard chunk streams -> logical bytes
+    (mirror of ECUtil::decode's concat overload, ECUtil.cc:12-48)."""
+    return decode_concat_launch(sinfo, ec, shards).result()
+
+
+def decode_shards_launch(
     sinfo: StripeInfo,
     ec: ErasureCodeInterface,
     shards: Mapping[int, np.ndarray],
     need: set[int],
-) -> dict[int, np.ndarray]:
-    """Recovery decode: rebuild whole target shards (data or parity) from
-    surviving shard streams (ECUtil::decode's per-shard overload,
-    ECUtil.cc:50-121)."""
+    aggregator=None,
+) -> PendingDecode:
+    """Launch a recovery decode WITHOUT materializing the rebuilt shards;
+    resolves to {shard: stream} for `need`.  With an `aggregator`, the
+    survivor batch is SUBMITTED: per-object decodes during recovery and
+    backfill — where ONE erasure pattern repeats across every object in
+    the PG — coalesce into one padded device launch when the window fills
+    or a barrier flushes (ECBackend.flush_decodes / any ticket reap)."""
     lengths = {len(v) for v in shards.values()}
     if len(lengths) != 1:
         raise EcError(EINVAL, "shards must have equal length")
@@ -264,25 +329,44 @@ def decode_shards(
     missing = sorted(i for i in need if i not in have)
     out = {i: have[i].reshape(-1) for i in need if i in have}
     if not missing:
-        return out
+        return PendingDecode(None, None, result=out)
     if _matrix_fast_path(ec):
         erasures = [i for i in range(ec.get_chunk_count()) if i not in have]
         idx = ec.decode_index(erasures)
         if any(i not in have for i in idx):
             raise EcError(EIO, f"missing survivor shards {idx}")
         survivors = np.stack([have[i] for i in idx], axis=1)
-        rec = np.asarray(ec.decode_array(erasures, survivors))
-        for p, e in enumerate(erasures):
-            if e in need:
-                out[e] = np.ascontiguousarray(rec[:, p, :]).reshape(-1)
-    else:
-        rebuilt = {e: np.empty((stripes, sinfo.chunk_size), dtype=np.uint8) for e in missing}
-        for s in range(stripes):
-            decoded = ec.decode(
-                set(missing), {i: buf[s] for i, buf in have.items()}
-            )
-            for e in missing:
-                rebuilt[e][s] = decoded[e]
+        if aggregator is not None:
+            handle = aggregator.submit(ec, erasures, survivors)
+        else:
+            handle = ec.decode_array(erasures, survivors)
+
+        def _assemble(rec: np.ndarray) -> dict[int, np.ndarray]:
+            for p, e in enumerate(erasures):
+                if e in need:
+                    out[e] = np.ascontiguousarray(rec[:, p, :]).reshape(-1)
+            return out
+
+        return PendingDecode(handle, _assemble)
+    rebuilt = {e: np.empty((stripes, sinfo.chunk_size), dtype=np.uint8) for e in missing}
+    for s in range(stripes):
+        decoded = ec.decode(
+            set(missing), {i: buf[s] for i, buf in have.items()}
+        )
         for e in missing:
-            out[e] = rebuilt[e].reshape(-1)
-    return out
+            rebuilt[e][s] = decoded[e]
+    for e in missing:
+        out[e] = rebuilt[e].reshape(-1)
+    return PendingDecode(None, None, result=out)
+
+
+def decode_shards(
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    shards: Mapping[int, np.ndarray],
+    need: set[int],
+) -> dict[int, np.ndarray]:
+    """Recovery decode: rebuild whole target shards (data or parity) from
+    surviving shard streams (ECUtil::decode's per-shard overload,
+    ECUtil.cc:50-121)."""
+    return decode_shards_launch(sinfo, ec, shards, need).result()

@@ -1,17 +1,18 @@
-"""Perf smoke (tier-1): dispatch-shape invariants of the encode hot path.
+"""Perf smoke (tier-1): dispatch-shape invariants of the coding hot paths.
 
-Runs a small encode/decode chain on the CPU backend and asserts the
-launch counter and plan-cache hit rate, so a regression back to
+Runs small encode/decode chains on the CPU backend and asserts the
+launch counters and plan-cache hit rates, so a regression back to
 per-stripe dispatch or per-call plan rebuilds fails `pytest -m 'not
 slow'` immediately instead of only dilating `python bench.py`
-(ISSUE 3 satellite).  The counter is a python-dispatch witness — see
-ceph_tpu/ops/dispatch.py for what it does and doesn't count."""
+(ISSUE 3 / ISSUE 5 satellites).  The counters are python-dispatch
+witnesses — see ceph_tpu/ops/dispatch.py for what they do and don't
+count; DECODE_LAUNCHES isolates the recovery/degraded-read half."""
 
 import numpy as np
 
 from ceph_tpu.codec import ErasureCodeTpuRs
 from ceph_tpu.codec.matrix_codec import PLAN_CACHE
-from ceph_tpu.ops.dispatch import LAUNCHES
+from ceph_tpu.ops.dispatch import DECODE_LAUNCHES, LAUNCHES
 from ceph_tpu.stripe import StripeInfo
 from ceph_tpu.stripe import stripe as stripe_mod
 
@@ -73,3 +74,49 @@ class TestPerfSmoke:
         s1 = PLAN_CACHE.stats()
         assert s1["hits"] - s0["hits"] == 5
         assert s1["misses"] == s0["misses"], "steady-state encode rebuilt a plan"
+
+    def test_recovery_decode_is_one_decode_dispatch(self):
+        """Rebuilding whole shards for a 16-stripe object must cost one
+        DECODE dispatch (the ISSUE 5 decode launch-counter contract) —
+        and that dispatch also lands on the global total."""
+        ec = make_rs()
+        sinfo = StripeInfo(4 * 4096, 4096)
+        stripes = 16
+        obj = np.random.default_rng(3).integers(
+            0, 256, stripes * sinfo.stripe_width, dtype=np.uint8
+        )
+        shards = stripe_mod.encode(sinfo, ec, obj)
+        have = {i: shards[i] for i in range(6) if i not in (1, 4)}
+        before_d = DECODE_LAUNCHES.snapshot()
+        before_t = LAUNCHES.snapshot()
+        rebuilt = stripe_mod.decode_shards(sinfo, ec, have, {1, 4})
+        after_d = DECODE_LAUNCHES.snapshot()
+        after_t = LAUNCHES.snapshot()
+        assert np.array_equal(rebuilt[1], shards[1])
+        assert np.array_equal(rebuilt[4], shards[4])
+        assert after_d["launches"] - before_d["launches"] == 1, (
+            f"{stripes}-stripe recovery took "
+            f"{after_d['launches'] - before_d['launches']} decode dispatches; "
+            "the batched decode path regressed to per-stripe launches"
+        )
+        assert after_d["stripes"] - before_d["stripes"] == stripes
+        assert after_t["launches"] - before_t["launches"] == 1
+
+    def test_decode_plan_cache_steady_state_hit_rate(self):
+        """Re-decoding the same erasure pattern must hit the decode coder
+        LRU: misses stay flat while hits climb (a regression to per-call
+        Gaussian inversions would only show up in recovery latency)."""
+        ec = make_rs()
+        sinfo = StripeInfo(4 * 4096, 4096)
+        obj = np.random.default_rng(4).integers(
+            0, 256, 4 * sinfo.stripe_width, dtype=np.uint8
+        )
+        shards = stripe_mod.encode(sinfo, ec, obj)
+        have = {i: shards[i] for i in range(6) if i != 2}
+        stripe_mod.decode_shards(sinfo, ec, have, {2})  # coder exists
+        s0 = PLAN_CACHE.stats()
+        for _ in range(5):
+            stripe_mod.decode_shards(sinfo, ec, have, {2})
+        s1 = PLAN_CACHE.stats()
+        assert s1["hits"] - s0["hits"] == 5
+        assert s1["misses"] == s0["misses"], "steady-state decode rebuilt a plan"
