@@ -52,6 +52,12 @@ TPU_RETRIES = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
 # of silently eating the whole child deadline.
 STAGE_TIMEOUT_S = float(os.environ.get("BENCH_STAGE_TIMEOUT", "60"))
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+# Backend init (jax.devices()) gets its own SHORT allowance and a distinct
+# exit code: rounds 4-5 lost whole rounds to the axon runtime wedging right
+# here, so a hang costs ~45 s, the parent retries init-hangs exactly once
+# (a transient tunnel blip recovers; a wedged one fails fast again), and
+# then falls back to CPU with the round's deadline mostly intact.
+BACKEND_INIT_TIMEOUT_S = float(os.environ.get("BENCH_BACKEND_INIT_TIMEOUT", "45"))
 
 
 def _log(msg: str) -> None:
@@ -60,8 +66,10 @@ def _log(msg: str) -> None:
 
 class _StageWatchdog:
     """Child-side watchdog over the warm-up stages.  A stage that
-    overruns its allowance hard-exits the child with rc=5 (the parent
-    treats that like a deadline: a hang will hang again, don't retry)."""
+    overruns its allowance hard-exits the child with its stage's exit
+    code: rc=5 for a generic stage hang (the parent treats that like a
+    deadline: a hang will hang again, don't retry), rc=6 for a backend
+    init hang specifically (the parent retries that exactly once)."""
 
     def __init__(self, clog):
         import threading
@@ -69,14 +77,16 @@ class _StageWatchdog:
         self._clog = clog
         self._stage = None
         self._deadline = None
+        self._rc = 5
         self._lock = threading.Lock()
         t = threading.Thread(target=self._run, daemon=True)
         t.start()
 
-    def stage(self, name: str, timeout_s: float) -> None:
+    def stage(self, name: str, timeout_s: float, rc: int = 5) -> None:
         with self._lock:
             self._stage = name
             self._deadline = time.monotonic() + timeout_s
+            self._rc = rc
         self._clog(f"stage: {name} (allowance {timeout_s:.0f}s)")
 
     def disarm(self) -> None:
@@ -88,22 +98,27 @@ class _StageWatchdog:
         while True:
             time.sleep(1.0)
             with self._lock:
-                stage, deadline = self._stage, self._deadline
+                stage, deadline, rc = self._stage, self._deadline, self._rc
             if deadline is not None and time.monotonic() > deadline:
                 self._clog(f"WATCHDOG: stage '{stage}' overran its allowance")
                 sys.stderr.flush()
-                os._exit(5)
+                os._exit(rc)
 
 
-def run_child(platform: str) -> None:
+def run_child(platform: str, mc_only: bool = False) -> None:
     """Child mode: do the actual measurement on the given platform.
 
     Progress is logged to stderr line-by-line so that a hang in backend init
     or compilation is attributable from the parent's captured output.
+
+    `mc_only`: run ONLY the multichip stage (the parent spawns this as a
+    separate CPU child with a forced 8-virtual-device mesh, so the
+    per-chip headline never pays the virtual-device threadpool split).
     """
 
     def clog(msg: str) -> None:
-        print(f"[bench-child:{platform}] {msg}", file=sys.stderr, flush=True)
+        tag = f"{platform}-mc" if mc_only else platform
+        print(f"[bench-child:{tag}] {msg}", file=sys.stderr, flush=True)
 
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -121,7 +136,7 @@ def run_child(platform: str) -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    watchdog.stage("backend_init", STAGE_TIMEOUT_S)
+    watchdog.stage("backend_init", BACKEND_INIT_TIMEOUT_S, rc=6)
     clog("initializing backend (jax.devices())")
     dev = jax.devices()[0]
     got = dev.platform
@@ -232,6 +247,126 @@ def run_child(platform: str) -> None:
         elapsed = time.perf_counter() - t0
         del data, p
         return batch * k * chunk * n / elapsed / 1e9
+
+    def _run_multichip(mc_base_batch: int) -> None:
+        """Multichip stage (ISSUE 6): shard the aggregated launch over
+        the device mesh, verify bytes through the SHIPPING sharded
+        dispatch, and measure AGGREGATE GB/s alongside the per-chip
+        number.  Prints its own `{"multichip": ...}` JSON line; any fault
+        is recorded there and never takes down the child."""
+        mc: dict = {}
+        try:
+            n_dev = len(jax.devices())
+            mc["devices"] = n_dev
+            if n_dev < 2:
+                mc["skipped"] = "single device"
+                raise _McDone()
+            from ceph_tpu.ops.dispatch import SHARDED_LAUNCHES
+            from ceph_tpu.parallel import dispatch as shard_dispatch
+            from ceph_tpu.parallel.sharded import _stripe_sharding
+
+            watchdog.stage("multichip_warmup", PROBE_TIMEOUT_S)
+            # Bytes first, through the SHIPPING sharded dispatch: an
+            # eager encode_array above the shard threshold must register
+            # one sharded launch and match the host oracle.
+            shard_dispatch.configure(min_batch=n_dev, devices=0)
+            mc_probe = rng.integers(0, 256, (2 * n_dev, k, 8192), dtype=np.uint8)
+            s0 = SHARDED_LAUNCHES.snapshot()["launches"]
+            mc_par = np.asarray(encode_fn(mc_probe))
+            if SHARDED_LAUNCHES.snapshot()["launches"] != s0 + 1:
+                clog("MULTICHIP: dispatch did not shard (policy/mesh fault)")
+                mc["error"] = "dispatch did not shard"
+                raise _McDone()
+            if not np.array_equal(mc_par[0], gf_matmul(gfm, mc_probe[0])):
+                clog("MULTICHIP PARITY MISMATCH vs host oracle")
+                mc["error"] = "sharded parity mismatch"
+                raise _McDone()
+            clog(f"multichip probe OK: 1 sharded launch over {n_dev} devices")
+
+            # Aggregate throughput: the same serial-chain methodology as
+            # the per-chip number, but the arrays live stripe-sharded
+            # over the mesh — each device runs the per-chip workload
+            # concurrently, so input bytes/elapsed is honest aggregate.
+            mc_batch = mc_base_batch * n_dev
+            mesh = shard_dispatch.shard_mesh(mc_batch)  # the locked public path
+            if mesh is None:
+                mc["error"] = "shard policy returned no mesh"
+                raise _McDone()
+            sharding = _stripe_sharding(mesh)
+            mc_host = rng.integers(0, 256, (mc_batch, k, chunk), dtype=np.uint8)
+            mc_data = jax.device_put(mc_host, sharding)
+            mc_p = jax.device_put(
+                np.zeros((mc_batch, m, chunk), np.uint8), sharding
+            )
+            mc_data, mc_p = step(mc_data, mc_p)  # compile + warm, sharded
+            jax.block_until_ready((mc_data, mc_p))
+            watchdog.disarm()
+            mc_iters = max(4, iters // 2)
+            clog(f"multichip measuring: batch={mc_batch} iters={mc_iters} "
+                 f"over {n_dev} devices")
+            t0 = time.perf_counter()
+            for _ in range(mc_iters):
+                mc_data, mc_p = step(mc_data, mc_p)
+            jax.block_until_ready((mc_data, mc_p))
+            _ = np.asarray(mc_p[0, 0, :8])
+            elapsed = time.perf_counter() - t0
+            del mc_data, mc_p
+            mc["encode_gbps"] = mc_batch * k * chunk * mc_iters / elapsed / 1e9
+            mc["batch"] = mc_batch
+            clog(f"multichip encode: {mc['encode_gbps']:.3f} GB/s aggregate")
+
+            # Decode twin: chained sharded decode at the same geometry.
+            try:
+                erasures = [0, 5, 9]
+                idx = ec.decode_index(erasures)
+                watchdog.stage("multichip_decode", PROBE_TIMEOUT_S)
+                d_host = rng.integers(
+                    0, 256, (mc_batch, k, chunk), dtype=np.uint8
+                )
+                d_data = jax.device_put(d_host, sharding)
+                surv = jnp.concatenate(
+                    [d_data, encode_fn(d_data)], axis=1)[:, idx, :]
+                del d_data
+                r = jax.device_put(
+                    np.zeros((mc_batch, len(erasures), chunk), np.uint8),
+                    sharding,
+                )
+
+                @functools.partial(jax.jit, donate_argnums=(0,))
+                def mc_dstep(s, r):
+                    patch = (r[:1, :1, :128] ^ jnp.uint8(1)).reshape(1, 1, 128)
+                    s2 = jax.lax.dynamic_update_slice(s, patch, (0, 0, 0))
+                    return s2, ec.decode_array(erasures, s2)
+
+                surv, r = mc_dstep(surv, r)  # compile + warm
+                jax.block_until_ready((surv, r))
+                watchdog.disarm()
+                t0 = time.perf_counter()
+                for _ in range(mc_iters):
+                    surv, r = mc_dstep(surv, r)
+                jax.block_until_ready((surv, r))
+                _ = np.asarray(r[0, 0, :8])
+                elapsed = time.perf_counter() - t0
+                del surv, r
+                mc["decode_gbps"] = (
+                    mc_batch * k * chunk * mc_iters / elapsed / 1e9
+                )
+                clog(f"multichip decode: {mc['decode_gbps']:.3f} GB/s aggregate")
+            except Exception as e:  # encode aggregate survives a decode fault
+                watchdog.disarm()
+                mc["decode_error"] = repr(e)
+                clog(f"multichip decode failed: {e!r}")
+        except _McDone:
+            watchdog.disarm()
+        except Exception as e:  # the stage must never take down the child
+            watchdog.disarm()
+            mc["error"] = repr(e)
+            clog(f"multichip stage failed: {e!r}")
+        print(json.dumps({"multichip": mc}), flush=True)
+
+    if mc_only:
+        _run_multichip(batch_candidates[0])
+        return
 
     batch = batch_candidates[0]
     if len(batch_candidates) > 1:
@@ -418,10 +553,22 @@ def run_child(platform: str) -> None:
             }
             for s in tr.export()
         ]
-    print(json.dumps(result))
+    # The per-chip headline is SAFE from here on: it goes out before the
+    # multichip stage runs, and the parent merges every JSON line it can
+    # salvage — a multichip hang/crash can only lose the multichip twin.
+    print(json.dumps(result), flush=True)
+    if platform == "tpu":
+        # Real chips don't share a threadpool, so the multichip stage can
+        # ride the same child (one backend init, one warm codec); on CPU
+        # the parent spawns a separate forced-8-device child instead.
+        _run_multichip(batch)
 
 
-def _child_env(platform: str) -> dict:
+class _McDone(Exception):
+    """Early exit from the multichip stage (skip/fault already recorded)."""
+
+
+def _child_env(platform: str, multichip: bool = False) -> dict:
     """Environment for a measurement child.
 
     The TPU child must not inherit CPU-forcing left by earlier callers in the
@@ -448,11 +595,47 @@ def _child_env(platform: str) -> dict:
         # broken, so strip the gate variable and force the CPU platform.
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
+        if multichip:
+            # Simulated 8-device mesh for the multichip-only CPU child
+            # (the dryrun recipe): proves the sharded launch path and
+            # emits the aggregate metric.  Virtual devices share the
+            # host's cores, so the CPU aggregate is a plumbing witness,
+            # not a scaling claim; a pre-set count is honored.
+            devs = os.environ.get("BENCH_CPU_DEVICES", "8")
+            if "xla_force_host_platform_device_count" not in env.get(
+                "XLA_FLAGS", ""
+            ):
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={devs}"
+                ).strip()
     return env
 
 
+def _parse_result_lines(stdout: bytes, require: str = "gbps") -> dict | None:
+    """Merge every JSON line the child printed (base result first, then
+    the optional `{"multichip": ...}` trailer) into one dict.  None when
+    no line carried the `require` key (the stage that makes the child's
+    output usable: the base measurement, or `multichip` for the
+    multichip-only child)."""
+    merged: dict = {}
+    for line in stdout.decode(errors="replace").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            merged.update(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return merged if require in merged else None
+
+
 def _try_platform(platform: str, deadline: float) -> tuple[dict | None, str]:
-    """Run a measurement child; return (result dict or None, error string)."""
+    """Run a measurement child; return (result dict or None, error string).
+
+    The child streams one JSON line per completed stage, so a late-stage
+    hang or watchdog kill (multichip after the headline) SALVAGES every
+    stage that finished instead of discarding the whole child."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", platform]
     _log(f"spawning {platform} child (deadline {deadline:.0f}s)")
     try:
@@ -464,28 +647,63 @@ def _try_platform(platform: str, deadline: float) -> tuple[dict | None, str]:
             env=_child_env(platform),
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        result = _parse_result_lines(e.stdout or b"")
+        if result is not None:
+            _log(f"{platform} child hit the deadline AFTER the headline; "
+                 "salvaging completed stages")
+            return result, ""
         return None, f"{platform} child hit {deadline:.0f}s deadline (backend hang?)"
     if proc.returncode != 0:
+        result = _parse_result_lines(proc.stdout)
+        if result is not None:
+            _log(f"{platform} child exited rc={proc.returncode} AFTER the "
+                 "headline; salvaging completed stages")
+            return result, ""
         return None, f"{platform} child exited rc={proc.returncode}"
-    for line in proc.stdout.decode().splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), ""
-            except json.JSONDecodeError:
-                continue
+    result = _parse_result_lines(proc.stdout)
+    if result is not None:
+        return result, ""
     return None, f"{platform} child produced no JSON result"
+
+
+def _try_multichip_cpu(deadline: float) -> dict | None:
+    """Run the multichip-only CPU child (forced 8 simulated devices) and
+    return its `multichip` payload; None on any fault.  Separate from the
+    per-chip CPU child so the virtual-device threadpool split never taxes
+    the per-chip headline."""
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--child", "cpu", "--multichip-only",
+    ]
+    _log(f"spawning multichip CPU child (deadline {deadline:.0f}s)")
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=None,
+            timeout=deadline,
+            env=_child_env("cpu", multichip=True),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        stdout = proc.stdout
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout or b""
+    merged = _parse_result_lines(stdout, require="multichip")
+    return merged["multichip"] if merged is not None else None
 
 
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
-        run_child(sys.argv[2])
+        run_child(sys.argv[2], mc_only="--multichip-only" in sys.argv[3:])
         return
 
     tpu_error = ""
     result = None
-    for attempt in range(1, TPU_RETRIES + 1):
+    init_retries = 0
+    attempt = 0
+    while attempt < TPU_RETRIES:
+        attempt += 1
         result, err = _try_platform("tpu", TPU_DEADLINE_S)
         if result is not None:
             break
@@ -499,6 +717,20 @@ def main() -> None:
             break  # parity mismatch is deterministic too — fall back
         if "rc=5" in err:
             break  # stage watchdog caught a backend hang — same story
+        if "rc=6" in err:
+            # backend init hang, caught by its own ~45 s sub-deadline: a
+            # transient tunnel blip recovers on retry, a wedged runtime
+            # fails fast again — ONE retry, riding OUTSIDE the generic
+            # attempt budget so it happens even with BENCH_TPU_RETRIES=1
+            # or after a generic-failure attempt, then CPU fallback with
+            # most of the round's deadline intact
+            init_retries += 1
+            if init_retries > 1:
+                break
+            _log("backend init hang: retrying once before CPU fallback")
+            attempt -= 1
+            time.sleep(10)
+            continue
         if attempt < TPU_RETRIES:
             time.sleep(10)
 
@@ -520,6 +752,15 @@ def main() -> None:
                 )
             )
             sys.exit(0)
+
+    # Multichip on the CPU fallback runs in its OWN child with a forced
+    # 8-device simulated mesh (the per-chip child stays 1-device so the
+    # headline is untaxed); a TPU child already ran it in-process.
+    mc = result.get("multichip")
+    if result.get("platform") == "cpu" and (mc is None or "skipped" in mc):
+        mc = _try_multichip_cpu(CPU_DEADLINE_S)
+        if mc is not None:
+            result["multichip"] = mc
 
     gbps = result["gbps"]
     out = {
@@ -543,6 +784,28 @@ def main() -> None:
             out["decode"]["stages"] = d["stages"]
     elif "decode_error" in result:
         out["decode_error"] = result["decode_error"]
+    # multichip stage (ISSUE 6): aggregate GB/s of the mesh-sharded
+    # launch path, alongside (never replacing) the per-chip metrics
+    if "multichip" in result:
+        m = result["multichip"]
+        mc_out = {"devices": m.get("devices", 0)}
+        if "encode_gbps" in m:
+            mc_out["metric"] = "rs_8_3_encode_GBps_aggregate"
+            mc_out["value"] = round(m["encode_gbps"], 3)
+            mc_out["unit"] = "GB/s"
+            mc_out["vs_per_chip"] = (
+                round(m["encode_gbps"] / gbps, 4) if gbps else 0
+            )
+        if "decode_gbps" in m:
+            mc_out["decode"] = {
+                "metric": "rs_8_3_decode_GBps_aggregate",
+                "value": round(m["decode_gbps"], 3),
+                "unit": "GB/s",
+            }
+        for key in ("skipped", "error", "decode_error", "batch"):
+            if key in m:
+                mc_out[key] = m[key]
+        out["multichip"] = mc_out
     if "stages" in result:
         out["stages"] = result["stages"]
     if "probe_s" in result:

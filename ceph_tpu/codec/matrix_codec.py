@@ -95,7 +95,46 @@ class _DeviceCoder:
         # also count their dispatches on ops.dispatch.DECODE_LAUNCHES
         self.decode = decode
 
+    def shard_mesh_for(self, shape):
+        """Mesh for a sharded dispatch at this input shape, or None for
+        the single-device path.  Batched (..., k, L) inputs of at least
+        PACKED_MIN_BYTES shard — lead dims collapse into one stripe axis
+        (CLAY's (planes, S, k+nu, sc) fragment launches included); the
+        threshold/width policy lives in parallel/dispatch.py (the
+        ec_tpu_shard_* knobs)."""
+        if len(shape) < 3 or int(np.prod(shape)) < PACKED_MIN_BYTES:
+            return None
+        from ceph_tpu.parallel import dispatch as shard_dispatch
+
+        return shard_dispatch.shard_mesh(int(np.prod(shape[:-2])))
+
+    def _shard_mesh(self, data):
+        """shard_mesh_for, guarded against trace-local values: a batch
+        traced inside an outer jit (bench.py's serial chain) must stay on
+        the in-trace kernel — a device_put of a tracer poisons the
+        trace."""
+        if _trace_local(data):
+            return None
+        return self.shard_mesh_for(data.shape)
+
     def __call__(self, data: jnp.ndarray, out=None) -> jnp.ndarray:
+        mesh = self._shard_mesh(data)
+        if mesh is not None:
+            # sharded dispatch mode (ISSUE 6): place the batch with a
+            # NamedSharding over `stripe` and run the fused kernel
+            # per-device via shard_map — one launch, the whole mesh
+            from ceph_tpu.parallel.sharded import sharded_coder_code
+
+            lead = data.shape[:-2]
+            if len(lead) > 1:
+                # collapse lead dims into the stripe axis (CLAY batched
+                # fragments); a host reshape of the contiguous batch is a
+                # view.  Donation skipped: the pooled buffer has the
+                # caller's lead geometry, not the flattened one.
+                flat = data.reshape(-1, *data.shape[-2:])
+                res = sharded_coder_code(self, flat, mesh)
+                return res.reshape(*lead, *res.shape[-2:])
+            return sharded_coder_code(self, data, mesh, out=out)
         if self.plan is not None and data.shape[-1] % 128 == 0:
             return self.plan(data)
         if int(np.prod(data.shape)) >= PACKED_MIN_BYTES:
@@ -340,6 +379,25 @@ class _GlobalPlanCache:
 
 
 PLAN_CACHE = _GlobalPlanCache()
+
+
+def _coder_donatable(coder: _DeviceCoder, data_shape) -> bool:
+    """Will a dispatch through `coder` at this (already >= packed-size)
+    input shape actually consume a donated out= buffer?  Mirrors the
+    _DeviceCoder dispatch exactly: the packed jnp kernel donates; the
+    Pallas plan ignores `out`; a SHARDED launch donates only on the
+    packed path with no remainder padding (a padded launch's output
+    shape differs from the pooled logical-shape buffer)."""
+    mesh = coder.shard_mesh_for(tuple(data_shape))
+    if mesh is not None:
+        if len(data_shape) != 3:
+            return False  # flattened-lead launches skip donation
+        if coder.plan is not None and data_shape[-1] % 128 == 0:
+            return False
+        from ceph_tpu.parallel.sharded import _stripe_shards
+
+        return data_shape[0] % _stripe_shards(mesh) == 0
+    return not (coder.plan is not None and data_shape[-1] % 128 == 0)
 
 
 def _next_pow2(n: int) -> int:
@@ -872,7 +930,11 @@ class MatrixCodecMixin:
             lead = arr.shape[:-2]
             record_launch(int(np.prod(lead)) if lead else 1, int(np.prod(arr.shape)))
             return xor_reduce(arr)[..., None, :]
-        return PLAN_CACHE.encode_coder(mat[self.k :])(jnp.asarray(data), out=out)
+        # host batches pass through un-placed: the coder's sharded mode
+        # does ONE sharded device_put (a premature jnp.asarray would
+        # commit to device 0 and pay a second reshard copy)
+        arr = data if isinstance(data, np.ndarray) else jnp.asarray(data)
+        return PLAN_CACHE.encode_coder(mat[self.k :])(arr, out=out)
 
     def encode_donatable(self, data_shape) -> bool:
         """True when encode_array(data, out=...) at this input shape will
@@ -886,7 +948,7 @@ class MatrixCodecMixin:
         if int(np.prod(data_shape)) < PACKED_MIN_BYTES:
             return False
         coder = PLAN_CACHE.encode_coder(mat[self.k :])
-        return not (coder.plan is not None and data_shape[-1] % 128 == 0)
+        return _coder_donatable(coder, data_shape)
 
     def decode_array(self, erasures: list[int], survivors, out=None) -> jnp.ndarray:
         """survivors (..., k, L) in decode_index order -> (..., nerrs, L).
@@ -898,7 +960,8 @@ class MatrixCodecMixin:
         reconstruction's shape, donated into the packed kernel so
         recurring aggregated recovery launches reuse the allocation."""
         coder, _ = PLAN_CACHE.decode_coder(self.distribution_matrix(), erasures, self.k)
-        return coder(jnp.asarray(survivors), out=out)
+        arr = survivors if isinstance(survivors, np.ndarray) else jnp.asarray(survivors)
+        return coder(arr, out=out)
 
     def decode_donatable(self, erasures: list[int], data_shape) -> bool:
         """True when decode_array(erasures, data, out=...) at this input
@@ -909,7 +972,7 @@ class MatrixCodecMixin:
         coder, _ = PLAN_CACHE.decode_coder(
             self.distribution_matrix(), list(erasures), self.k
         )
-        return not (coder.plan is not None and data_shape[-1] % 128 == 0)
+        return _coder_donatable(coder, data_shape)
 
     def decode_index(self, erasures: list[int]) -> list[int]:
         _, idx = PLAN_CACHE.decode_plan(self.distribution_matrix(), erasures, self.k)

@@ -154,6 +154,31 @@ OPTIONS: dict[str, Option] = _opts(
         see_also=("ec_tpu_decode_aggregate_window",),
         runtime=True,
     ),
+    Option(
+        "ec_tpu_shard_min_batch",
+        int,
+        32,
+        A,
+        "minimum stripe count before a coding launch (aggregated or bulk) "
+        "shards data-parallel over the device mesh (parallel/dispatch.py); "
+        "smaller launches stay single-device — a sharded dispatch pays a "
+        "sharded H2D placement and a per-mesh compile, pure overhead for "
+        "the few-stripe writes the aggregation window already coalesces",
+        see_also=("ec_tpu_shard_devices", "ec_tpu_aggregate_window"),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_shard_devices",
+        int,
+        0,
+        A,
+        "device-mesh width for sharded coding launches: 0 = every visible "
+        "device, 1 disables sharding entirely, N caps the mesh at the "
+        "first N devices (a pod slice reserved for serving can be kept "
+        "out of bulk recovery launches)",
+        see_also=("ec_tpu_shard_min_batch",),
+        runtime=True,
+    ),
     # --- OSD ----------------------------------------------------------------
     Option("osd_recovery_max_chunk", int, 8 << 20, A,
            "max recovery push size; rounded to stripe (ECBackend.h:206)"),

@@ -228,8 +228,14 @@ class MDS(Dispatcher):
 
     async def _beacon_loop(self) -> None:
         while True:
+            # the daemon's RADOS client instance rides the beacon so the
+            # mon can fence exactly this instance's pool I/O on failover
+            client = ""
+            if self.rados is not None and getattr(self.rados, "objecter", None):
+                client = self.rados.objecter.reqid_name
             beacon = MMDSBeacon(
-                name=self.name, addr=self.msgr.addr, state=self.state
+                name=self.name, addr=self.msgr.addr, state=self.state,
+                client=client,
             )
             for mon_name in self.monmap.ranks:
                 try:

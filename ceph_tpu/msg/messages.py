@@ -636,9 +636,15 @@ class MClientCaps(Message):
 class MMDSBeacon(Message):
     """MDS -> mon availability beacon (src/messages/MMDSBeacon.h): drives
     MDSMonitor's rank assignment and failover.  `state` is the daemon's
-    self-reported state (boot / standby / active)."""
+    self-reported state (boot / standby / active).  `client` is the
+    daemon's RADOS client instance id (objecter reqid name, '' when the
+    daemon runs embedded without one): what the MDSMonitor blocklists
+    through the OSDMonitor when it fails this daemon over — the
+    reference's MDSMonitor::fail_mds_gid blocklisting the gid's addrs."""
 
-    FIELDS = [("name", "str"), ("addr", "str"), ("state", "str")]
+    FIELDS = [
+        ("name", "str"), ("addr", "str"), ("state", "str"), ("client", "str")
+    ]
 
 
 @message_type(41)

@@ -1,15 +1,19 @@
 """Device-launch accounting for the coding hot path.
 
-Two counters, incremented exactly once per host->device kernel dispatch by
-the lowest-level python wrapper of each coding path (PackedPlan, the
-Pallas CodingPlan, the jnp bitsliced fallback, xor_reduce): `LAUNCHES`
-totals every coding dispatch, `DECODE_LAUNCHES` additionally totals the
-dispatches issued on behalf of a decode (recovery / degraded read).
-Tests assert batching invariants against them — "encoding N stripes cost
-1 dispatch", "recovering N same-pattern objects cost O(1) decode
-dispatches" — so a regression back to per-stripe launches fails tier-1
-instead of only showing up as a bench number (ISSUE 3 / ISSUE 5
-launch-counter contracts).
+Three counters, incremented exactly once per host->device kernel dispatch
+by the lowest-level python wrapper of each coding path (PackedPlan, the
+Pallas CodingPlan, the jnp bitsliced fallback, xor_reduce, the sharded
+shard_map dispatch): `LAUNCHES` totals every coding dispatch,
+`DECODE_LAUNCHES` additionally totals the dispatches issued on behalf of
+a decode (recovery / degraded read), and `SHARDED_LAUNCHES` additionally
+totals the dispatches that spanned more than one device of the mesh
+(parallel/dispatch.py data-parallel fan-out).  Tests assert batching
+invariants against them — "encoding N stripes cost 1 dispatch",
+"recovering N same-pattern objects cost O(1) decode dispatches", "a
+bulk batch crossed the shard threshold and spanned the mesh" — so a
+regression back to per-stripe launches (or silently single-device
+launches) fails tier-1 instead of only showing up as a bench number
+(ISSUE 3 / ISSUE 5 / ISSUE 6 launch-counter contracts).
 
 Caveat: counting happens at python dispatch time.  A coding call traced
 inside an OUTER jax.jit (bench.py's serial chain) runs the wrapper once
@@ -65,12 +69,78 @@ LAUNCHES = LaunchCounter()
 # in one window = O(1) decode launches" is assertable on its own.
 DECODE_LAUNCHES = LaunchCounter()
 
+# Multi-device dispatches (parallel/dispatch.py shard_map fan-out over
+# the stripe axis).  Counted here AND in LAUNCHES (and DECODE_LAUNCHES
+# when it is a decode): by construction SHARDED_LAUNCHES.launches <=
+# LAUNCHES.launches, and a 1-device process records zero here — the
+# consistency contract tests/test_perf_smoke.py pins.
+SHARDED_LAUNCHES = LaunchCounter()
 
-def record_launch(stripes: int, nbytes: int, decode: bool = False) -> None:
+
+class DeviceOccupancy:
+    """Devices-per-launch distribution: how wide each coding dispatch
+    ran.  Exact per-count buckets (device counts are tiny integers, a
+    log2 histogram would blur 6 vs 8 chips) plus a device-launch total so
+    mean occupancy is derivable from two scalars."""
+
+    __slots__ = ("_lock", "counts", "device_launches")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counts: dict[int, int] = {}
+        self.device_launches = 0  # sum(devices) over every dispatch
+
+    def record(self, devices: int) -> None:
+        with self._lock:
+            self.counts[devices] = self.counts.get(devices, 0) + 1
+            self.device_launches += devices
+
+    def snapshot(self) -> dict[int, int]:
+        with self._lock:
+            return dict(self.counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts.clear()
+            self.device_launches = 0
+
+
+DEVICES_PER_LAUNCH = DeviceOccupancy()
+
+
+def record_launch(
+    stripes: int, nbytes: int, decode: bool = False, devices: int = 1
+) -> None:
     """Record one device dispatch carrying `stripes` stripes / `nbytes`
     input bytes on the global counter(s).  `decode=True` marks a dispatch
     issued on behalf of a decode (the coder's kind, threaded down from
-    PLAN_CACHE.decode_coder) so it also lands on DECODE_LAUNCHES."""
+    PLAN_CACHE.decode_coder) so it also lands on DECODE_LAUNCHES.
+    `devices` is how many mesh devices the dispatch spanned (the sharded
+    dispatcher passes its stripe-shard count); > 1 additionally lands on
+    SHARDED_LAUNCHES and every value feeds the occupancy distribution."""
     LAUNCHES.record(stripes, nbytes)
     if decode:
         DECODE_LAUNCHES.record(stripes, nbytes)
+    if devices > 1:
+        SHARDED_LAUNCHES.record(stripes, nbytes)
+    DEVICES_PER_LAUNCH.record(devices)
+
+
+def perf_dump() -> dict[str, object]:
+    """JSON-safe export of every dispatch counter — the `ec_dispatch`
+    section of the OSD's asok `perf dump` and (flattened) of the
+    MMgrReport payload the mgr Prometheus scrape re-exports.  The
+    devices-per-launch distribution rides as `devices_per_launch.<n>`
+    scalars so the scrape renders one labeled-by-dot series per width."""
+    out: dict[str, object] = {}
+    for prefix, counter in (
+        ("", LAUNCHES),
+        ("decode_", DECODE_LAUNCHES),
+        ("sharded_", SHARDED_LAUNCHES),
+    ):
+        for name, val in counter.snapshot().items():
+            out[f"{prefix}{name}"] = val
+    out["device_launches"] = DEVICES_PER_LAUNCH.device_launches
+    for devices, launches in sorted(DEVICES_PER_LAUNCH.snapshot().items()):
+        out[f"devices_per_launch.{devices}"] = launches
+    return out

@@ -228,6 +228,22 @@ class OSD(Dispatcher):
             ["ec_tpu_decode_aggregate_max_bytes"],
             lambda _n, v: self.decode_aggregator.configure(max_bytes=int(v)),
         )
+        # sharded-dispatch policy (ISSUE 6): the process-wide mesh fan-out
+        # knobs ride the same config/observer plumbing as the aggregators
+        from ..parallel import dispatch as shard_dispatch
+
+        shard_dispatch.configure(
+            min_batch=self.conf.get("ec_tpu_shard_min_batch"),
+            devices=self.conf.get("ec_tpu_shard_devices"),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_shard_min_batch"],
+            lambda _n, v: shard_dispatch.configure(min_batch=int(v)),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_shard_devices"],
+            lambda _n, v: shard_dispatch.configure(devices=int(v)),
+        )
         self.admin_socket = None
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
@@ -294,12 +310,17 @@ class OSD(Dispatcher):
         # distributions alongside the daemon counters
         agg_perf = self.encode_aggregator.perf
         dec_perf = self.decode_aggregator.perf
+        from ..ops import dispatch as ec_dispatch
+
         sock.register(
             "perf dump",
             lambda cmd: {
                 **self.perf.dump(),
                 "ec_aggregator": agg_perf.dump(),
                 "ec_decode_aggregator": dec_perf.dump(),
+                # process-wide launch counters incl. the sharded-launch /
+                # devices-per-launch dimension (ops/dispatch.py)
+                "ec_dispatch": ec_dispatch.perf_dump(),
             },
             "dump perf counters",
         )
@@ -514,6 +535,13 @@ class OSD(Dispatcher):
             perf[f"ec_aggregator.{name}"] = val
         for name, val in self.decode_aggregator.perf.dump().items():
             perf[f"ec_decode_aggregator.{name}"] = val
+        # launch counters incl. sharded launches / devices-per-launch
+        # (ops/dispatch.py): flat scalars, so the mgr prometheus scrape
+        # exports one ceph_tpu_ec_dispatch_* family per counter
+        from ..ops import dispatch as ec_dispatch
+
+        for name, val in ec_dispatch.perf_dump().items():
+            perf[f"ec_dispatch.{name}"] = val
         self._send_addr(
             self.mgr_addr,
             MMgrReport(

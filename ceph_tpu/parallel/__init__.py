@@ -1,4 +1,5 @@
-"""Pod-scale data distribution: mesh construction + sharded stripe pipelines."""
+"""Pod-scale data distribution: mesh construction, sharded stripe
+pipelines, and the live sharded-dispatch policy (parallel.dispatch)."""
 
 from .mesh import make_mesh
 from .sharded import sharded_decode, sharded_encode, scrub_step

@@ -32,6 +32,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+import numpy as np
+
+from ceph_tpu.ops.dispatch import record_launch
+from ceph_tpu.ops.packed_gf import PackedPlan, _packed_code_impl
 from ceph_tpu.ops.pallas_gf import CodingPlan
 from ceph_tpu.ops.xor_mm import xor_matmul
 
@@ -264,6 +268,98 @@ def plan_scrub_step(
     on each device's tile (shard_map) — the multi-chip scrub ships the
     same kernel as encode_chunks."""
     return _plan_scrub_executable(mesh, plan, k)(chunks)
+
+
+def _packed_shard_executable(mesh: Mesh, packed: PackedPlan, donate: bool):
+    """shard_map wrapper of the packed-plane kernel: each device runs the
+    fused plane-tower/XOR-schedule program (ops/packed_gf.py) on its own
+    (S/n, k, L) stripe tile — the multi-chip fan-out of the exact kernel
+    the aggregated single-device launch ships.
+
+    `donate=True` builds the `_packed_code_into` twin: a dead output
+    buffer (already sharded with the output's NamedSharding from a prior
+    launch at this geometry) is threaded through as a donated first
+    argument, so recurring aggregated launches recycle the allocation on
+    every device instead of growing each device's heap."""
+    spec = _stripe_spec(mesh)
+
+    def build():
+        if donate:
+            local = _shard_map(
+                # `out` is dead — it exists only to be donated; XLA
+                # aliases each device's result tile into it
+                lambda out, data: _packed_code_impl(
+                    data, packed.sched, packed.k, packed.m
+                ),
+                mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+                check_vma=False,
+            )
+            return jax.jit(local, donate_argnums=(0,))
+        local = _shard_map(
+            lambda data: _packed_code_impl(data, packed.sched, packed.k, packed.m),
+            mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
+        )
+        return jax.jit(local)
+
+    return _cached_exec(
+        ("packed", mesh, packed.sched, packed.k, packed.m, donate), build
+    )
+
+
+def sharded_coder_code(coder, data, mesh: Mesh, out=None) -> jax.Array:
+    """One (S, k, L) uint8 coding launch, data-parallel over the mesh's
+    stripe axis — the sharded dispatch mode of codec/matrix_codec.py's
+    `_DeviceCoder` (ISSUE 6 tentpole).
+
+    `coder` duck-types _DeviceCoder: `.plan` (Pallas CodingPlan or None),
+    `.packed` (PackedPlan), `.decode` (kind flag).  The batch is padded
+    to a stripe-shard multiple (zero stripes code to zero output — exact
+    for GF maps), placed with a NamedSharding over `stripe` (ONE sharded
+    H2D instead of a single-device put plus a reshard), run per-device
+    via the cached shard_map executable, and sliced back to the logical
+    stripe count.  Kernel choice per device mirrors the single-device
+    dispatch: the fused Pallas kernel on TPU-aligned chunk lengths
+    (lane_parallelism is 1, so the per-device tile keeps L), the packed
+    plane kernel otherwise — bytes are identical either way.
+
+    `out`: optional dead device buffer from a prior sharded launch at
+    this exact geometry AND sharding; consumed (donated) only on the
+    packed path with no remainder padding, ignored otherwise."""
+    S, _, L = data.shape
+    n = _stripe_shards(mesh)
+    pad = -S % n
+    record_launch(
+        S, int(np.prod(data.shape)), decode=coder.decode, devices=n
+    )
+    if pad:
+        if isinstance(data, np.ndarray):
+            data = np.concatenate(
+                [data, np.zeros((pad, *data.shape[1:]), dtype=np.uint8)]
+            )
+        else:
+            data = jnp.pad(data, ((0, pad), (0, 0), (0, 0)))
+    placed = jax.device_put(data, _stripe_sharding(mesh))
+    if coder.plan is not None and L % 128 == 0:
+        # trace-time caveat: the CodingPlan wrapper records its own
+        # (single) launch while the shard_map body is first traced; the
+        # per-dispatch accounting above is the authoritative count
+        result = _plan_encode_executable(mesh, coder.plan)(placed)
+    else:
+        packed = coder.packed
+        want = (S + pad, packed.m, L)
+        if (
+            not pad
+            and out is not None
+            and tuple(getattr(out, "shape", ())) == want
+            and getattr(out, "dtype", None) == jnp.uint8
+            and getattr(out, "sharding", None) == _stripe_sharding(mesh)
+        ):
+            result = _packed_shard_executable(mesh, packed, donate=True)(
+                out, placed
+            )
+        else:
+            result = _packed_shard_executable(mesh, packed, donate=False)(placed)
+    return result[:S] if pad else result
 
 
 def scrub_step(

@@ -145,6 +145,76 @@ def test_multiple_filesystems_independent_namespaces():
     asyncio.run(run())
 
 
+def test_zombie_active_is_fenced_before_standby_promotion():
+    """STALL rank 0 (partition its beacons, leave the daemon — flush
+    loop, sessions, RADOS client — running): the mon must blocklist the
+    zombie's RADOS client via the OSDMonitor BEFORE promoting the
+    standby (MDSMonitor::fail_mds_gid), so the zombie's in-flight
+    metadata writes bounce at every OSD instead of racing the promoted
+    standby's journal — the split-brain corruption window (ADVICE round
+    5, high)."""
+
+    async def run():
+        import json
+
+        from ceph_tpu.client.rados import RadosError
+
+        cluster = DevCluster(n_mons=1, n_osds=3, with_mgr=False, with_mds=True)
+        await cluster.start()
+        rados = Rados(cluster.monmap)
+        await rados.connect()
+        data_io = await rados.open_ioctx("cephfs_data")
+        fsc = CephFSClient(data_ioctx=data_io, monmap=cluster.monmap)
+        await fsc.connect()
+        await fsc.write_file("/pre", b"before the stall")
+        zombie = cluster.mds
+        standby = next(d for d in cluster.mds_daemons if d is not zombie)
+        zombie_client = zombie.rados.objecter.reqid_name
+        # stall, don't stop: beacons cease (the partition) but the
+        # daemon's flush loop and RADOS client stay alive — a zombie
+        zombie._beacon_task.cancel()
+        await wait_until(
+            lambda: standby.state == "active",
+            BEACON_GRACE + 10.0,
+            "standby promoted to rank 0",
+        )
+        # the fence must already be committed: the zombie's client is in
+        # the blocklist and every OSD has applied the epoch
+        rv, _, out = await rados.mon_command({"prefix": "osd blocklist ls"})
+        assert rv == 0
+        assert zombie_client in json.loads(out), "zombie was never fenced"
+        await wait_until(
+            lambda: all(
+                zombie_client in o.osdmap.blocklist for o in cluster.osds
+            ),
+            10.0,
+            "blocklist epoch reaching the OSDs",
+        )
+        # the zombie's writes into the metadata pool now bounce — the
+        # split-brain write is dead even though the process is alive
+        with pytest.raises((RadosError, TimeoutError)):
+            await zombie.meta.write_full("zombie_marker", b"stale active")
+        # the promoted standby serves: old data visible, new writes land
+        assert await fsc.read_file("/pre") == b"before the stall"
+        await fsc.write_file("/post", b"after failover")
+        assert await fsc.read_file("/post") == b"after failover"
+        rv, _, out = await rados.mon_command({"prefix": "fs status"})
+        assert json.loads(out)["filesystems"][0]["rank0"] == standby.name
+        cluster.mds_daemons.remove(zombie)
+        cluster.mds = standby
+        # direct teardown of the zombie (its stop() would try to flush
+        # through the fenced client and hang)
+        for t in (zombie._flush_task, zombie._activate_task):
+            if t is not None:
+                t.cancel()
+        await zombie.msgr.shutdown()
+        await fsc.shutdown()
+        await rados.shutdown()
+        await cluster.stop()
+
+    asyncio.run(run())
+
+
 def test_active_mds_failover_with_journal_replay():
     """Kill rank 0 WITHOUT flushing (a crash): the mon fails it over on
     beacon timeout, the standby replays the journal, and a monmap-driven

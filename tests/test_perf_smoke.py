@@ -12,7 +12,13 @@ import numpy as np
 
 from ceph_tpu.codec import ErasureCodeTpuRs
 from ceph_tpu.codec.matrix_codec import PLAN_CACHE
-from ceph_tpu.ops.dispatch import DECODE_LAUNCHES, LAUNCHES
+from ceph_tpu.ops.dispatch import (
+    DECODE_LAUNCHES,
+    DEVICES_PER_LAUNCH,
+    LAUNCHES,
+    SHARDED_LAUNCHES,
+    perf_dump,
+)
 from ceph_tpu.stripe import StripeInfo
 from ceph_tpu.stripe import stripe as stripe_mod
 
@@ -120,3 +126,69 @@ class TestPerfSmoke:
         s1 = PLAN_CACHE.stats()
         assert s1["hits"] - s0["hits"] == 5
         assert s1["misses"] == s0["misses"], "steady-state decode rebuilt a plan"
+
+
+class TestShardedCounters:
+    """Sharded-launch counter consistency (ISSUE 6 satellite): the
+    counters feed asok perf dump and the mgr Prometheus scrape — these
+    invariants keep them from silently rotting."""
+
+    def test_sharded_launches_never_exceed_total(self):
+        """By construction every sharded dispatch also lands on the
+        global total: SHARDED_LAUNCHES <= LAUNCHES, always."""
+        s, t = SHARDED_LAUNCHES.snapshot(), LAUNCHES.snapshot()
+        assert s["launches"] <= t["launches"]
+        assert s["stripes"] <= t["stripes"]
+        assert s["bytes"] <= t["bytes"]
+        assert DECODE_LAUNCHES.snapshot()["launches"] <= t["launches"]
+
+    def test_devices_per_launch_histogram_consistent(self):
+        """Occupancy distribution vs the launch counters: every dispatch
+        records exactly one occupancy sample, multi-device samples equal
+        the sharded-launch total, and a 1-device dispatch reports zero
+        sharded launches."""
+        from ceph_tpu.parallel import dispatch as shard_dispatch
+
+        ec = make_rs()
+        rng = np.random.default_rng(7)
+        min_batch, devices = shard_dispatch.settings()
+        try:
+            # a guaranteed-sharded launch, then a guaranteed-single one
+            shard_dispatch.configure(min_batch=16, devices=0)
+            t0 = LAUNCHES.snapshot()["launches"]
+            s0 = SHARDED_LAUNCHES.snapshot()["launches"]
+            d0 = DEVICES_PER_LAUNCH.snapshot()
+            ec.encode_array(rng.integers(0, 256, (32, 4, 4096), dtype=np.uint8))
+            shard_dispatch.configure(devices=1)  # degenerate mesh: 1-device run
+            ec.encode_array(rng.integers(0, 256, (32, 4, 4096), dtype=np.uint8))
+        finally:
+            shard_dispatch.configure(min_batch=min_batch, devices=devices)
+        t1 = LAUNCHES.snapshot()["launches"]
+        s1 = SHARDED_LAUNCHES.snapshot()["launches"]
+        d1 = DEVICES_PER_LAUNCH.snapshot()
+        assert t1 - t0 == 2
+        assert s1 - s0 == 1, "exactly the wide launch lands on SHARDED_LAUNCHES"
+        occ_delta = {
+            n: d1.get(n, 0) - d0.get(n, 0) for n in set(d0) | set(d1)
+        }
+        assert sum(occ_delta.values()) == 2, "one occupancy sample per dispatch"
+        assert occ_delta.get(1, 0) == 1, "the 1-device run must sample width 1"
+        wide = sum(v for n, v in occ_delta.items() if n > 1)
+        assert wide == s1 - s0, "multi-device samples must equal sharded total"
+
+    def test_perf_dump_exports_sharded_dimension(self):
+        """The asok/mgr export payload carries the sharded counters and
+        the devices-per-launch distribution, internally consistent."""
+        dump = perf_dump()
+        for key in ("launches", "sharded_launches", "decode_launches",
+                    "device_launches"):
+            assert key in dump, f"missing {key} in ec_dispatch perf dump"
+        assert dump["sharded_launches"] <= dump["launches"]
+        occ = {
+            int(k.split(".")[1]): v
+            for k, v in dump.items()
+            if k.startswith("devices_per_launch.")
+        }
+        assert sum(occ.values()) == dump["launches"]
+        assert sum(v for n, v in occ.items() if n > 1) == dump["sharded_launches"]
+        assert sum(n * v for n, v in occ.items()) == dump["device_launches"]
