@@ -517,6 +517,16 @@ def run_child(platform: str, mc_only: bool = False) -> None:
         "parity_ok": True,
         "probe_s": round(probe_s, 3),
     }
+    # degraded-backend verdict (ISSUE 7 device guard): a run whose
+    # launches fell back to the host oracle must say so, or a silently
+    # degraded chip reads as a kernel regression in the headline number
+    from ceph_tpu.ops import dispatch as ec_dispatch
+    from ceph_tpu.ops.guard import device_guard
+
+    fallbacks = ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"]
+    if device_guard().degraded or fallbacks:
+        result["backend_degraded"] = bool(device_guard().degraded)
+        result["fallback_launches"] = fallbacks
     if decode_result is not None:
         result["decode"] = decode_result
     elif decode_err:

@@ -59,9 +59,19 @@ class Objecter(Dispatcher):
         # reqids.  Without the nonce, a second process reusing the name
         # restarts tids at 1 and the PG's dup detection would serve it
         # the FIRST process's remembered replies instead of applying.
+        import random
         import secrets
 
         self.reqid_name = f"{name}.{secrets.token_hex(4)}"
+        # resend pacing: per-instance rng so many clients retrying through
+        # the same map churn spread out instead of thundering in lockstep
+        self._backoff_rng = random.Random(secrets.randbits(32))
+        from ..common.perf_counters import PerfCountersBuilder
+
+        b = PerfCountersBuilder(name)
+        for c in ("op", "op_resend", "op_reply", "op_timeout"):
+            b.add_u64_counter(c)
+        self.perf = b.create_perf_counters()
         self.msgr = Messenger(
             name, auth=auth, secure=secure, compress=compress, stack=stack
         )
@@ -196,14 +206,28 @@ class Objecter(Dispatcher):
         finally:
             span.finish()
 
+    def _backoff_delay(self, attempt: int, base: float = 0.05,
+                       cap: float = 1.0) -> float:
+        """Bounded exponential backoff with jitter for op resends: many
+        clients retrying through the same osdmap churn must NOT
+        synchronize into resend storms, so each retry waits
+        base * 2^attempt (capped at ~1 s) scaled by a uniform [0.5, 1.0)
+        jitter — the classic decorrelated-retry shape."""
+        return min(cap, base * (1 << min(attempt, 16))) * (
+            0.5 + self._backoff_rng.random() / 2.0
+        )
+
     async def _op_submit(
         self, pool_id, oid, ops, timeout, ps, snap_seq, snaps, snap_id,
         reqid, span,
     ) -> MOSDOpReply:
         deadline = time.monotonic() + timeout
+        self.perf.inc("op")
+        attempt = 0
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                self.perf.inc("op_timeout")
                 raise TimeoutError(f"op {reqid.key()} on {oid} timed out")
             if ps is not None:
                 _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(
@@ -240,18 +264,28 @@ class Objecter(Dispatcher):
                     fut, min(remaining, 2.0)
                 )
             except (ConnectionError, asyncio.TimeoutError):
-                # Peer died or reply lost: re-target after a map change (or
-                # a short delay) and resend — Objecter's resend loop.
+                # Peer died or reply lost: re-target after a map change
+                # (or a backoff delay) and resend — Objecter's resend
+                # loop, paced so client fleets don't retry in lockstep.
                 span.event("resend: connection lost or reply timed out")
+                self.perf.inc("op_resend")
                 self._replies.pop(reqid.tid, None)
-                await self._wait_map_change(min(remaining, 0.3))
+                await self._wait_map_change(
+                    min(remaining, self._backoff_delay(attempt))
+                )
+                attempt += 1
                 continue
             if reply.result == -EAGAIN:
                 # Not primary / not yet active: refresh + retry.
                 span.event("resend: target not active (-EAGAIN)")
-                await self._wait_map_change(min(remaining, 0.3))
+                self.perf.inc("op_resend")
+                await self._wait_map_change(
+                    min(remaining, self._backoff_delay(attempt))
+                )
+                attempt += 1
                 continue
             span.event("reply received")
+            self.perf.inc("op_reply")
             return reply
 
     async def _wait_map_change(self, timeout: float) -> None:

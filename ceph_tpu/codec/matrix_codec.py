@@ -37,6 +37,14 @@ from .interface import EcError
 
 DECODE_LRU_CAPACITY = 2516
 
+# Host-oracle decode-plan memo (decode_array_host): pure-numpy expanded
+# bit-matrices keyed by (distribution matrix, erasure pattern), bounded
+# like the device-side decode LRU but kept fully separate from the
+# jnp-backed PLAN_CACHE — degraded mode must never touch the runtime.
+_HOST_DECODE_CAPACITY = 256
+_HOST_DECODE_PLANS: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+_HOST_DECODE_LOCK = threading.Lock()
+
 
 def _trace_local(x) -> bool:
     """True when `x` was created inside a jax.jit/vmap trace.  Trace-local
@@ -458,6 +466,7 @@ class _AggGroup:
     __slots__ = (
         "key", "ec", "ctx", "arrays", "tickets", "stripes", "nbytes",
         "parity", "host", "pad", "error", "donatable", "lock",
+        "input", "credit",
     )
 
     def __init__(self, key, ec, ctx=None):
@@ -473,6 +482,11 @@ class _AggGroup:
         self.pad = 0
         self.error: BaseException | None = None  # a failed launch, sticky
         self.donatable = False  # launch path can reuse a donated buffer
+        # concatenated padded launch input, retained from launch until
+        # settle so a device that wedges AFTER dispatch can still be
+        # recomputed on the host oracle
+        self.input: np.ndarray | None = None
+        self.credit = 0  # inflight-byte throttle credit held by this group
         # serializes THIS group's launch/materialization (the encode
         # dispatch + blocking device wait) without stalling the
         # aggregator-wide lock; RLock because a reap-forced launch runs
@@ -508,8 +522,10 @@ class LaunchAggregator:
     PERF_NAME = "ec_aggregator"
     WHAT = "encode"  # used in error reports
 
-    def __init__(self, window: int = 0, max_bytes: int = 64 << 20, pad_pow2: bool = True):
+    def __init__(self, window: int = 0, max_bytes: int = 64 << 20,
+                 pad_pow2: bool = True, inflight_max_bytes: int | None = None):
         from ceph_tpu.common.perf_counters import PerfCountersBuilder
+        from ceph_tpu.common.throttle import Throttle
 
         self.window = int(window)
         self.max_bytes = int(max_bytes)
@@ -519,10 +535,23 @@ class LaunchAggregator:
         self._lock = threading.RLock()
         self._groups: "OrderedDict[tuple, _AggGroup]" = OrderedDict()
         self._donate_pool: dict[tuple, object] = {}  # shape -> dead output buf
+        # end-to-end backpressure (ec_tpu_inflight_max_bytes): byte credit
+        # over everything admitted but not yet settled — windowed groups
+        # AND launched-but-unreaped ones.  Over the bound, _admit makes
+        # the SUBMITTER settle older launches first.
+        if inflight_max_bytes is None:
+            from ceph_tpu.common.options import OPTIONS
+
+            inflight_max_bytes = int(OPTIONS["ec_tpu_inflight_max_bytes"].default)
+        self.inflight = Throttle(
+            f"{self.PERF_NAME}.inflight", int(inflight_max_bytes)
+        )
+        self._live: list[_AggGroup] = []  # launched, not yet settled (FIFO)
         b = PerfCountersBuilder(self.PERF_NAME)
         for c in ("submits", "launches", "flush_window", "flush_bytes",
                   "flush_explicit", "flush_immediate", "flush_reap",
-                  "pad_stripes"):
+                  "flush_backpressure", "pad_stripes", "host_fallbacks",
+                  "throttle_stalls"):
             b.add_u64_counter(c)
         b.add_histogram("stripes_per_launch",
                         "stripe-batch occupancy of each device launch",
@@ -535,17 +564,25 @@ class LaunchAggregator:
                         lowest=4096, buckets=18)
         self.perf = b.create_perf_counters()
 
-    def configure(self, window: int | None = None, max_bytes: int | None = None) -> None:
+    def configure(self, window: int | None = None, max_bytes: int | None = None,
+                  inflight_max_bytes: int | None = None) -> None:
         """Apply live config (the OSD wires its Config + runtime observers
         here, so the aggregate_* settings reach the shared instance)."""
         if window is not None:
             self.window = int(window)
         if max_bytes is not None:
             self.max_bytes = int(max_bytes)
+        if inflight_max_bytes is not None:
+            self.inflight.limit = int(inflight_max_bytes)
 
     # -- subclass hooks ------------------------------------------------------
 
     def _dispatch(self, g: _AggGroup, data: np.ndarray, donate):
+        raise NotImplementedError
+
+    def _dispatch_host(self, g: _AggGroup, data: np.ndarray) -> np.ndarray:
+        """Byte-identical host-oracle recompute of `_dispatch` (pure
+        numpy): the DEGRADED-mode path a wedged device cannot hang."""
         raise NotImplementedError
 
     def _out_shape(self, g: _AggGroup, data_shape) -> tuple:
@@ -559,8 +596,10 @@ class LaunchAggregator:
     def _submit(self, key, ec, ctx, shaped: np.ndarray) -> AggTicket:
         """Queue one (stripes, k, L) uint8 batch under `key`; returns its
         ticket.  May launch (this or earlier submissions) when a threshold
-        trips."""
+        trips.  Admission is throttled: past ec_tpu_inflight_max_bytes of
+        unsettled work, this call settles older launches first."""
         stripes = shaped.shape[0]
+        self._admit(shaped.nbytes)
         reason = None
         with self._lock:
             self.perf.inc("submits")
@@ -572,6 +611,7 @@ class LaunchAggregator:
             g.tickets.append(ticket)
             g.stripes += stripes
             g.nbytes += shaped.nbytes
+            g.credit += shaped.nbytes
             if self.window <= 1:
                 reason = "flush_immediate"
             elif g.nbytes >= self.max_bytes:
@@ -589,6 +629,47 @@ class LaunchAggregator:
                 # tear down its unrelated write)
                 pass
         return ticket
+
+    def _admit(self, nbytes: int) -> None:
+        """Backpressure admission (the byte Throttle): take credit for a
+        submission; over the bound, the SUBMITTER settles the oldest
+        outstanding launches — paying the drain latency itself — until
+        credit frees.  Pushing back on the producer is the point: a
+        degraded/slow backend must stall its writers, not queue device
+        work unboundedly.  A single submission larger than the whole
+        bound is admitted once nothing older remains (the reference
+        Throttle's oversized-request semantics: the dispatch path must
+        not wedge)."""
+        if self.inflight.get_or_fail(nbytes):
+            return
+        self.perf.inc("throttle_stalls")
+        while not self.inflight.get_or_fail(nbytes):
+            if not self._settle_oldest():
+                self.inflight.take(nbytes)  # oversized: admit anyway
+                return
+
+    def _settle_oldest(self) -> bool:
+        """Settle one outstanding group, oldest first — launched groups
+        before windowed ones (their credit frees on a blocking wait;
+        windowed groups must be launched first).  False when nothing is
+        outstanding."""
+        with self._lock:
+            if self._live:
+                g = self._live[0]
+            elif self._groups:
+                g = next(iter(self._groups.values()))
+            else:
+                return False
+        if g.parity is None and g.host is None and g.error is None:
+            with self._lock:
+                if self._groups.get(g.key) is g:
+                    del self._groups[g.key]
+            try:
+                self._launch(g, "flush_backpressure")
+            except Exception:
+                pass  # sticky on the group; settle releases its credit
+        self._settle(g)
+        return True
 
     def pending(self) -> int:
         """Submissions queued but not yet launched."""
@@ -647,16 +728,29 @@ class LaunchAggregator:
             if g.donatable:
                 with self._lock:
                     donate = self._donate_pool.pop(out_shape, None)
+            # retained until settle: a device that wedges AFTER this
+            # dispatch is recomputed from these exact bytes on the host
+            g.input = data
             try:
-                parity = self._dispatch(g, data, donate)
+                parity = self._guarded_dispatch(g, data, donate)
             except BaseException as e:
                 # sticky: every co-rider's reap reports the launch failure
-                # instead of crashing on a half-torn group
+                # instead of crashing on a half-torn group.  The group
+                # still enters the live list so its backpressure credit
+                # releases at settle.
                 g.error = e
+                g.pad = pad
+                with self._lock:
+                    self._live.append(g)
                 raise
             g.arrays = []
             g.pad = pad
             g.parity = parity
+            # inside g.lock, like the error path above: appending after
+            # release races a reaper that settles (and _live-removes) the
+            # group first, which would pin a settled group in _live
+            with self._lock:
+                self._live.append(g)
         self.perf.inc("launches")
         self.perf.inc(reason)
         self.perf.inc("pad_stripes", pad)
@@ -664,12 +758,62 @@ class LaunchAggregator:
         self.perf.hinc("tickets_per_launch", len(g.tickets))
         self.perf.hinc("launch_bytes", data.nbytes)
 
-    def _materialize(self, ticket: AggTicket) -> None:
-        # Lock order: group lock -> aggregator lock (nothing acquires the
-        # other way).  The blocking device wait + D2H copy runs outside
-        # the aggregator-wide lock so other geometries never stall behind
-        # a kernel.
-        g = ticket._group
+    # -- device guard / host fallback ---------------------------------------
+
+    def _guarded_dispatch(self, g: _AggGroup, data: np.ndarray, donate):
+        """Dispatch one launch under the device guard: the `codec.launch`
+        faultpoint and the per-launch deadline apply here; a device error
+        or timeout re-runs the group on the byte-identical host oracle
+        and marks the backend DEGRADED.  While degraded, the device is
+        bypassed entirely until a probe heals it."""
+        from ceph_tpu.common.fault_injector import faultpoint
+        from ceph_tpu.ops.guard import device_guard
+
+        guard = device_guard()
+        if not guard.maybe_probe():
+            # DEGRADED, probe not due (or failed): straight to the host
+            return self._host_fallback(g, data, None)
+        try:
+            faultpoint("codec.launch")
+            return guard.call(
+                lambda: self._dispatch(g, data, donate),
+                what=f"{self.WHAT} dispatch",
+            )
+        except BaseException as e:
+            return self._host_fallback(g, data, e)
+
+    def _host_fallback(self, g: _AggGroup, data: np.ndarray, cause):
+        """Re-run a launch on the host oracle.  `cause` is the device
+        failure that sent us here (None = degraded-mode bypass); the
+        backend is marked DEGRADED only when the host recompute SUCCEEDS
+        after a device failure — a recompute that fails identically
+        (singular matrix, bad geometry) is a data error, not a backend
+        verdict, and raises sticky like any launch failure."""
+        host = self._dispatch_host(g, data)
+        if cause is not None:
+            from ceph_tpu.ops.guard import device_guard
+
+            device_guard().mark_degraded(
+                f"{self.WHAT} launch failed: {cause!r}"
+            )
+        from ceph_tpu.ops.dispatch import record_fallback
+
+        record_fallback(data.shape[0], data.nbytes)
+        self.perf.inc("host_fallbacks")
+        return host
+
+    # -- settle / reap -------------------------------------------------------
+
+    def _settle(self, g: _AggGroup) -> None:
+        """Resolve a group to host bytes (or a sticky error), releasing
+        its backpressure credit exactly once.  Lock order: group lock ->
+        aggregator lock (nothing acquires the other way); the blocking
+        device wait runs outside the aggregator-wide lock so other
+        geometries never stall behind a kernel.  The wait itself is
+        deadline-guarded: a device that wedges AFTER dispatch triggers
+        the same host recompute as a failed dispatch."""
+        from ceph_tpu.ops.guard import device_guard
+
         with g.lock:
             if g.host is None and g.error is None and g.parity is None:
                 # still windowed: detach and launch it ourselves (a reap
@@ -683,29 +827,59 @@ class LaunchAggregator:
                 try:
                     self._launch(g, "flush_reap")
                 except Exception:
-                    pass  # reported as EcError via g.error below
-            if g.error is not None:
-                raise EcError(
-                    EIO, f"aggregated {self.WHAT} launch failed: {g.error!r}"
-                )
-            if g.host is None:
+                    pass  # reported as EcError via g.error at the reap
+            if g.host is None and g.error is None:
                 parity = g.parity
-                if len(g.tickets) == 1 and not g.pad:
-                    # single-ticket unpadded group (the window<=1 default
-                    # path): hand the device result straight through —
-                    # no forced copy, no donation-pool recycling
-                    g.host = np.asarray(parity)
-                else:
+                device_side = not isinstance(parity, np.ndarray)
+                single = len(g.tickets) == 1 and not g.pad
+                host = parity
+                if device_side:
                     # when the buffer is headed for the donation pool the
                     # copy MUST be forced (np.array): a zero-copy
                     # CPU-backend view into a later-donated buffer would
-                    # corrupt silently
-                    host = np.array(parity) if g.donatable else np.asarray(parity)
-                    g.host = host[: g.stripes] if g.pad else host
-                    if g.donatable and not isinstance(parity, np.ndarray):
-                        with self._lock:
-                            self._donate_pool[tuple(parity.shape)] = parity
-                g.parity = None
+                    # corrupt silently.  Single-ticket unpadded groups
+                    # (the window<=1 default path) hand the result
+                    # straight through — no forced copy, no pooling.
+                    force_copy = g.donatable and not single
+                    try:
+                        host = device_guard().call(
+                            lambda: np.array(parity)
+                            if force_copy
+                            else np.asarray(parity),
+                            what=f"{self.WHAT} materialize",
+                        )
+                    except BaseException as e:
+                        try:
+                            host = self._host_fallback(g, g.input, e)
+                        except BaseException as e2:
+                            g.error = e2
+                        device_side = False  # suspect buffer: never pool it
+                if g.error is None:
+                    if single:
+                        g.host = host
+                    else:
+                        g.host = host[: g.stripes] if g.pad else host
+                        if g.donatable and device_side:
+                            with self._lock:
+                                self._donate_pool[tuple(parity.shape)] = parity
+                    g.parity = None
+            # settled (host bytes or sticky error): release the
+            # backpressure credit and the retained launch input
+            if g.credit:
+                self.inflight.put(g.credit)
+                g.credit = 0
+            g.input = None
+        with self._lock:
+            if g in self._live:
+                self._live.remove(g)
+
+    def _materialize(self, ticket: AggTicket) -> None:
+        g = ticket._group
+        self._settle(g)
+        if g.error is not None:
+            raise EcError(
+                EIO, f"aggregated {self.WHAT} launch failed: {g.error!r}"
+            )
         ticket._value = g.host[ticket._start : ticket._start + ticket._stripes]
 
 
@@ -725,6 +899,9 @@ class EncodeAggregator(LaunchAggregator):
 
     def _dispatch(self, g: _AggGroup, data: np.ndarray, donate):
         return g.ec.encode_array(data, out=donate)
+
+    def _dispatch_host(self, g: _AggGroup, data: np.ndarray) -> np.ndarray:
+        return g.ec.encode_array_host(data)
 
     def _out_shape(self, g: _AggGroup, data_shape) -> tuple:
         return (
@@ -770,6 +947,9 @@ class DecodeAggregator(LaunchAggregator):
 
     def _dispatch(self, g: _AggGroup, data: np.ndarray, donate):
         return g.ec.decode_array(list(g.ctx), data, out=donate)
+
+    def _dispatch_host(self, g: _AggGroup, data: np.ndarray) -> np.ndarray:
+        return g.ec.decode_array_host(list(g.ctx), data)
 
     def _out_shape(self, g: _AggGroup, data_shape) -> tuple:
         return (data_shape[0], len(g.ctx), data_shape[2])
@@ -973,6 +1153,52 @@ class MatrixCodecMixin:
             self.distribution_matrix(), list(erasures), self.k
         )
         return _coder_donatable(coder, data_shape)
+
+    def encode_array_host(self, data) -> np.ndarray:
+        """Byte-identical HOST oracle of encode_array: pure numpy end to
+        end, so a wedged device runtime can never hang it.  This is the
+        DEGRADED-mode fallback the launch watchdog (ops/guard.py) re-runs
+        aggregated encodes on — same xor fast path gate, same bit-matrix,
+        same GF(2) reduction as the device kernels."""
+        from ceph_tpu.gf.bitslice import xor_matmul_host_batch
+
+        mat = self.distribution_matrix()
+        arr = np.asarray(data, dtype=np.uint8)
+        if self.m == 1 and self._xor_row_available():
+            return np.bitwise_xor.reduce(arr, axis=-2)[..., None, :]
+        return xor_matmul_host_batch(expand_matrix(mat[self.k :]), arr)
+
+    def decode_array_host(self, erasures: list[int], survivors) -> np.ndarray:
+        """Byte-identical HOST oracle of decode_array (pure numpy): the
+        decode plan is built with the same isa_decode_matrix Gaussian
+        the cached coder was built from, so reconstruction through the
+        fallback path matches the device result bit for bit.  Plans are
+        memoized host-side (never through the jnp-backed PLAN_CACHE —
+        a wedged runtime can hang any jnp call): degraded-mode recovery
+        repeats ONE erasure pattern across many launches and must not
+        pay the O(k^3) inversion each time."""
+        from ceph_tpu.gf.bitslice import xor_matmul_host_batch
+
+        dist = self.distribution_matrix()
+        key = (dist.shape, dist.tobytes(), tuple(erasures))
+        with _HOST_DECODE_LOCK:
+            bm = _HOST_DECODE_PLANS.get(key)
+            if bm is not None:
+                _HOST_DECODE_PLANS.move_to_end(key)
+        if bm is None:
+            plan = isa_decode_matrix(dist, list(erasures), self.k)
+            if plan is None:
+                raise EcError(
+                    EIO, f"singular decode matrix for erasures {erasures}"
+                )
+            c, _idx = plan
+            bm = expand_matrix(c)
+            with _HOST_DECODE_LOCK:
+                _HOST_DECODE_PLANS[key] = bm
+                _HOST_DECODE_PLANS.move_to_end(key)
+                while len(_HOST_DECODE_PLANS) > _HOST_DECODE_CAPACITY:
+                    _HOST_DECODE_PLANS.popitem(last=False)
+        return xor_matmul_host_batch(bm, np.asarray(survivors, dtype=np.uint8))
 
     def decode_index(self, erasures: list[int]) -> list[int]:
         _, idx = PLAN_CACHE.decode_plan(self.distribution_matrix(), erasures, self.k)

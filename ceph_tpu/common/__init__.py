@@ -8,7 +8,13 @@ framework, throttles, fault injection, and span tracing.
 
 from .config import Config, ConfigObserver
 from .encoding import Decoder, Encoder, Encodable
-from .fault_injector import FaultInjector
+from .fault_injector import (
+    FAULT_POINTS,
+    FaultInjector,
+    InjectedFailure,
+    faultpoint,
+    global_injector,
+)
 from .options import OPTIONS, Option, OptionLevel
 from .perf_counters import PerfCounters, PerfCountersBuilder, PerfCountersCollection
 from .throttle import Throttle
@@ -20,7 +26,11 @@ __all__ = [
     "Decoder",
     "Encodable",
     "Encoder",
+    "FAULT_POINTS",
     "FaultInjector",
+    "InjectedFailure",
+    "faultpoint",
+    "global_injector",
     "OPTIONS",
     "Option",
     "OptionLevel",

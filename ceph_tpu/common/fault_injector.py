@@ -76,9 +76,61 @@ class FaultInjector:
             return point in self._points or point in self._probabilistic
 
 
+# The injection-point catalog: every name wired through `faultpoint()`
+# anywhere in the tree MUST be registered here, and every entry must be
+# documented in docs/ROBUSTNESS.md — tests/test_faultpoint_lint.py
+# enforces both directions, so a hook can neither go stale in the docs
+# nor be armed under a typo'd name that silently never fires.
+FAULT_POINTS: dict[str, str] = {
+    "msgr.send": (
+        "messenger frame send, checked before any bytes reach the wire "
+        "(ms_inject_socket_failures semantics: lossy connections reset, "
+        "lossless ones transparently reconnect and resend)"
+    ),
+    "msgr.recv": (
+        "messenger frame receive, checked after a frame is read; faults "
+        "the connection like a peer reset (the already-read frame is "
+        "lost, as a real mid-delivery connection death would lose it)"
+    ),
+    "os.read": (
+        "objectstore read() data path (memstore + bluestore; stat/attr "
+        "lookups stay clean): raises StoreError(EIO), the "
+        "test-erasure-eio.sh disk-error analog"
+    ),
+    "os.write": (
+        "objectstore queue_transaction (every backend, checked before "
+        "any op is applied or staged): raises StoreError(EIO), failing "
+        "the transaction whole — per-op injection would tear it, since "
+        "apply does not roll back"
+    ),
+    "ec.sub_read": (
+        "EC shard-side sub-read in ECBackend.handle_sub_read: the shard "
+        "answers with a per-object EIO, driving redundant-read "
+        "escalation and reconstruction on the primary"
+    ),
+    "codec.launch": (
+        "device coding-launch submit in LaunchAggregator._launch: the "
+        "device dispatch fails and the group re-runs on the byte-"
+        "identical host oracle (gf/bitslice.py), marking the backend "
+        "DEGRADED"
+    ),
+}
+
+
 # Process-wide injector used by daemons when none is passed explicitly.
 _global = FaultInjector()
 
 
 def global_injector() -> FaultInjector:
     return _global
+
+
+def faultpoint(point: str) -> None:
+    """Check a REGISTERED injection point on the process-global injector.
+
+    The one spelling every wired seam uses (and the one the lint greps
+    for): an unregistered name is a programming error, raised eagerly so
+    a typo cannot create a hook that never fires."""
+    if point not in FAULT_POINTS:
+        raise ValueError(f"unregistered fault point {point!r}")
+    _global.check(point)

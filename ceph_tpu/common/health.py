@@ -33,6 +33,31 @@ def slow_ops_detail(slow: dict[str, dict]) -> list[str]:
     ]
 
 
+def tpu_degraded_summary(degraded: dict[str, dict]) -> str | None:
+    """The TPU_BACKEND_DEGRADED check summary for a per-daemon degraded
+    slice ({daemon: {degraded_for_sec, reason, fallback_launches}}), or
+    None when every backend is healthy.  Shared by the mon health check
+    and the mgr's healthcheck gauge so the two surfaces agree."""
+    if not degraded:
+        return None
+    longest = max(v.get("degraded_for_sec", 0.0) for v in degraded.values())
+    return (
+        f"{len(degraded)} daemon(s) dispatching EC on the host fallback "
+        f"(device backend degraded, longest for {longest:.0f} sec): "
+        f"[{','.join(sorted(degraded))}]"
+    )
+
+
+def tpu_degraded_detail(degraded: dict[str, dict]) -> list[str]:
+    """Per-daemon breakdown lines (`health detail`)."""
+    return [
+        f"{d}: degraded {v.get('degraded_for_sec', 0.0):.0f} sec "
+        f"({v.get('fallback_launches', 0)} host-fallback launches): "
+        f"{v.get('reason', '') or 'unknown'}"
+        for d, v in sorted(degraded.items())
+    ]
+
+
 def down_in_osds(osdmap) -> list:
     """OSDs that are IN but not up — the OSD_DOWN population.  A
     decommissioned (out) osd being down is healthy by design, as in the

@@ -155,6 +155,49 @@ OPTIONS: dict[str, Option] = _opts(
         runtime=True,
     ),
     Option(
+        "ec_tpu_launch_timeout_ms",
+        int,
+        20000,
+        A,
+        "per-launch deadline (ms) for EC device dispatches and their "
+        "blocking materialization, enforced by a watchdog thread "
+        "(ops/guard.py DeviceGuard).  A launch that exceeds it marks the "
+        "backend DEGRADED and re-runs on the byte-identical host oracle "
+        "(gf/bitslice.py) so in-flight writes/recoveries complete instead "
+        "of chain-aborting behind a wedged TPU.  <= 0 disables the "
+        "watchdog (launches may block forever, the pre-ISSUE-7 behavior)",
+        see_also=("ec_tpu_probe_interval_ms",),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_probe_interval_ms",
+        int,
+        2000,
+        A,
+        "while DEGRADED, re-probe the device backend with a tiny compile "
+        "probe at most this often (ms); a probe that completes under the "
+        "launch deadline self-heals dispatch back to the TPU path and "
+        "clears the TPU_BACKEND_DEGRADED health check.  <= 0 disables "
+        "re-probing (degraded mode is then sticky until restart)",
+        see_also=("ec_tpu_launch_timeout_ms",),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_inflight_max_bytes",
+        int,
+        256 << 20,
+        A,
+        "end-to-end backpressure bound: input bytes admitted into the EC "
+        "launch aggregators (windowed + launched-but-unreaped) before a "
+        "new submission must first settle older launches.  Bounds the "
+        "memory a degraded/slow backend can queue behind itself and "
+        "pushes back on submitters instead of growing the window "
+        "unboundedly.  <= 0 disables admission control",
+        see_also=("ec_tpu_aggregate_max_bytes",
+                  "ec_tpu_decode_aggregate_max_bytes"),
+        runtime=True,
+    ),
+    Option(
         "ec_tpu_shard_min_batch",
         int,
         32,

@@ -28,6 +28,26 @@ class Throttle:
         with self._cond:
             return self._count
 
+    @property
+    def limit(self) -> int:
+        with self._cond:
+            return self._limit
+
+    @limit.setter
+    def limit(self, value: int) -> None:
+        """Runtime-mutable bound (Throttle::reset_max): raising it wakes
+        blocked producers; 0 disables the throttle."""
+        with self._cond:
+            self._limit = int(value)
+            self._cond.notify_all()
+
+    def take(self, amount: int = 1) -> None:
+        """Unconditionally take credit, even past the limit — the
+        reference's Throttle::take for work that must be admitted
+        (oversized requests once nothing older remains)."""
+        with self._cond:
+            self._count += amount
+
     def get(self, amount: int = 1) -> None:
         """Take credit, blocking while over limit (Throttle::get).
 

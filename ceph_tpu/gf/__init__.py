@@ -32,6 +32,7 @@ from .bitslice import (
     expand_matrix,
     unbitslice_bytes,
     xor_matmul_host,
+    xor_matmul_host_batch,
 )
 
 __all__ = [
@@ -43,4 +44,5 @@ __all__ = [
     "jerasure_r6_matrix", "jerasure_vandermonde_matrix",
     "vandermonde_mds_check", "bitslice_bytes", "coeff_bitmatrix",
     "expand_matrix", "unbitslice_bytes", "xor_matmul_host",
+    "xor_matmul_host_batch",
 ]

@@ -76,3 +76,46 @@ def xor_matmul_host(bit_matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
     planes = bitslice_bytes(data)
     out_planes = (bit_matrix.astype(np.int32) @ planes.astype(np.int32)) & 1
     return unbitslice_bytes(out_planes.astype(np.uint8))
+
+
+# Host-oracle working-set bound: the int32 plane expansion below costs
+# ~40x its input slice, so stripe batches process in slices of at most
+# this many input bytes (~8 MiB slice -> ~320 MiB transient), keeping
+# the fallback of a max-size aggregated launch from OOMing the daemon
+# at exactly the moment its device backend died.
+_HOST_BATCH_SLICE_BYTES = 8 << 20
+
+
+def xor_matmul_host_batch(bit_matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Batched host oracle: (..., k, L) uint8 -> (..., m, L) uint8.
+
+    Pure numpy end to end — this is the DEGRADED-mode fallback the
+    device guard re-runs launches on, so it must never touch the jax
+    runtime (a wedged TPU backend can hang any jnp call).  Bit-for-bit
+    identical to xor_matmul_host applied per stripe: same LSB-first
+    plane layout, same GF(2) matmul-and-mask reduction.
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    lead = data.shape[:-2]
+    k, L = data.shape[-2:]
+    flat = data.reshape(-1, k, L)
+    m = bit_matrix.shape[0] // 8
+    stripes = flat.shape[0]
+    per_stripe = max(1, k * L)
+    step = max(1, _HOST_BATCH_SLICE_BYTES // per_stripe)
+    bm32 = bit_matrix.astype(np.int32)
+    weights = (1 << np.arange(8, dtype=np.uint16))[None, None, :, None]
+    out = np.empty((stripes, m, L), dtype=np.uint8)
+    for s0 in range(0, stripes, step):
+        part = flat[s0 : s0 + step]
+        # (s, k, 8, L) -> (s, 8k, L): chunk-major, bit-minor like
+        # bitslice_bytes
+        planes = (
+            (part[:, :, None, :]
+             >> np.arange(8, dtype=np.uint8)[None, None, :, None])
+            & 1
+        ).reshape(part.shape[0], 8 * k, L)
+        out_planes = (bm32 @ planes.astype(np.int32)) & 1
+        p = out_planes.reshape(part.shape[0], m, 8, L).astype(np.uint16)
+        out[s0 : s0 + step] = (p * weights).sum(axis=2).astype(np.uint8)
+    return out.reshape(*lead, m, L)

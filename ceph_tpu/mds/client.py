@@ -145,6 +145,13 @@ class CephFSClient(Dispatcher):
         self.fs_name = fs_name  # "" = the first filesystem in the fsmap
         self.data = data_ioctx
         self.monmap = monmap
+        # per-instance identity for MDS request dedup: (client_id, tid)
+        # is stable across retries, so a resent non-idempotent op replays
+        # the MDS's recorded result instead of re-executing (the
+        # reference's session-scoped completed_requests)
+        import secrets
+
+        self.client_id = f"{name}.{secrets.token_hex(4)}"
         self.monc = None
         self._mdsmap_epoch = 0
         self._mds_changed = asyncio.Event()
@@ -230,15 +237,23 @@ class CephFSClient(Dispatcher):
         """One metadata op with failover retry in mon mode: a dead or
         not-yet-active MDS (-EAGAIN / connection loss / reply timeout)
         re-resolves rank 0 from the mdsmap and resends (Client request
-        resend on mds_map, Client.cc)."""
+        resend on mds_map, Client.cc).
+
+        The reqid (client_id, tid) is allocated ONCE and reused on every
+        retry — a fresh tid per attempt would defeat the MDS's completed-
+        request dedup and re-execute non-idempotent ops (mkdir/create/
+        unlink/rename), surfacing spurious EEXIST/ENOENT after failover."""
         deadline = asyncio.get_event_loop().time() + timeout
         attempt = 0
+        self._tid += 1
+        tid = self._tid
         while True:
-            self._tid += 1
-            tid = self._tid
             fut: asyncio.Future = asyncio.get_event_loop().create_future()
             self._replies[tid] = fut
-            msg = MClientRequest(tid=tid, op=op, args=json.dumps(args).encode())
+            msg = MClientRequest(
+                tid=tid, op=op, args=json.dumps(args).encode(),
+                client=self.client_id,
+            )
             reply: MClientReply | None = None
             try:
                 await self.msgr.send_to(self.mds_addr, msg)

@@ -60,6 +60,15 @@ ROOT_INO = 1  # MDS_INO_ROOT
 INOTABLE_OID = "mds_inotable"
 JOURNAL_OID = "mds_journal"
 JOURNAL_HEAD_OID = "mds_journal_head"
+COMPLETED_OID = "mds_completed"  # journaled (client, tid) reply records
+COMPLETED_CAP = 1024  # retained completed-request records (oldest drop)
+# ops whose re-execution is NOT idempotent: their results are journaled
+# per (client, tid) so a retry after failover replays the recorded reply
+# (Server::handle_client_request's completed_requests check) instead of
+# re-running and surfacing spurious EEXIST/ENOENT
+MUTATING_OPS = frozenset(
+    ("mkdir", "create", "symlink", "unlink", "rmdir", "rename", "setattr")
+)
 FLUSH_INTERVAL = 0.5
 JOURNAL_FLUSH_BYTES = 1 << 20
 REVOKE_TIMEOUT = 3.0  # mds_session_timeout scaled down
@@ -103,6 +112,14 @@ class MDS(Dispatcher):
         self._ino_dirty = False
         self._journal_seq = 0
         self._journal_bytes = 0
+        # completed non-idempotent requests: (client, tid) -> recorded
+        # reply.  Journaled (write-ahead) and persisted at flush, so a
+        # promoted standby serves a retried mkdir/create/unlink/rename
+        # its ORIGINAL result instead of re-executing it.
+        from collections import OrderedDict
+
+        self._completed: "OrderedDict[tuple[str, int], dict]" = OrderedDict()
+        self._completed_dirty = False
         self._flush_task: asyncio.Task | None = None
         self._running = False
         # caps: ino -> {conn: "r"|"w"} ; waiters for revoke acks
@@ -217,6 +234,8 @@ class MDS(Dispatcher):
         self._dirs.clear()
         self._dirty.clear()
         self._ino_dirty = False
+        self._completed.clear()  # reloaded from pool+journal on promotion
+        self._completed_dirty = False
         self.caps.clear()
         self._revoke_waiters.clear()
         self._ino_loc.clear()
@@ -301,6 +320,12 @@ class MDS(Dispatcher):
                 INOTABLE_OID, json.dumps({"next": 2}).encode()
             )
             await self.meta.write_full(f"dir.{ROOT_INO}", b"{}")
+        try:
+            raw = await self.meta.read(COMPLETED_OID)
+            for client, tid, rec in json.loads(raw.decode() or "[]"):
+                self._completed[(client, int(tid))] = rec
+        except Exception:
+            pass  # fresh fs / pre-upgrade pool: no completed table yet
 
     # -- journal (MDLog) -------------------------------------------------------
 
@@ -365,6 +390,16 @@ class MDS(Dispatcher):
         elif op == "inotable":
             self._next_ino = ev["next"]
             self._ino_dirty = True
+        elif op == "completed_req":
+            key = (ev["client"], int(ev["tid"]))
+            self._completed[key] = {
+                "result": ev.get("result", 0),
+                "payload": ev["payload"],
+            }
+            self._completed.move_to_end(key)
+            while len(self._completed) > COMPLETED_CAP:
+                self._completed.popitem(last=False)
+            self._completed_dirty = True
 
     async def _journal(self, *events: dict) -> None:
         """Append events durably BEFORE applying/replying (MDLog::submit +
@@ -399,7 +434,11 @@ class MDS(Dispatcher):
         would otherwise be cleared unwritten and trimmed — losing acked
         metadata, the exact thing the journal exists to prevent."""
         async with self._lock:
-            if not self._dirty and not self._ino_dirty:
+            if (
+                not self._dirty
+                and not self._ino_dirty
+                and not self._completed_dirty
+            ):
                 return
             for ino in sorted(self._dirty):
                 await self.meta.write_full(
@@ -411,6 +450,17 @@ class MDS(Dispatcher):
                     INOTABLE_OID, json.dumps({"next": self._next_ino}).encode()
                 )
                 self._ino_dirty = False
+            if self._completed_dirty:
+                # the completed-request table must survive the journal
+                # trim below: a trimmed completed_req event can no longer
+                # be replayed, so the table itself is the durable record
+                await self.meta.write_full(
+                    COMPLETED_OID,
+                    json.dumps(
+                        [[c, t, rec] for (c, t), rec in self._completed.items()]
+                    ).encode(),
+                )
+                self._completed_dirty = False
             await self.meta.write_full(
                 JOURNAL_HEAD_OID,
                 json.dumps({"flushed": self._journal_seq}).encode(),
@@ -539,8 +589,36 @@ class MDS(Dispatcher):
     async def _handle(self, conn: Connection, msg: MClientRequest) -> None:
         try:
             args = json.loads(msg.args.decode() or "{}")
+            key = None
+            client = getattr(msg, "client", "") or ""
+            if client:
+                key = (client, int(msg.tid))
             async with self._lock:
-                payload = await self._dispatch_op(conn, msg.op, args)
+                done = self._completed.get(key) if key is not None else None
+                if done is not None:
+                    # a retry of an already-applied request (stable reqid
+                    # across resends): replay the recorded reply instead
+                    # of re-executing — re-running mkdir/create/unlink/
+                    # rename would return spurious EEXIST/ENOENT after a
+                    # failover even though the ORIGINAL attempt succeeded
+                    payload = done["payload"]
+                    await self._reissue_caps(conn, payload)
+                else:
+                    payload = await self._dispatch_op(conn, msg.op, args)
+                    if key is not None and msg.op in MUTATING_OPS:
+                        # journal the completion write-ahead of the reply:
+                        # a crash between apply and this record at worst
+                        # re-executes (today's behavior); a crash after it
+                        # replays the right answer
+                        await self._journal(
+                            {
+                                "op": "completed_req",
+                                "client": client,
+                                "tid": int(msg.tid),
+                                "result": 0,
+                                "payload": payload,
+                            }
+                        )
             reply = MClientReply(
                 tid=msg.tid, result=0, payload=json.dumps(payload).encode()
             )
@@ -553,6 +631,16 @@ class MDS(Dispatcher):
             await conn.send_message(reply)
         except ConnectionError:
             pass
+
+    async def _reissue_caps(self, conn: Connection, payload: dict) -> None:
+        """A replayed create/open result promised capabilities: grant
+        them to the retrying session (the original grant died with the
+        failed-over daemon), or the client's next data op would bounce
+        off the cap check it believes it passed."""
+        entry = payload.get("entry") if isinstance(payload, dict) else None
+        caps = payload.get("caps") if isinstance(payload, dict) else None
+        if entry and caps:
+            await self._acquire_caps(conn, entry["ino"], caps)
 
     async def _dispatch_op(self, conn, op: str, args: dict) -> dict:
         if op == "mkdir":

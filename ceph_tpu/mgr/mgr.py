@@ -155,7 +155,44 @@ class Mgr(Dispatcher):
             # per-daemon slow-request counts (OpTracker complaint ages);
             # the mon-side SLOW_OPS health check reads this slice
             "slow_ops": self.slow_ops_by_daemon(),
+            # daemons whose device backend is DEGRADED (EC dispatch on
+            # the host fallback); the mon-side TPU_BACKEND_DEGRADED
+            # check reads this slice
+            "tpu_degraded": self.tpu_degraded_by_daemon(),
         }
+
+    def tpu_degraded_by_daemon(self) -> dict[str, dict]:
+        """Daemons reporting a DEGRADED device backend (the OSD status'
+        tpu_backend blob, ops/guard.py verdict).  A down daemon's stale
+        report is dropped like the slow-ops slice: its process — and
+        with it the degraded runtime — is gone."""
+        out: dict[str, dict] = {}
+        for daemon, st in self.daemons.items():
+            backend = (st.status or {}).get("tpu_backend") or {}
+            if not backend.get("degraded"):
+                continue
+            if not self._daemon_report_live(daemon):
+                continue
+            out[daemon] = {
+                "degraded_for_sec": float(backend.get("degraded_for_sec", 0.0)),
+                "reason": str(backend.get("reason", "")),
+                "fallback_launches": int(backend.get("fallback_launches", 0)),
+            }
+        return out
+
+    def _daemon_report_live(self, daemon: str) -> bool:
+        """False when a daemon's last report is provably stale — a down
+        OSD's process (and with it its in-flight ops, degraded runtime,
+        ...) is gone, so its final status must not survive into the
+        digest slices health checks read."""
+        if daemon.startswith("osd."):
+            try:
+                info = self.osdmap.osds.get(int(daemon[4:]))
+            except ValueError:
+                info = None
+            if info is not None and not info.up:
+                return False
+        return True
 
     def slow_ops_by_daemon(self) -> dict[str, dict]:
         """Daemons currently reporting slow requests (count + oldest age),
@@ -165,16 +202,8 @@ class Mgr(Dispatcher):
             slow = (st.status or {}).get("slow_ops") or {}
             if not slow.get("count"):
                 continue
-            # a crashed daemon's LAST report would otherwise raise
-            # SLOW_OPS forever: a down osd has no in-flight ops, so its
-            # stale count must not survive into the digest
-            if daemon.startswith("osd."):
-                try:
-                    info = self.osdmap.osds.get(int(daemon[4:]))
-                except ValueError:
-                    info = None
-                if info is not None and not info.up:
-                    continue
+            if not self._daemon_report_live(daemon):
+                continue
             out[daemon] = {
                 "count": int(slow["count"]),
                 "oldest_sec": float(slow.get("oldest_sec", 0.0)),
@@ -201,6 +230,12 @@ class Mgr(Dispatcher):
             checks["OSD_DOWN"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{len(down)} osds down",
+            }
+        degraded = health.tpu_degraded_summary(self.tpu_degraded_by_daemon())
+        if degraded:
+            checks["TPU_BACKEND_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": degraded,
             }
         for module in self.modules:
             checks.update(getattr(module, "health_checks", {}) or {})

@@ -464,6 +464,16 @@ class Monitor(Dispatcher):
         if summary:
             checks["SLOW_OPS"] = summary
             details["SLOW_OPS"] = health.slow_ops_detail(slow)
+        # daemons whose EC dispatch fell back to the host oracle (device
+        # backend wedged/erroring; ops/guard.py verdict via the mgr
+        # digest).  Clears when the daemon's re-probe heals the backend.
+        degraded = self.pg_digest.get("tpu_degraded") or {}
+        summary = health.tpu_degraded_summary(degraded)
+        if summary:
+            checks["TPU_BACKEND_DEGRADED"] = summary
+            details["TPU_BACKEND_DEGRADED"] = health.tpu_degraded_detail(
+                degraded
+            )
         return checks, details
 
     def _mon_command_handler(self, prefix: str):

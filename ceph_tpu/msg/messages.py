@@ -608,9 +608,34 @@ class MClientRequest(Message):
     """Client -> MDS metadata op (src/messages/MClientRequest.h).  `op` is
     the request name (mkdir, create, lookup, readdir, unlink, rmdir,
     rename, setattr, open, release); `args` is a JSON blob — the dynamic
-    shape of the reference's filepath+args union."""
+    shape of the reference's filepath+args union.  `client` (v2) is the
+    sender's per-instance identity: with a STABLE tid across retries it
+    forms the (client, tid) reqid the MDS's completed-request table
+    dedups on, so a retried non-idempotent op (mkdir/create/unlink/
+    rename) replays its recorded reply instead of re-executing ('' = a
+    v1 sender; no dedup)."""
 
-    FIELDS = [("tid", "u64"), ("op", "str"), ("args", "bytes")]
+    VERSION = 2
+    COMPAT = 1
+    FIELDS = [
+        ("tid", "u64"), ("op", "str"), ("args", "bytes"), ("client", "str")
+    ]
+
+    @classmethod
+    def decode(cls, dec):
+        # struct_v-gated tail (encoding.h WRITE_CLASS_ENCODER shape): a
+        # v1 frame simply lacks `client` and decodes as a no-dedup
+        # sender, instead of overrunning the versioned frame
+        struct_v = dec.start(cls.VERSION)
+        msg = cls.__new__(cls)
+        msg.src = ""
+        msg.seq = 0
+        msg.tid = dec.u64()
+        msg.op = dec.string()
+        msg.args = dec.bytes_()
+        msg.client = dec.string() if struct_v >= 2 else ""
+        dec.finish()
+        return msg
 
 
 @message_type(37)

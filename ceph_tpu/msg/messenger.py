@@ -24,6 +24,7 @@ import random
 from dataclasses import dataclass
 
 from ..common import tracer as tracer_mod
+from ..common.fault_injector import InjectedFailure, faultpoint
 from ..common.log import dout
 from ..common.throttle import AsyncThrottle
 from .crypto import (
@@ -86,6 +87,15 @@ class Dispatcher:
         pass
 
 
+# Lossless resend bounds: ~4 s of backoff across 12 attempts covers any
+# transient fault, and the wall-clock window caps the worst case — a
+# zombie peer whose TCP accepts succeed but whose handshakes burn their
+# full timeouts per attempt — so a permanently dead peer surfaces
+# ConnectionError instead of pinning the connection's send lock.
+_RESEND_TRIES = 12
+_RESEND_WINDOW = 15.0  # seconds
+
+
 class Connection:
     """One peer session (AsyncConnection).  Owns the socket streams, a
     send queue, and (for lossless policies) the unacked resend queue."""
@@ -107,6 +117,10 @@ class Connection:
         self._out_seq = 0
         self._closed = False
         self._read_task: asyncio.Task | None = None
+        # set when a lossless resend window gave the peer up: sends
+        # before this instant fail fast instead of each serially burning
+        # a fresh full retry window under the send lock
+        self._dead_until = 0.0
 
     @property
     def connected(self) -> bool:
@@ -119,7 +133,10 @@ class Connection:
     ) -> None:
         self._reader = reader
         self._writer = writer
-        self._read_task = asyncio.create_task(self._read_loop())
+        # the reader is BOUND at attach time: a fault can null
+        # self._reader before the task's first step runs, and the task
+        # must then exit, not read from None
+        self._read_task = asyncio.create_task(self._read_loop(reader))
 
     async def _connect(self) -> None:
         reader, writer = await self.msgr.stack.connect(self.peer_addr)
@@ -213,43 +230,108 @@ class Connection:
 
     async def send_message(self, msg: Message) -> None:
         """Queue-and-send (AsyncConnection::send_message).  Raises on
-        lossy connections that are closed; lossless ones reconnect."""
+        lossy connections that are closed; lossless ones transparently
+        reconnect and RESEND the faulted message (Policy.resend_on_
+        reconnect — the reference requeues unacked messages on the new
+        session), bounded by _RESEND_TRIES so a PERMANENTLY dead peer
+        surfaces ConnectionError to the caller's own recovery (objecter
+        resend, OSD peering) instead of wedging the send lock forever.
+
+        Duplication: the injection checks (`msgr.send` faultpoint + the
+        legacy ms_inject_socket_failures knob) run BEFORE any bytes hit
+        the wire, so INJECTED faults can never duplicate a delivered
+        frame.  A real socket error after a full write but before drain
+        returns can resend a frame the peer already processed —
+        at-least-once, like any ack-less retransmit (the reference
+        closes the gap with session seq replay, which needs the ack
+        machinery this model doesn't carry)."""
         async with self._send_lock:
             if self._closed:
                 raise ConnectionError(f"connection to {self.peer_addr} closed")
-            if self._writer is None:
-                # Lazy connect (first send), and lazy REconnect for
-                # lossless policies; faulted lossy connections were marked
-                # closed in _fault() and never reach here.
-                if self.policy.server:
-                    raise ConnectionError(f"not connected to {self.peer_addr}")
-                await self._connect()
+            if asyncio.get_event_loop().time() < self._dead_until:
+                raise ConnectionError(
+                    f"peer {self.peer_addr} recently unreachable"
+                )
             self._out_seq += 1
             msg.src = self.msgr.name
             msg.seq = self._out_seq
             env, payload = encode_message(msg)
             frame = Frame(TAG_MESSAGE, [env, payload])
-            try:
-                self.msgr._maybe_inject_fault()
-                raw = frame.pack(self.msgr.crc_data)
-                if self._onwire is not None:
-                    raw = self._onwire.wrap(raw)
-                self._writer.write(raw)
-                await self._writer.drain()
-            except (ConnectionError, OSError):
-                self._fault()
-                raise ConnectionError(f"send to {self.peer_addr} failed")
+            attempt = 0
+            give_up_at = asyncio.get_event_loop().time() + _RESEND_WINDOW
+            while True:
+                if self._closed:  # closed underneath a resend backoff
+                    raise ConnectionError(
+                        f"connection to {self.peer_addr} closed"
+                    )
+                if self._writer is None and self.policy.server:
+                    # accept-side connections cannot re-dial the peer:
+                    # not retryable, surface immediately
+                    raise ConnectionError(f"not connected to {self.peer_addr}")
+                try:
+                    if self._writer is None:
+                        # Lazy connect (first send), and lazy REconnect for
+                        # lossless policies; faulted lossy connections were
+                        # marked closed in _fault() and never reach here.
+                        await self._connect()
+                    faultpoint("msgr.send")
+                    self.msgr._maybe_inject_fault()
+                    raw = frame.pack(self.msgr.crc_data)
+                    if self._onwire is not None:
+                        raw = self._onwire.wrap(raw)
+                    self._writer.write(raw)
+                    await self._writer.drain()
+                    return
+                except (ConnectionError, OSError, InjectedFailure):
+                    self._fault()
+                    if self.policy.lossy or not self.policy.resend_on_reconnect:
+                        raise ConnectionError(
+                            f"send to {self.peer_addr} failed"
+                        )
+                    if self._closed:
+                        raise ConnectionError(
+                            f"connection to {self.peer_addr} closed"
+                        )
+                    attempt += 1
+                    # bounded by attempts AND wall clock: a zombie peer
+                    # whose accepts succeed but handshakes stall would
+                    # otherwise stretch 12 attempts into minutes of
+                    # handshake timeouts while holding the send lock
+                    if (
+                        attempt > _RESEND_TRIES
+                        or asyncio.get_event_loop().time() > give_up_at
+                    ):
+                        # peer looks permanently gone: give the message
+                        # back to the caller's recovery loop, and fail
+                        # queued senders fast for another window instead
+                        # of each serially re-burning a full one
+                        self._dead_until = (
+                            asyncio.get_event_loop().time() + _RESEND_WINDOW
+                        )
+                        raise ConnectionError(
+                            f"send to {self.peer_addr} failed after "
+                            f"{attempt} resend attempts"
+                        )
+                    # lossless: back off briefly and resend the SAME frame
+                    # (same seq) over a fresh session
+                    self.msgr.resends += 1
+                    await asyncio.sleep(min(0.5, 0.01 * (1 << min(attempt, 6))))
 
     # -- receive -------------------------------------------------------------
 
-    async def _read_loop(self) -> None:
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        # bound to the reader it was attached with: a lossless reconnect
+        # attaches a NEW loop, and this (stale) one must neither read the
+        # fresh stream nor fault the fresh session when its dead socket
+        # finally errors out
         try:
-            while not self._closed:
+            while not self._closed and self._reader is reader:
                 if self._onwire is not None:
-                    body = await read_record(self._reader)
+                    body = await read_record(reader)
                     frame = frame_from_bytes(self._onwire.unwrap(body))
                 else:
-                    frame = await read_frame(self._reader)
+                    frame = await read_frame(reader)
+                faultpoint("msgr.recv")
                 self.msgr._maybe_inject_fault()
                 if frame.tag == TAG_KEEPALIVE:
                     continue
@@ -263,9 +345,10 @@ class Connection:
             OSError,
             FrameError,
             OnWireError,
+            InjectedFailure,
             asyncio.CancelledError,
         ):
-            if not self._closed:
+            if not self._closed and self._reader is reader:
                 self._fault()
 
 
@@ -315,6 +398,7 @@ class Messenger:
         self.secure = secure
         self.compress = compress
         self.inject_socket_failures = inject_socket_failures
+        self.resends = 0  # lossless transparent resends (fault recovery)
         self._rng = random.Random(hash(name) & 0xFFFF)
         self.dispatchers: list[Dispatcher] = []
         self._conns: dict[str, Connection] = {}  # peer_addr -> conn

@@ -107,6 +107,20 @@ class DeviceOccupancy:
 
 DEVICES_PER_LAUNCH = DeviceOccupancy()
 
+# Launches that completed on the HOST ORACLE instead of the device
+# (ops/guard.py DeviceGuard fallback: launch deadline exceeded, device
+# error, or degraded-mode bypass).  NOT counted in LAUNCHES — these never
+# reached the device — so LAUNCHES keeps meaning "device dispatches" for
+# every existing batching invariant, and FALLBACK_LAUNCHES isolates the
+# degraded-mode traffic the TPU_BACKEND_DEGRADED health check describes.
+FALLBACK_LAUNCHES = LaunchCounter()
+
+
+def record_fallback(stripes: int, nbytes: int) -> None:
+    """Record one host-oracle fallback carrying `stripes` stripes /
+    `nbytes` input bytes (the aggregator's degraded-path accounting)."""
+    FALLBACK_LAUNCHES.record(stripes, nbytes)
+
 
 def record_launch(
     stripes: int, nbytes: int, decode: bool = False, devices: int = 1
@@ -137,10 +151,20 @@ def perf_dump() -> dict[str, object]:
         ("", LAUNCHES),
         ("decode_", DECODE_LAUNCHES),
         ("sharded_", SHARDED_LAUNCHES),
+        ("fallback_", FALLBACK_LAUNCHES),
     ):
         for name, val in counter.snapshot().items():
             out[f"{prefix}{name}"] = val
     out["device_launches"] = DEVICES_PER_LAUNCH.device_launches
     for devices, launches in sorted(DEVICES_PER_LAUNCH.snapshot().items()):
         out[f"devices_per_launch.{devices}"] = launches
+    # degraded-backend state (ops/guard.py): `backend_degraded` is the
+    # gauge the prometheus scrape exports next to the fallback counters
+    from .guard import device_guard
+
+    snap = device_guard().snapshot()
+    out["backend_degraded"] = snap["degraded"]
+    out["backend_degraded_total"] = snap["degraded_total"]
+    out["backend_probes"] = snap["probes"]
+    out["backend_probe_failures"] = snap["probe_failures"]
     return out

@@ -348,6 +348,10 @@ class BlueStore(ObjectStore):
         """Stage every op, then commit in BlueStore's order: direct data →
         fsync → one atomic KV batch (the commit point) → deferred WAL
         application → WAL cleanup (BlueStore::_txc_state_proc)."""
+        if txn.ops:
+            # same pre-apply seam as the base class: an injected write
+            # fault fails the transaction whole, before staging
+            self._faultpoint("os.write", txn.ops[0].coll, txn.ops[0].oid)
         self._batch, self._dirty = [], set()
         self._direct, self._deferred = [], []
         self._staged, self._to_release = {}, []
@@ -577,6 +581,7 @@ class BlueStore(ObjectStore):
     # -- reads -----------------------------------------------------------------
 
     def read(self, coll: str, oid: str, off: int = 0, length: int = 0) -> bytes:
+        self._faultpoint("os.read", coll, oid)
         o = self._peek_onode(coll, oid)
         end = o.size if length == 0 else min(off + length, o.size)
         if off >= end:

@@ -66,6 +66,9 @@ class FileStore(ObjectStore):
     # -- transaction durability ----------------------------------------------
 
     def queue_transaction(self, txn: Transaction, on_commit=None) -> None:
+        if txn.ops:
+            # pre-journal write-fault seam, matching the other backends
+            self._faultpoint("os.write", txn.ops[0].coll, txn.ops[0].oid)
         txn = self._resolve_appends(txn)
         self._journal_seq += 1
         key = f"{self._journal_seq:016d}"

@@ -92,6 +92,7 @@ class MemStore(ObjectStore):
     # -- reads ---------------------------------------------------------------
 
     def read(self, coll: str, oid: str, off: int = 0, length: int = 0) -> bytes:
+        self._faultpoint("os.read", coll, oid)
         o = self._obj(coll, oid)
         if length == 0:
             return bytes(o.data[off:])

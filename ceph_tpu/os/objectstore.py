@@ -30,6 +30,20 @@ class ObjectStore:
     """Abstract store.  Backends implement the _-prefixed primitives;
     the public surface mirrors ObjectStore.h."""
 
+    @staticmethod
+    def _faultpoint(point: str, coll: str, oid: str) -> None:
+        """Media-error injection seam (os.read / os.write): an armed
+        fault surfaces as StoreError, exactly the errno a dying disk
+        would hand the objectstore (test-erasure-eio.sh semantics)."""
+        from ..common.fault_injector import InjectedFailure, faultpoint
+
+        try:
+            faultpoint(point)
+        except InjectedFailure as e:
+            raise StoreError(
+                abs(e.errno), f"injected {point} fault on {coll}/{oid}"
+            ) from e
+
     def mount(self) -> None:
         pass
 
@@ -49,6 +63,11 @@ class ObjectStore:
         caller bug; ops already applied are NOT rolled back and
         on_commit does not fire.  Durable backends additionally drop the
         journal entry so the aborted txn never replays."""
+        if txn.ops:
+            # write-fault seam, checked BEFORE any op lands: an injected
+            # media error fails the whole transaction atomically (per-op
+            # injection would tear it, since apply does not roll back)
+            self._faultpoint("os.write", txn.ops[0].coll, txn.ops[0].oid)
         for op in txn.ops:
             self._apply_op(op)
         self._persist(txn)

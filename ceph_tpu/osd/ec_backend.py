@@ -845,6 +845,15 @@ class ECBackend(PGBackend):
             runs = [tuple(r) for r in msg.subchunks.get(oid, [[0, sub_count]])]
             out: list[list[bytes]] = []
             try:
+                # shard-side EIO injection (ec.sub_read): answers this
+                # object with an error, driving the primary's redundant-
+                # read escalation + reconstruct path
+                from ..common.fault_injector import faultpoint
+
+                try:
+                    faultpoint("ec.sub_read")
+                except Exception as e:
+                    raise EcError(EIO, f"injected sub-read fault: {e}")
                 shard_size = self.store.stat(coll, oid)
                 for off, ln in extents:
                     ln = min(ln, max(shard_size - off, 0))

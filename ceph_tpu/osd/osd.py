@@ -228,6 +228,32 @@ class OSD(Dispatcher):
             ["ec_tpu_decode_aggregate_max_bytes"],
             lambda _n, v: self.decode_aggregator.configure(max_bytes=int(v)),
         )
+        # backpressure bound: both aggregators share the knob (ISSUE 7),
+        # runtime-mutable like the window/byte-budget settings
+        def _apply_inflight(v: int) -> None:
+            self.encode_aggregator.configure(inflight_max_bytes=int(v))
+            self.decode_aggregator.configure(inflight_max_bytes=int(v))
+
+        _apply_inflight(self.conf.get("ec_tpu_inflight_max_bytes"))
+        self.conf.add_observer(
+            ["ec_tpu_inflight_max_bytes"], lambda _n, v: _apply_inflight(v)
+        )
+        # device-launch watchdog (ops/guard.py): per-launch deadline +
+        # degraded-mode re-probe cadence, runtime-mutable
+        from ..ops.guard import device_guard
+
+        device_guard().configure(
+            timeout_ms=self.conf.get("ec_tpu_launch_timeout_ms"),
+            probe_interval_ms=self.conf.get("ec_tpu_probe_interval_ms"),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_launch_timeout_ms"],
+            lambda _n, v: device_guard().configure(timeout_ms=int(v)),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_probe_interval_ms"],
+            lambda _n, v: device_guard().configure(probe_interval_ms=int(v)),
+        )
         # sharded-dispatch policy (ISSUE 6): the process-wide mesh fan-out
         # knobs ride the same config/observer plumbing as the aggregators
         from ..parallel import dispatch as shard_dispatch
@@ -382,6 +408,47 @@ class OSD(Dispatcher):
             },
             "give up on unfound objects: delete + release waiters "
             "(args: pool, ps[, mode=delete])",
+        )
+        def _injectargs(cmd: dict) -> dict:
+            """injectargs-style runtime fault arming: the harness and the
+            tests drive the SAME process-global FaultInjector hooks the
+            data path checks (common/fault_injector.py catalog).
+
+            Forms: {point, error?, hits?} arms a counted errno fault;
+            {point, one_in} arms a probabilistic fault
+            (ms_inject_socket_failures semantics); {clear: true, point?}
+            disarms one point or everything; {conf: {name: value}}
+            additionally applies runtime config sets (the classic
+            `injectargs '--opt val'` use)."""
+            from ..common.fault_injector import FAULT_POINTS, global_injector
+
+            inj = global_injector()
+            if cmd.get("clear"):
+                inj.clear(cmd.get("point"))
+            elif "point" in cmd:
+                point = cmd["point"]
+                if point not in FAULT_POINTS:
+                    raise ValueError(f"unregistered fault point {point!r}")
+                if "one_in" in cmd:
+                    inj.inject_probabilistic(point, int(cmd["one_in"]))
+                else:
+                    inj.inject(
+                        point, int(cmd.get("error", 5)),
+                        hits=int(cmd.get("hits", -1)),
+                    )
+            for name, value in (cmd.get("conf") or {}).items():
+                self.conf.set(name, value)
+            return {
+                "armed": sorted(
+                    p for p in FAULT_POINTS if inj.armed(p)
+                ),
+            }
+
+        sock.register(
+            "injectargs",
+            _injectargs,
+            "arm/clear fault-injection points + runtime config sets "
+            "(args: point, error, hits, one_in, clear, conf)",
         )
         sock.register(
             "dump_historic_ops",
@@ -1021,4 +1088,21 @@ def _osd_status(osd: "OSD") -> dict:
         # in-flight ops older than osd_op_complaint_time (OpTracker) —
         # aggregated by the mgr into the digest that raises SLOW_OPS
         "slow_ops": {"count": slow_count, "oldest_sec": slow_oldest},
+        # device-backend verdict (ops/guard.py): the mgr aggregates this
+        # into the digest slice the TPU_BACKEND_DEGRADED health check
+        # (mon HEALTH_WARN + mgr prometheus healthcheck gauge) reads
+        "tpu_backend": _tpu_backend_status(),
+    }
+
+
+def _tpu_backend_status() -> dict:
+    from ..ops import dispatch as ec_dispatch
+    from ..ops.guard import device_guard
+
+    snap = device_guard().snapshot()
+    return {
+        "degraded": bool(snap["degraded"]),
+        "degraded_for_sec": snap["degraded_for_sec"],
+        "reason": snap["reason"],
+        "fallback_launches": ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"],
     }

@@ -1,0 +1,332 @@
+"""Chaos / recovery-under-load harness — ROADMAP item 4's first rung.
+
+Boots a real multi-OSD vstart-style cluster (loopback messengers, live
+mon/mgr/OSD daemons) and drives mixed client load while injecting the
+failure modes the wired FaultInjector seams expose (ISSUE 7):
+
+- probabilistic socket failures (`msgr.send`, the ms_inject_socket_
+  failures analog) under lossless-policy resend,
+- objectstore EIO bursts (`os.read`) driving EC redundant-read
+  escalation and reconstruction,
+- device coding-launch failures (`codec.launch`) driving the
+  DEGRADED-backend host fallback + re-probe self-heal,
+- an OSD flap (stop, degraded writes, restart on the old store) driving
+  peering + recovery pushes.
+
+The run is SEEDED and deterministic in its decision sequence (payloads,
+object names, injection arming order all come from one rng; socket-fault
+draws use the injector's own fixed-seed rng), asserts convergence — all
+PGs active+clean, every acked write readable byte-identical, health
+clear of stuck SLOW_OPS and TPU_BACKEND_DEGRADED — and reports
+machine-readable metrics pulled from the PR-1 histogram substrate: p99
+client op latency, recovery launch occupancy, host-fallback counts,
+messenger resends.
+
+`--smoke` is the fast, seed-fixed variant tier-1 runs
+(tests/test_chaos_smoke.py); the full mode scales objects/rounds up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+
+
+from ceph_tpu.tools.vstart import _free_port_addrs
+
+
+def _osd_conf(i: int):
+    from ceph_tpu.common.config import Config
+
+    return Config(
+        {
+            "name": f"osd.{i}",
+            "osd_heartbeat_interval": 0.1,
+            "osd_heartbeat_grace": 0.6,
+            # tight deadline so an (injected) wedged launch falls back
+            # within the run instead of riding the 20 s default
+            "ec_tpu_launch_timeout_ms": 5000,
+            "ec_tpu_probe_interval_ms": 200,
+        },
+        env=False,
+    )
+
+
+async def _wait_until(pred, timeout: float, what: str) -> None:
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"chaos: timed out waiting for {what}")
+        await asyncio.sleep(0.02)
+
+
+def _p99_from_histogram(dump: dict) -> float:
+    """99th-percentile upper bound from a PerfHistogram.dump() payload
+    (cumulative [le, count] buckets): the smallest bound covering >= 99%
+    of samples.  inf means the tail spilled into the overflow bucket."""
+    h = (dump or {}).get("histogram") or {}
+    buckets = h.get("buckets") or []
+    total = h.get("count") or 0
+    if not total:
+        return 0.0
+    want = 0.99 * total
+    for le, cum in buckets:
+        if cum >= want:
+            return float("inf") if le == "+Inf" else float(le)
+    return float("inf")
+
+
+async def _run(cfg: dict) -> dict:
+    from ceph_tpu.client import Rados
+    from ceph_tpu.common.fault_injector import global_injector
+    from ceph_tpu.mgr import Mgr
+    from ceph_tpu.mon import MonMap, Monitor
+    from ceph_tpu.ops import dispatch as ec_dispatch
+    from ceph_tpu.ops.guard import device_guard
+    from ceph_tpu.osd.osd import OSD
+
+    rng = random.Random(cfg["seed"])
+    inj = global_injector()
+    report: dict = {
+        "seed": cfg["seed"],
+        "smoke": cfg["smoke"],
+        "osds": cfg["osds"],
+        "objects": cfg["objects"],
+        "converged": False,
+        "lost_writes": -1,
+        "events": [],
+    }
+    fallback0 = ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"]
+
+    monmap = MonMap(addrs=_free_port_addrs(1))
+    mons = [Monitor(n, monmap, election_timeout=0.3) for n in monmap.addrs]
+    for m in mons:
+        await m.start()
+    for m in mons:
+        await m.wait_for_quorum()
+    osds = [OSD(i, monmap, conf=_osd_conf(i)) for i in range(cfg["osds"])]
+    for o in osds:
+        await o.start()
+    for o in osds:
+        await o.wait_for_up()
+    mgr = Mgr("x", monmap)
+    mgr.beacon_interval = 0.1
+    await mgr.start()
+    await mgr.wait_for_active()
+
+    client = Rados(monmap)
+    await client.connect()
+    rv, rs, _ = await client.mon_command(
+        {
+            "prefix": "osd erasure-code-profile set",
+            "name": "chaos21",
+            "profile": ["k=2", "m=1", "plugin=tpu"],
+        }
+    )
+    assert rv == 0, rs
+    await client.pool_create(
+        "chaospool", "erasure", profile="chaos21", pg_num=cfg["pg_num"]
+    )
+    io = await client.open_ioctx("chaospool")
+
+    expected: dict[str, bytes] = {}
+
+    async def put(oid: str, nbytes: int) -> None:
+        data = bytes(rng.getrandbits(8) for _ in range(nbytes))
+        await io.write_full(oid, data)
+        expected[oid] = data  # recorded only once the write was ACKED
+
+    try:
+        # ---- phase 0: baseline load -------------------------------------
+        for i in range(cfg["objects"]):
+            await put(f"base{i}", 8192 + 512 * (i % 5))
+        report["events"].append("baseline written")
+
+        # ---- phase 1: socket faults under load --------------------------
+        inj.inject_probabilistic("msgr.send", cfg["sock_one_in"])
+        for i in range(cfg["objects"] // 2):
+            await put(f"sock{i}", 8192)
+            back = await io.read(f"base{i % cfg['objects']}")
+            assert back == expected[f"base{i % cfg['objects']}"]
+        inj.clear("msgr.send")
+        report["events"].append("socket-fault load survived")
+
+        # ---- phase 2: shard-read EIO burst ------------------------------
+        # counted hits so the run converges deterministically: early reads
+        # eat the errors (redundant-read escalation reconstructs where a
+        # survivor set remains; a read whose EVERY shard answered EIO is
+        # correctly failed to the client and retried), later reads run
+        # clean as the hit budget drains
+        inj.inject("ec.sub_read", 5, hits=cfg["eio_hits"])
+        eio_retries = 0
+        for i in range(cfg["objects"] // 2):
+            oid = f"base{i % cfg['objects']}"
+            for _attempt in range(cfg["eio_hits"] + 2):
+                try:
+                    back = await io.read(oid)
+                    break
+                except Exception:
+                    eio_retries += 1
+            else:
+                raise AssertionError(f"chaos: {oid} unreadable after EIO burst")
+            assert back == expected[oid]
+        inj.clear("ec.sub_read")
+        report["eio_client_retries"] = eio_retries
+        report["events"].append("EIO burst reconstructed")
+
+        # ---- phase 3: device-launch faults -> host fallback -------------
+        inj.inject("codec.launch", 5, hits=cfg["launch_faults"])
+        for i in range(cfg["objects"] // 2):
+            await put(f"launch{i}", 2 * 8192)
+        inj.clear("codec.launch")
+        report["degraded_entered"] = bool(
+            device_guard().degraded
+            or ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"] > fallback0
+        )
+        report["events"].append("launch faults absorbed by host fallback")
+
+        # ---- phase 4: OSD flap + recovery -------------------------------
+        victim_id = rng.randrange(cfg["osds"])
+        victim = osds[victim_id]
+        victim_store = victim.store
+        await victim.stop()
+        await _wait_until(
+            lambda: not mons[0].osdmon.osdmap.is_up(victim_id),
+            10.0,
+            f"mon marking osd.{victim_id} down",
+        )
+        for i in range(cfg["objects"] // 2):
+            await put(f"flap{i}", 8192)  # degraded writes
+            oid = f"base{i % cfg['objects']}"
+            assert await io.read(oid) == expected[oid]  # degraded reads
+        revived = OSD(victim_id, monmap, conf=_osd_conf(victim_id),
+                      store=victim_store)
+        await revived.start()
+        await revived.wait_for_up()
+        osds[victim_id] = revived
+        report["events"].append(f"osd.{victim_id} flapped")
+
+        # ---- convergence ------------------------------------------------
+        def all_clean() -> bool:
+            return all(
+                pg.is_clean
+                for o in osds
+                if o._running
+                for pg in o.pgs.values()
+                if pg.peering.is_primary()
+            )
+
+        await _wait_until(all_clean, cfg["converge_timeout"],
+                          "all PGs active+clean")
+        # the device guard must have healed (probe) by convergence time
+        await _wait_until(
+            lambda: not device_guard().degraded, 10.0,
+            "device backend re-probe self-heal",
+        )
+        # health clear: no stuck SLOW_OPS, no TPU_BACKEND_DEGRADED
+        def health_clear() -> bool:
+            checks, _ = mons[0].health_checks()
+            return (
+                "SLOW_OPS" not in checks
+                and "TPU_BACKEND_DEGRADED" not in checks
+            )
+
+        await _wait_until(health_clear, cfg["converge_timeout"],
+                          "health clear of SLOW_OPS/TPU_BACKEND_DEGRADED")
+
+        # ---- zero lost writes -------------------------------------------
+        lost = 0
+        for oid, data in expected.items():
+            if await io.read(oid) != data:
+                lost += 1
+        report["lost_writes"] = lost
+        report["converged"] = lost == 0
+
+        # ---- metrics (the PR-1 histogram substrate) ---------------------
+        live = [o for o in osds if o._running]
+        p99 = [
+            _p99_from_histogram(o.perf.dump_histograms().get("op_latency"))
+            for o in live
+        ]
+        report["p99_op_latency_sec"] = max(p99) if p99 else 0.0
+        occ = [
+            o.decode_aggregator.perf.get("launches") for o in live
+        ]
+        report["recovery_decode_launches"] = int(sum(occ))
+        report["fallback_launches"] = (
+            ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"] - fallback0
+        )
+        report["msgr_resends"] = sum(
+            o.msgr.resends + o.monc.msgr.resends for o in live
+        ) + client.objecter.msgr.resends
+        report["op_resends"] = int(client.objecter.perf.get("op_resend"))
+        report["health_checks"] = mons[0].health_checks()[0]
+    finally:
+        inj.clear()
+        device_guard().mark_healthy()
+        await client.shutdown()
+        await mgr.stop()
+        for o in osds:
+            if o._running:
+                await o.stop()
+        for m in mons:
+            await m.stop()
+        await asyncio.sleep(0.05)
+    return report
+
+
+def run_chaos(
+    seed: int = 0xC405,
+    smoke: bool = False,
+    osds: int = 4,
+    objects: int = 24,
+    pg_num: int = 4,
+) -> dict:
+    """Run the harness to completion and return the report dict.  Raises
+    (TimeoutError / AssertionError) when the cluster fails to converge —
+    convergence IS the assertion."""
+    if smoke:
+        # fast, seed-fixed tier-1 variant: small but still crossing every
+        # phase (sockets, EIO, launch faults, flap + recovery)
+        osds, objects, pg_num = 3, 8, 2
+    cfg = {
+        "seed": seed,
+        "smoke": smoke,
+        "osds": osds,
+        "objects": objects,
+        "pg_num": pg_num,
+        "sock_one_in": 25,
+        "eio_hits": 3 if smoke else 8,
+        "launch_faults": 2 if smoke else 4,
+        "converge_timeout": 30.0 if smoke else 90.0,
+    }
+    return asyncio.run(_run(cfg))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast seed-fixed variant (tier-1)")
+    ap.add_argument("--seed", type=int, default=0xC405)
+    ap.add_argument("--osds", type=int, default=4)
+    ap.add_argument("--objects", type=int, default=24)
+    ap.add_argument("--pg-num", type=int, default=4)
+    args = ap.parse_args(argv)
+    try:
+        report = run_chaos(
+            seed=args.seed, smoke=args.smoke, osds=args.osds,
+            objects=args.objects, pg_num=args.pg_num,
+        )
+    except (TimeoutError, AssertionError) as e:
+        print(json.dumps({"converged": False, "error": str(e)}))
+        return 1
+    print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    return 0 if report.get("converged") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -270,11 +270,16 @@ class TestAggregatorRobustness:
         pend1 = stripe_mod.encode_launch(self.sinfo, self.ec, data1, aggregator=agg)
 
         real = self.ec.encode_array
+        real_host = self.ec.encode_array_host
 
         def boom(data, out=None):
+            # both the device dispatch AND the host-oracle fallback fail:
+            # only then is the error sticky (a device-only failure now
+            # completes on the host, ISSUE 7)
             raise RuntimeError("injected device OOM")
 
         self.ec.encode_array = boom
+        self.ec.encode_array_host = boom
         try:
             # second submission trips the window; its launch fails, but
             # submit must NOT raise into an arbitrary co-rider's write —
@@ -284,6 +289,7 @@ class TestAggregatorRobustness:
             )
         finally:
             self.ec.encode_array = real
+            self.ec.encode_array_host = real_host
         # every co-rider's reap reports the failure instead of crashing
         # on a half-torn group, and polling sees it as "ready" (reapable)
         for pend in (pend1, pend2):
@@ -307,11 +313,14 @@ class TestAggregatorRobustness:
             b.encode_aggregator = agg
         primary = c.primary
         real = primary.ec.encode_array
+        real_host = primary.ec.encode_array_host
 
         def boom(data, out=None):
+            # device AND host-oracle failure: the truly-unrecoverable case
             raise RuntimeError("injected launch failure")
 
         primary.ec.encode_array = boom
+        primary.ec.encode_array_host = boom
         outcomes = []
         try:
             for i in range(2):
@@ -325,6 +334,7 @@ class TestAggregatorRobustness:
             primary.flush_encodes()  # barrier must not throw
         finally:
             primary.ec.encode_array = real
+            primary.ec.encode_array_host = real_host
         assert [(o[0], o[1]) for o in outcomes] == [("fail", 0), ("fail", 1)]
         assert all(o[2] < 0 for o in outcomes)  # negative errno convention
         assert not primary.in_flight
@@ -353,15 +363,22 @@ class TestAggregatorRobustness:
             b.encode_aggregator = agg
         primary = c.primary
         real = primary.ec.encode_array
+        real_host = primary.ec.encode_array_host
 
         def boom_two_stripes(data, out=None):
             if data.shape[0] == 2:  # only W1's 2-stripe group fails
                 raise RuntimeError("injected launch failure")
             return real(data, out=out)
 
+        def boom_two_stripes_host(data):
+            if data.shape[0] == 2:  # the host fallback fails identically
+                raise RuntimeError("injected launch failure")
+            return real_host(data)
+
         sw = pool.stripe_width
         outcomes = []
         primary.ec.encode_array = boom_two_stripes
+        primary.ec.encode_array_host = boom_two_stripes_host
         try:
             w1 = PGTransaction("fx").write(0, bytes(2 * sw))
             primary.submit_transaction(
@@ -382,6 +399,7 @@ class TestAggregatorRobustness:
             c.pump()
         finally:
             primary.ec.encode_array = real
+            primary.ec.encode_array_host = real_host
         assert [(o[0], o[1]) for o in outcomes] == [("fail", 1), ("fail", 2)]
         assert not primary.in_flight and not primary._projected
         # neither write landed: the object does not exist on any shard
@@ -408,13 +426,21 @@ class TestAggregatorRobustness:
         c.write("rx", 0, base)  # pre-existing 2-stripe object
 
         real = primary.ec.encode_array
+        real_host = primary.ec.encode_array_host
         armed = [True]
 
         def boom_once(data, out=None):
             if armed[0]:
-                armed[0] = False
                 raise RuntimeError("injected launch failure")
             return real(data, out=out)
+
+        def boom_once_host(data):
+            # the host fallback fails the same launch, then disarms: the
+            # pair models ONE launch no path can compute
+            if armed[0]:
+                armed[0] = False
+                raise RuntimeError("injected launch failure")
+            return real_host(data)
 
         outcomes = []
         # W1: full-stripe overwrite (no RMW read); stays windowed
@@ -432,11 +458,13 @@ class TestAggregatorRobustness:
             on_failure=lambda err: outcomes.append(("fail", 2, err)),
         )
         primary.ec.encode_array = boom_once
+        primary.ec.encode_array_host = boom_once_host
         try:
             agg.flush()  # W1's group launches and fails, sticky
             primary.flush_encodes()  # W1 reap fails -> dooms W2 too
         finally:
             primary.ec.encode_array = real
+            primary.ec.encode_array_host = real_host
         assert [(o[0], o[1]) for o in outcomes] == [("fail", 1), ("fail", 2)]
         c.pump()  # delivers W2's stale RMW read replies
         assert [(o[0], o[1]) for o in outcomes] == [("fail", 1), ("fail", 2)]
@@ -461,13 +489,19 @@ class TestAggregatorRobustness:
         primary = c.primary
         sw = pool.stripe_width
         real = primary.ec.encode_array
+        real_host = primary.ec.encode_array_host
         armed = [False]
 
         def boom_when_armed(data, out=None):
             if armed[0]:
-                armed[0] = False
                 raise RuntimeError("injected launch failure")
             return real(data, out=out)
+
+        def boom_when_armed_host(data):
+            if armed[0]:
+                armed[0] = False  # one launch, failed on both paths
+                raise RuntimeError("injected launch failure")
+            return real_host(data)
 
         outcomes = []
         d1 = mk_payload(sw, seed=11)
@@ -483,6 +517,7 @@ class TestAggregatorRobustness:
         # W2 appends at sw (planned against projection size sw); its
         # launch fails at reap
         primary.ec.encode_array = boom_when_armed
+        primary.ec.encode_array_host = boom_when_armed_host
         try:
             primary.submit_transaction(
                 PGTransaction("px").write(sw, bytes(sw)),
@@ -495,6 +530,7 @@ class TestAggregatorRobustness:
             primary.flush_encodes()
         finally:
             primary.ec.encode_array = real
+            primary.ec.encode_array_host = real_host
         assert ("fail2" in [o[0] if isinstance(o, tuple) else o for o in outcomes])
         # W1 survives: projection still reflects ITS planned size, so W3
         # (an append at sw) plans correctly even before W1's commits land

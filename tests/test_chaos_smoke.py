@@ -1,0 +1,29 @@
+"""Seed-fixed chaos smoke in tier-1 (ISSUE 7 acceptance): a real
+mon+mgr+OSD cluster under mixed load survives socket faults, shard-read
+EIO bursts, device-launch failures (host fallback), and an OSD flap —
+converging to all-PGs-clean with ZERO lost writes and health clear of
+SLOW_OPS / TPU_BACKEND_DEGRADED.
+
+The full-size variant lives in `python -m ceph_tpu.tools.chaos`; this is
+the `--smoke` configuration run in-process so tier-1 exercises the same
+code path the operator harness does."""
+
+from ceph_tpu.tools.chaos import run_chaos
+
+
+class TestChaosSmoke:
+    def test_smoke_converges_with_zero_lost_writes(self):
+        report = run_chaos(seed=0xC405, smoke=True)
+        assert report["converged"], report
+        assert report["lost_writes"] == 0, report
+        # every chaos phase actually ran
+        assert len(report["events"]) == 5, report["events"]
+        # the launch-fault phase really drove the host fallback
+        assert report["degraded_entered"], report
+        assert report["fallback_launches"] >= 1, report
+        # health settled: no stuck SLOW_OPS, no lingering degraded check
+        assert "SLOW_OPS" not in report["health_checks"], report
+        assert "TPU_BACKEND_DEGRADED" not in report["health_checks"], report
+        # machine-readable metrics came from the histogram substrate
+        assert report["p99_op_latency_sec"] > 0.0, report
+        assert report["recovery_decode_launches"] >= 0

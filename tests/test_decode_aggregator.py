@@ -196,11 +196,15 @@ class TestDecodeAggregatorCore:
         agg = DecodeAggregator(window=2)
         _, pend1 = self._launch(agg, 1, seed=0)
         real = self.ec.decode_array
+        real_host = self.ec.decode_array_host
 
         def boom(erasures, survivors, out=None):
+            # device AND host-oracle failure: only then is the error
+            # sticky (a device-only failure now completes on the host)
             raise RuntimeError("injected device OOM")
 
         self.ec.decode_array = boom
+        self.ec.decode_array_host = boom
         try:
             # second submission trips the window; its launch fails, but
             # submit must NOT raise into an arbitrary co-rider — the
@@ -208,6 +212,7 @@ class TestDecodeAggregatorCore:
             _, pend2 = self._launch(agg, 1, seed=1)
         finally:
             self.ec.decode_array = real
+            self.ec.decode_array_host = real_host
         for pend in (pend1, pend2):
             assert pend.ready()
             with pytest.raises(EcError):
@@ -361,17 +366,21 @@ class TestBackendAggregatedRecovery:
         c.missing["fx"] = {lost}
         primary = c.primary
         real = primary.ec.decode_array
+        real_host = primary.ec.decode_array_host
 
         def boom(erasures, survivors, out=None):
+            # fails on the device AND the host oracle: truly unrecoverable
             raise RuntimeError("injected decode launch failure")
 
         res = []
         primary.ec.decode_array = boom
+        primary.ec.decode_array_host = boom
         try:
             primary.recover_object("fx", {lost}, lambda e: res.append(e))
             c.pump()  # barrier reaps the failed launch
         finally:
             primary.ec.decode_array = real
+            primary.ec.decode_array_host = real_host
         assert len(res) == 1 and res[0] < 0
         assert not primary.recovery_ops
         assert not primary._decode_pipe

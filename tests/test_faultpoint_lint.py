@@ -1,0 +1,99 @@
+"""Fault-point lint — the injection catalog stays wired and documented
+(the CI satellite of ISSUE 7, mirroring test_metrics_lint.py).
+
+Every `faultpoint("...")` call site in the tree must use a name
+registered in common/fault_injector.py's FAULT_POINTS catalog; every
+catalog entry must have at least one call site (no dead hooks a harness
+could arm in vain) and must be documented in docs/ROBUSTNESS.md — so a
+typo can neither create a hook that never fires nor a doc that lies."""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "ceph_tpu"
+
+# faultpoint("name") / _faultpoint("name", ...) — the two spellings the
+# seams use (objectstore routes through ObjectStore._faultpoint so the
+# InjectedFailure -> StoreError mapping lives in one place)
+_CALL = re.compile(r"""\b_?faultpoint\(\s*["']([a-z0-9_.]+)["']""")
+
+
+def _call_sites() -> dict[str, list[str]]:
+    """point name -> [relative file paths using it]."""
+    found: dict[str, list[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        for m in _CALL.finditer(text):
+            found.setdefault(m.group(1), []).append(
+                str(path.relative_to(REPO))
+            )
+    return found
+
+
+class TestFaultPointCatalog:
+    def test_every_call_site_is_registered(self):
+        from ceph_tpu.common.fault_injector import FAULT_POINTS
+
+        sites = _call_sites()
+        unregistered = {
+            p: files for p, files in sites.items() if p not in FAULT_POINTS
+        }
+        assert not unregistered, (
+            f"faultpoint() call sites using unregistered names: "
+            f"{unregistered} — add them to FAULT_POINTS"
+        )
+
+    def test_every_registered_point_is_wired(self):
+        """A catalog entry nothing checks is a trap: the harness arms it
+        and the fault never fires."""
+        from ceph_tpu.common.fault_injector import FAULT_POINTS
+
+        sites = _call_sites()
+        dead = sorted(set(FAULT_POINTS) - set(sites))
+        assert not dead, (
+            f"FAULT_POINTS entries with no faultpoint() call site: {dead}"
+        )
+
+    def test_every_point_documented_in_robustness_md(self):
+        from ceph_tpu.common.fault_injector import FAULT_POINTS
+
+        doc = (REPO / "docs" / "ROBUSTNESS.md").read_text()
+        undocumented = sorted(
+            p for p in FAULT_POINTS if f"`{p}`" not in doc
+        )
+        assert not undocumented, (
+            f"fault points missing from docs/ROBUSTNESS.md: {undocumented}"
+        )
+
+    def test_catalog_descriptions_nonempty(self):
+        from ceph_tpu.common.fault_injector import FAULT_POINTS
+
+        for name, desc in FAULT_POINTS.items():
+            assert desc.strip(), f"{name}: empty catalog description"
+
+    def test_unregistered_name_raises_eagerly(self):
+        import pytest
+
+        from ceph_tpu.common.fault_injector import faultpoint
+
+        with pytest.raises(ValueError, match="unregistered"):
+            faultpoint("no.such.point")
+
+    def test_counted_hits_drain_and_disarm(self):
+        """Armed hit budgets drain per check and disarm at zero — the
+        property the chaos harness's deterministic bursts rely on."""
+        import pytest
+
+        from ceph_tpu.common.fault_injector import (
+            FaultInjector,
+            InjectedFailure,
+        )
+
+        inj = FaultInjector()
+        inj.inject("os.read", 5, hits=2)
+        for _ in range(2):
+            with pytest.raises(InjectedFailure):
+                inj.check("os.read")
+        inj.check("os.read")  # budget drained: no longer armed
+        assert not inj.armed("os.read")

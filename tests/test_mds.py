@@ -295,3 +295,135 @@ class TestSymlinks:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestExactlyOnceRetries:
+    """ISSUE 7 satellite (ADVICE round-5 medium): retries of
+    non-idempotent ops keep a STABLE (client, tid) reqid and the MDS
+    journals completed results per reqid — a replayed request returns
+    the ORIGINAL reply instead of re-executing (no spurious
+    EEXIST/ENOENT after failover)."""
+
+    @staticmethod
+    async def _resend(fsc, mds_addr, tid, op, args):
+        """Re-send a request with an already-used reqid, as the client's
+        retry loop would after a lost reply."""
+        from ceph_tpu.msg.messages import MClientRequest
+
+        fut = asyncio.get_event_loop().create_future()
+        fsc._replies[tid] = fut
+        msg = MClientRequest(
+            tid=tid, op=op, args=json.dumps(args).encode(),
+            client=fsc.client_id,
+        )
+        await fsc.msgr.send_to(mds_addr, msg)
+        try:
+            return await asyncio.wait_for(fut, 5.0)
+        finally:
+            fsc._replies.pop(tid, None)
+
+    def test_retried_mkdir_replays_original_result(self):
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            fsc = CephFSClient(mds.addr, data)
+            await fsc.mkdir("/once")  # allocated tid 1
+            # the retry (same reqid) replays success — NOT EEXIST
+            reply = await self._resend(
+                fsc, mds.addr, 1, "mkdir", {"path": "/once"}
+            )
+            assert reply.result == 0
+            # a genuinely NEW request for the same path still conflicts
+            with pytest.raises(FsClientError):
+                await fsc.mkdir("/once")
+            await fsc.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_retry_after_crash_replays_from_journal(self):
+        """The completed-request record is write-ahead journaled: a crash
+        before flush still lets the promoted MDS replay the original
+        reply to a retried mkdir/unlink."""
+
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            mds._flush_task.cancel()  # no flush: journal is the only record
+            fsc = CephFSClient(mds.addr, data)
+            await fsc.mkdir("/j")          # tid 1
+            await fsc.mkdir("/j/sub")      # tid 2
+            # crash without flush, promote a fresh daemon on the pools
+            mds._running = False
+            mds._flush_task = None
+            await mds.msgr.shutdown()
+            mds2 = MDS(meta, data)
+            await mds2.start()
+            # retried tids replay their original success
+            for tid, path in ((1, "/j"), (2, "/j/sub")):
+                reply = await self._resend(
+                    fsc, mds2.addr, tid, "mkdir", {"path": path}
+                )
+                assert reply.result == 0, (tid, path, reply.result)
+            # new requests see the real namespace state
+            fsc2 = CephFSClient(mds2.addr, data, name="client.fs2")
+            with pytest.raises(FsClientError):
+                await fsc2.mkdir("/j")
+            await fsc.shutdown()
+            await fsc2.shutdown()
+            await mds2.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_retry_after_flush_replays_from_completed_table(self):
+        """A journal TRIM must not forget completed requests: the table
+        persists in mds_completed at flush and reloads on promotion."""
+
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            fsc = CephFSClient(mds.addr, data)
+            await fsc.mkdir("/t")  # tid 1
+            await mds._flush()     # journal trims; table persisted
+            assert (await meta.read(JOURNAL_OID)) == b""
+            await mds.stop(flush=False)
+            mds2 = MDS(meta, data)
+            await mds2.start()
+            reply = await self._resend(
+                fsc, mds2.addr, 1, "mkdir", {"path": "/t"}
+            )
+            assert reply.result == 0
+            await fsc.shutdown()
+            await mds2.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_replayed_create_regrants_caps(self):
+        """A retried create must leave the retrying session holding the
+        caps its recorded reply promises, or the next setattr bounces."""
+
+        async def run():
+            monmap, mons, osds, rados, meta, data, mds = await _fs_cluster()
+            fsc = CephFSClient(mds.addr, data)
+            fh = await fsc.create("/f.txt")  # tid 1: grants "w"
+            ino = fh.entry["ino"]
+            reply = await self._resend(
+                fsc, mds.addr, 1, "create", {"path": "/f.txt", "caps": "w"}
+            )
+            assert reply.result == 0
+            payload = json.loads(reply.payload.decode())
+            assert payload["entry"]["ino"] == ino
+            assert payload["caps"] == "w"
+            # the session holds the re-granted caps: handle-held setattr
+            # (the cap-checked op) succeeds
+            await fh.truncate(0)
+            await fh.close()
+            await fsc.shutdown()
+            await mds.stop()
+            await rados.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
