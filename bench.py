@@ -25,7 +25,9 @@ Robustness: the environment's TPU backend (axon) is known to sometimes fail
 or hang during init.  The parent process therefore never imports jax; the
 measurement runs in a child subprocess under a bounded deadline, attempted
 on TPU first (with one retry for fast failures) and falling back to a CPU
-child.  A TPU failure is recorded in the JSON as `tpu_error` and the CPU
+child.  A TPU failure is recorded in the JSON as a structured `tpu_failure`
+object — `{"cause": import_hang | backend_init_hang | stage_hang |
+device_error, "stage": ..., "rc": ..., "detail": ...}` — and the CPU
 number still satisfies the one-JSON-line contract.  The line is always
 parseable; only if BOTH children fail is value 0, with the causes in an
 `error` field.
@@ -101,6 +103,11 @@ class _StageWatchdog:
                 stage, deadline, rc = self._stage, self._deadline, self._rc
             if deadline is not None and time.monotonic() > deadline:
                 self._clog(f"WATCHDOG: stage '{stage}' overran its allowance")
+                # machine-readable failure stage on stdout: the parent
+                # folds it into the JSON taxonomy (import_hang /
+                # backend_init_hang / stage_hang) instead of a free-text
+                # error string
+                print(json.dumps({"failure_stage": stage}), flush=True)
                 sys.stderr.flush()
                 os._exit(rc)
 
@@ -563,6 +570,17 @@ def run_child(platform: str, mc_only: bool = False) -> None:
             }
             for s in tr.export()
         ]
+    # Flight-recorder summary (ISSUE 8): launch count, mean queue-wait,
+    # occupancy over the child's run.  Bench encodes run OUTSIDE the
+    # aggregators, so these are span-less dispatch-shape witnesses
+    # (occupancy 0 here is expected); the aggregated data-path numbers
+    # come from the OSD asok dump_flight / chaos report instead.
+    try:
+        from ceph_tpu.ops.flight_recorder import flight_recorder
+
+        result["flight"] = flight_recorder().summary()
+    except Exception as e:  # headline survives a summary fault
+        clog(f"flight summary failed: {e!r}")
     # The per-chip headline is SAFE from here on: it goes out before the
     # multichip stage runs, and the parent merges every JSON line it can
     # salvage — a multichip hang/crash can only lose the multichip twin.
@@ -576,6 +594,34 @@ def run_child(platform: str, mc_only: bool = False) -> None:
 
 class _McDone(Exception):
     """Early exit from the multichip stage (skip/fault already recorded)."""
+
+
+def classify_tpu_failure(
+    rc: int | None, deadline: bool, stage: str | None
+) -> str:
+    """TPU-child failure taxonomy (ISSUE 8 satellite): collapse the
+    rc/deadline/watchdog-stage evidence into one machine-diffable cause
+    so the round-over-round fallback pattern (rounds 4-5 fell back on
+    backend-init hangs) is comparable across BENCH_r*.json without
+    parsing prose.
+
+    - `import_hang`:       the import_jax watchdog stage overran (the
+                           axon sitecustomize blocking in `import jax`)
+    - `backend_init_hang`: jax.devices() overran its ~45 s sub-deadline
+                           (rc=6; the parent retries this once)
+    - `stage_hang`:        any later watchdog stage overran (rc=5), or
+                           the whole child hit the parent deadline
+    - `device_error`:      the child FAILED rather than hung — no TPU
+                           (rc=3), parity mismatch (rc=4), a crash, or
+                           an exit (even rc=0) without a usable result
+    """
+    if stage == "import_jax":
+        return "import_hang"
+    if rc == 6 or stage == "backend_init":
+        return "backend_init_hang"
+    if rc == 5 or stage is not None or deadline:
+        return "stage_hang"
+    return "device_error"
 
 
 def _child_env(platform: str, multichip: bool = False) -> dict:
@@ -640,8 +686,30 @@ def _parse_result_lines(stdout: bytes, require: str = "gbps") -> dict | None:
     return merged if require in merged else None
 
 
-def _try_platform(platform: str, deadline: float) -> tuple[dict | None, str]:
-    """Run a measurement child; return (result dict or None, error string).
+def _failure_info(
+    platform: str, stdout: bytes, rc: int | None, deadline: bool, detail: str
+) -> dict:
+    """Structured failure record for the emitted JSON (the taxonomy
+    satellite): cause + watchdog stage (when the child reported one) +
+    the raw detail string."""
+    merged = _parse_result_lines(stdout, require="failure_stage") or {}
+    stage = merged.get("failure_stage")
+    info = {
+        "cause": classify_tpu_failure(rc, deadline, stage),
+        "detail": detail,
+    }
+    if stage is not None:
+        info["stage"] = stage
+    if rc is not None:
+        info["rc"] = rc
+    return info
+
+
+def _try_platform(
+    platform: str, deadline: float
+) -> tuple[dict | None, str, dict | None]:
+    """Run a measurement child; return (result dict or None, error
+    string, failure-taxonomy dict or None).
 
     The child streams one JSON line per completed stage, so a late-stage
     hang or watchdog kill (multichip after the headline) SALVAGES every
@@ -662,19 +730,26 @@ def _try_platform(platform: str, deadline: float) -> tuple[dict | None, str]:
         if result is not None:
             _log(f"{platform} child hit the deadline AFTER the headline; "
                  "salvaging completed stages")
-            return result, ""
-        return None, f"{platform} child hit {deadline:.0f}s deadline (backend hang?)"
+            return result, "", None
+        detail = f"{platform} child hit {deadline:.0f}s deadline (backend hang?)"
+        return None, detail, _failure_info(
+            platform, e.stdout or b"", None, True, detail
+        )
     if proc.returncode != 0:
         result = _parse_result_lines(proc.stdout)
         if result is not None:
             _log(f"{platform} child exited rc={proc.returncode} AFTER the "
                  "headline; salvaging completed stages")
-            return result, ""
-        return None, f"{platform} child exited rc={proc.returncode}"
+            return result, "", None
+        detail = f"{platform} child exited rc={proc.returncode}"
+        return None, detail, _failure_info(
+            platform, proc.stdout, proc.returncode, False, detail
+        )
     result = _parse_result_lines(proc.stdout)
     if result is not None:
-        return result, ""
-    return None, f"{platform} child produced no JSON result"
+        return result, "", None
+    detail = f"{platform} child produced no JSON result"
+    return None, detail, _failure_info(platform, proc.stdout, 0, False, detail)
 
 
 def _try_multichip_cpu(deadline: float) -> dict | None:
@@ -709,15 +784,17 @@ def main() -> None:
         return
 
     tpu_error = ""
+    tpu_failure = None
     result = None
     init_retries = 0
     attempt = 0
     while attempt < TPU_RETRIES:
         attempt += 1
-        result, err = _try_platform("tpu", TPU_DEADLINE_S)
+        result, err, failure = _try_platform("tpu", TPU_DEADLINE_S)
         if result is not None:
             break
         tpu_error = err
+        tpu_failure = failure
         _log(f"TPU attempt {attempt}/{TPU_RETRIES} failed: {err}")
         if "deadline" in err:
             break  # a hang will hang again; don't burn another deadline
@@ -746,21 +823,20 @@ def main() -> None:
 
     if result is None:
         _log("falling back to CPU measurement")
-        result, err = _try_platform("cpu", CPU_DEADLINE_S)
+        result, err, _cpu_failure = _try_platform("cpu", CPU_DEADLINE_S)
         if result is None:
             # Still emit a parseable line: an attributable environment fault
             # beats a traceback.
-            print(
-                json.dumps(
-                    {
-                        "metric": "rs_8_3_encode_GBps_per_chip",
-                        "value": 0,
-                        "unit": "GB/s",
-                        "vs_baseline": 0,
-                        "error": f"tpu: {tpu_error}; cpu: {err}",
-                    }
-                )
-            )
+            out = {
+                "metric": "rs_8_3_encode_GBps_per_chip",
+                "value": 0,
+                "unit": "GB/s",
+                "vs_baseline": 0,
+                "error": f"tpu: {tpu_error}; cpu: {err}",
+            }
+            if tpu_failure is not None:
+                out["tpu_failure"] = tpu_failure
+            print(json.dumps(out))
             sys.exit(0)
 
     # Multichip on the CPU fallback runs in its OWN child with a forced
@@ -820,10 +896,33 @@ def main() -> None:
         out["stages"] = result["stages"]
     if "probe_s" in result:
         out["probe_s"] = result["probe_s"]
-    if tpu_error:
-        out["tpu_error"] = tpu_error
+    if tpu_failure is not None:
+        # machine-diffable failure taxonomy (replaces the free-text
+        # tpu_error field): cause in {import_hang, backend_init_hang,
+        # stage_hang, device_error} + stage/rc/detail evidence
+        out["tpu_failure"] = tpu_failure
+    if "flight" in result:
+        # flight-recorder summary from the measuring child (ISSUE 8):
+        # launch count, mean queue-wait, occupancy — the bench
+        # trajectory tracks device utilization alongside GB/s
+        out["flight"] = result["flight"]
     if "trace" in result:
         out["trace"] = result["trace"]
+    # chaos-harness metrics (tools/chaos.py --out): fold chaos_p99_ms +
+    # recovery_occupancy into the bench line so the PROGRESS trajectory
+    # tracks them alongside GB/s (ROADMAP item 4)
+    chaos_path = os.environ.get("BENCH_CHAOS_JSON", "")
+    if chaos_path and os.path.exists(chaos_path):
+        try:
+            with open(chaos_path) as f:
+                chaos = json.load(f)
+            out["chaos"] = {
+                k: chaos[k]
+                for k in ("chaos_p99_ms", "recovery_occupancy", "converged")
+                if k in chaos
+            }
+        except (OSError, json.JSONDecodeError) as e:
+            _log(f"ignoring unreadable BENCH_CHAOS_JSON: {e!r}")
     print(json.dumps(out))
 
 

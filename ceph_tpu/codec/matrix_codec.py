@@ -15,6 +15,7 @@ so any erasure pattern reuses one compiled kernel per shape.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Mapping
 
@@ -466,7 +467,7 @@ class _AggGroup:
     __slots__ = (
         "key", "ec", "ctx", "arrays", "tickets", "stripes", "nbytes",
         "parity", "host", "pad", "error", "donatable", "lock",
-        "input", "credit",
+        "input", "credit", "flight", "submit_ts", "stalled",
     )
 
     def __init__(self, key, ec, ctx=None):
@@ -487,6 +488,12 @@ class _AggGroup:
         # recomputed on the host oracle
         self.input: np.ndarray | None = None
         self.credit = 0  # inflight-byte throttle credit held by this group
+        # flight-recorder state (ISSUE 8): the launch's record, the
+        # window-open timestamp queue-wait anchors on, and whether any
+        # submitter hit the backpressure bound getting in
+        self.flight: dict | None = None
+        self.submit_ts = time.monotonic()
+        self.stalled = False
         # serializes THIS group's launch/materialization (the encode
         # dispatch + blocking device wait) without stalling the
         # aggregator-wide lock; RLock because a reap-forced launch runs
@@ -599,13 +606,15 @@ class LaunchAggregator:
         trips.  Admission is throttled: past ec_tpu_inflight_max_bytes of
         unsettled work, this call settles older launches first."""
         stripes = shaped.shape[0]
-        self._admit(shaped.nbytes)
+        stalled = self._admit(shaped.nbytes)
         reason = None
         with self._lock:
             self.perf.inc("submits")
             g = self._groups.get(key)
             if g is None:
                 g = self._groups[key] = _AggGroup(key, ec, ctx)
+            if stalled:
+                g.stalled = True  # flight record flags the stall
             ticket = AggTicket(self, g, g.stripes, stripes)
             g.arrays.append(shaped)
             g.tickets.append(ticket)
@@ -630,7 +639,7 @@ class LaunchAggregator:
                 pass
         return ticket
 
-    def _admit(self, nbytes: int) -> None:
+    def _admit(self, nbytes: int) -> bool:
         """Backpressure admission (the byte Throttle): take credit for a
         submission; over the bound, the SUBMITTER settles the oldest
         outstanding launches — paying the drain latency itself — until
@@ -639,14 +648,16 @@ class LaunchAggregator:
         work unboundedly.  A single submission larger than the whole
         bound is admitted once nothing older remains (the reference
         Throttle's oversized-request semantics: the dispatch path must
-        not wedge)."""
+        not wedge).  Returns True when the submitter stalled (the flight
+        record of the launch it rides flags `throttle_stall`)."""
         if self.inflight.get_or_fail(nbytes):
-            return
+            return False
         self.perf.inc("throttle_stalls")
         while not self.inflight.get_or_fail(nbytes):
             if not self._settle_oldest():
                 self.inflight.take(nbytes)  # oversized: admit anyway
-                return
+                break
+        return True
 
     def _settle_oldest(self) -> bool:
         """Settle one outstanding group, oldest first — launched groups
@@ -731,18 +742,60 @@ class LaunchAggregator:
             # retained until settle: a device that wedges AFTER this
             # dispatch is recomputed from these exact bytes on the host
             g.input = data
+            # flight record (ISSUE 8): the launch's timeline entry.
+            # queue_wait anchors on the group's window-open timestamp;
+            # the guarded dispatch runs inside the record's scope so
+            # ops/dispatch.py annotates devices and ops/guard.py flags
+            # deadline hits on THIS record.
+            from ceph_tpu.ops.flight_recorder import flight_recorder, new_record
+
+            fr = flight_recorder()
+            rec = g.flight = new_record(
+                self.WHAT,
+                group=self._group_label(g),
+                tickets=len(g.tickets),
+                stripes=g.stripes,
+                batch=data.shape[0],
+                nbytes=data.nbytes,
+                submit_ts=g.submit_ts,
+                reason=reason,
+            )
+            if g.stalled:
+                rec["flags"]["throttle_stall"] = True
+            t_dispatch = time.monotonic()
             try:
-                parity = self._guarded_dispatch(g, data, donate)
+                with fr.active_scope(rec):
+                    parity = self._guarded_dispatch(g, data, donate)
             except BaseException as e:
                 # sticky: every co-rider's reap reports the launch failure
                 # instead of crashing on a half-torn group.  The group
                 # still enters the live list so its backpressure credit
                 # releases at settle.
+                # same dead-time rule as the success path, stricter: a
+                # launch that RAISED (deadline wait, device error with a
+                # failed host recompute, bad geometry) produced nothing
+                # — none of its elapsed time banks as busy
+                rec["dispatch_ts"] = t_dispatch
                 g.error = e
                 g.pad = pad
                 with self._lock:
                     self._live.append(g)
                 raise
+            # dispatch_ts anchors where the launch LEFT the window
+            # (queue-wait ends here); h2d_s is the synchronous slice of
+            # the dispatch — H2D staging + launch enqueue (JAX dispatch
+            # is async, kernel time shows up at settle).  A fallback
+            # launch gets h2d_s = 0: its host compute is already banked
+            # in kernel_s, and the remainder of the elapsed time is the
+            # watchdog DEADLINE wait on a wedged device — dead time that
+            # must not inflate device_busy_seconds/occupancy.
+            rec["dispatch_ts"] = t_dispatch
+            if rec["flags"]["fallback"]:
+                rec["h2d_s"] = 0.0
+            else:
+                rec["h2d_s"] = max(
+                    0.0, time.monotonic() - t_dispatch - rec["kernel_s"]
+                )
             g.arrays = []
             g.pad = pad
             g.parity = parity
@@ -757,6 +810,18 @@ class LaunchAggregator:
         self.perf.hinc("stripes_per_launch", g.stripes)
         self.perf.hinc("tickets_per_launch", len(g.tickets))
         self.perf.hinc("launch_bytes", data.nbytes)
+
+    def _group_label(self, g: _AggGroup) -> str:
+        """Stable human-readable lane name for a group's flight records
+        and trace-export lanes: aggregator kind + a short key digest +
+        the chunk length (the key's raw bytes are not JSON-safe).
+        crc32 over the key's repr, NOT hash(): the built-in is salted
+        per process, which would break cross-run lane correlation."""
+        import zlib
+
+        chunk = g.key[-1] if g.key and isinstance(g.key[-1], int) else 0
+        digest = zlib.crc32(repr(g.key).encode())
+        return f"{self.PERF_NAME}/{digest:08x}/L{chunk}"
 
     # -- device guard / host fallback ---------------------------------------
 
@@ -789,7 +854,17 @@ class LaunchAggregator:
         after a device failure — a recompute that fails identically
         (singular matrix, bad geometry) is a data error, not a backend
         verdict, and raises sticky like any launch failure."""
+        t0 = time.monotonic()
         host = self._dispatch_host(g, data)
+        if g.flight is not None:
+            # flight-record verdict: this launch completed on the host.
+            # The host compute banks as kernel_s (it IS the kernel, just
+            # not on the device); degraded_bypass marks launches that
+            # never tried the device at all.
+            g.flight["flags"]["fallback"] = True
+            if cause is None:
+                g.flight["flags"]["degraded_bypass"] = True
+            g.flight["kernel_s"] += time.monotonic() - t0
         if cause is not None:
             from ceph_tpu.ops.guard import device_guard
 
@@ -841,13 +916,46 @@ class LaunchAggregator:
                     # (the window<=1 default path) hand the result
                     # straight through — no forced copy, no pooling.
                     force_copy = g.donatable and not single
-                    try:
-                        host = device_guard().call(
-                            lambda: np.array(parity)
+                    rec = g.flight
+                    # the worker writes spans into a side dict, folded
+                    # into the record only on SUCCESS: a materialize
+                    # that times out leaves an abandoned worker holding
+                    # this closure, and if the device later unwedges it
+                    # would otherwise rewrite an already-committed
+                    # record with a minutes-long bogus kernel span
+                    spans: dict[str, float] = {}
+
+                    def _materialize():
+                        # flight sub-spans: kernel_s is how long THIS
+                        # reap blocked waiting for the device (0 = the
+                        # kernel finished under other work — perfect
+                        # overlap); d2h_s is the device->host copy.
+                        t0 = time.monotonic()
+                        wait = getattr(parity, "block_until_ready", None)
+                        if wait is not None:
+                            wait()
+                        t1 = time.monotonic()
+                        out = (
+                            np.array(parity)
                             if force_copy
-                            else np.asarray(parity),
-                            what=f"{self.WHAT} materialize",
+                            else np.asarray(parity)
                         )
+                        t2 = time.monotonic()
+                        spans["kernel_s"] = t1 - t0
+                        spans["d2h_s"] = t2 - t1
+                        return out
+
+                    from ceph_tpu.ops.flight_recorder import flight_recorder
+
+                    try:
+                        with flight_recorder().active_scope(rec):
+                            host = device_guard().call(
+                                _materialize,
+                                what=f"{self.WHAT} materialize",
+                            )
+                        if rec is not None:
+                            rec["kernel_s"] += spans.get("kernel_s", 0.0)
+                            rec["d2h_s"] += spans.get("d2h_s", 0.0)
                     except BaseException as e:
                         try:
                             host = self._host_fallback(g, g.input, e)
@@ -869,6 +977,15 @@ class LaunchAggregator:
                 self.inflight.put(g.credit)
                 g.credit = 0
             g.input = None
+            # commit the flight record exactly once (g.flight nulls out;
+            # later reaps of the same group skip this)
+            if g.flight is not None:
+                rec, g.flight = g.flight, None
+                rec["flags"]["error"] = g.error is not None
+                rec["settle_ts"] = time.monotonic()
+                from ceph_tpu.ops.flight_recorder import flight_recorder
+
+                flight_recorder().commit(rec)
         with self._lock:
             if g in self._live:
                 self._live.remove(g)

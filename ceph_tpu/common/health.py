@@ -58,6 +58,32 @@ def tpu_degraded_detail(degraded: dict[str, dict]) -> list[str]:
     ]
 
 
+def recovery_stalled_summary(stalled: dict[str, dict]) -> str | None:
+    """The PG_RECOVERY_STALLED check summary for a stalled-event slice
+    ({"<pgid>:<kind>": {pgid, kind, stalled_for_sec, objects_done,
+    objects_total}}), or None when every event is advancing.  Shared by
+    the mgr progress module and the mon health check so the two
+    surfaces agree."""
+    if not stalled:
+        return None
+    longest = max(v.get("stalled_for_sec", 0.0) for v in stalled.values())
+    return (
+        f"{len(stalled)} pg event(s) have recovery/backfill making no "
+        f"progress (longest stalled for {longest:.0f} sec): "
+        f"[{','.join(sorted(stalled))}]"
+    )
+
+
+def recovery_stalled_detail(stalled: dict[str, dict]) -> list[str]:
+    """Per-event breakdown lines (`health detail`)."""
+    return [
+        f"pg {v.get('pgid', key)}: {v.get('kind', 'recovery')} stalled "
+        f"{v.get('stalled_for_sec', 0.0):.0f} sec at "
+        f"{v.get('objects_done', 0)}/{v.get('objects_total', 0)} objects"
+        for key, v in sorted(stalled.items())
+    ]
+
+
 def down_in_osds(osdmap) -> list:
     """OSDs that are IN but not up — the OSD_DOWN population.  A
     decommissioned (out) osd being down is healthy by design, as in the

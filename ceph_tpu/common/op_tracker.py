@@ -37,7 +37,21 @@ class TrackedOp:
                 "events": [
                     {"time": round(t - self.start, 6), "event": e}
                     for t, e in self.events
-                ]
+                ],
+                # per-stage durations (ISSUE 8 satellite): the gap
+                # between consecutive event marks, named after the stage
+                # they END ("queued" -> "reached_pg" renders as
+                # reached_pg's duration) — where a historic op's time
+                # went, without the reader diffing timestamps by hand
+                "stages": [
+                    {
+                        "stage": self.events[i][1],
+                        "duration": round(
+                            self.events[i][0] - self.events[i - 1][0], 6
+                        ),
+                    }
+                    for i in range(1, len(self.events))
+                ],
             },
         }
 

@@ -198,6 +198,31 @@ OPTIONS: dict[str, Option] = _opts(
         runtime=True,
     ),
     Option(
+        "ec_tpu_flight_records",
+        int,
+        512,
+        A,
+        "launch flight-recorder ring capacity (ops/flight_recorder.py): "
+        "completed per-launch records retained for the asok dump_flight "
+        "command and tools/trace_export.py timelines.  Resizing at "
+        "runtime keeps the newest records; the ring is the memory bound "
+        "— each record is a small flat dict",
+        see_also=("ec_tpu_aggregate_window",),
+        runtime=True,
+    ),
+    Option(
+        "mgr_progress_stall_sec",
+        float,
+        60.0,
+        A,
+        "PG_RECOVERY_STALLED window (mgr/progress.py): a PG whose "
+        "recovery/backfill event reports no objects/bytes advance for "
+        "this many seconds raises the health warning; it clears on the "
+        "next observed advance (or event completion).  <= 0 disables "
+        "the check",
+        runtime=True,
+    ),
+    Option(
         "ec_tpu_shard_min_batch",
         int,
         32,

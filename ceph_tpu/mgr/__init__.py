@@ -5,6 +5,7 @@ from .dashboard import DashboardModule
 from .mgr import Mgr
 from .modules import MgrModule
 from .orchestrator import OrchBackend, OrchestratorModule, ServiceSpec
+from .progress import ProgressModule
 from .telemetry import TelemetryModule
 
 __all__ = [
@@ -13,6 +14,7 @@ __all__ = [
     "MgrModule",
     "OrchBackend",
     "OrchestratorModule",
+    "ProgressModule",
     "ServiceSpec",
     "TelemetryModule",
 ]

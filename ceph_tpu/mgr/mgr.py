@@ -159,7 +159,21 @@ class Mgr(Dispatcher):
             # the host fallback); the mon-side TPU_BACKEND_DEGRADED
             # check reads this slice
             "tpu_degraded": self.tpu_degraded_by_daemon(),
+            # per-PG recovery/backfill/scrub bars with rate + ETA from
+            # the progress module (ISSUE 8); `ceph_cli status` renders
+            # them and the mon's PG_RECOVERY_STALLED check reads the
+            # `stalled` sub-slice.  Empty when no module is registered.
+            "progress": self.progress_digest(),
         }
+
+    def progress_digest(self) -> dict:
+        """The registered progress module's digest slice, or {} when the
+        module isn't loaded (modules are opt-in, like the reference's)."""
+        for module in self.modules:
+            digest = getattr(module, "progress_digest", None)
+            if digest is not None:
+                return digest()
+        return {}
 
     def tpu_degraded_by_daemon(self) -> dict[str, dict]:
         """Daemons reporting a DEGRADED device backend (the OSD status'

@@ -17,6 +17,24 @@ def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
+def _perf_type(counter: str) -> str:
+    """Family type for a scalar perf value.  Most daemon perf scalars
+    are monotonic counters, but the flight-recorder utilization exports
+    rise AND fall (occupancy is a fraction; a dump_flight reset rebases
+    everything) — announcing those as counters would make PromQL
+    rate()/increase() read every dip as a counter reset."""
+    name = counter.rsplit(".", 1)[-1]
+    if (
+        "occupancy" in name
+        or "mean_queue_wait" in name
+        or "busy_seconds" in name
+        or "flight_records" in name
+        or name == "backend_degraded"
+    ):
+        return "gauge"
+    return "counter"
+
+
 class PrometheusModule(HttpServedModule, MgrModule):
     NAME = "prometheus"
 
@@ -79,6 +97,22 @@ class PrometheusModule(HttpServedModule, MgrModule):
                 rows.append(
                     f'ceph_tpu_{metric}{{pool="{pool}"}} {st[field_]}'
                 )
+        # module-exported families (the reference's MgrModule
+        # add_metric analog): any registered module exposing
+        # `prometheus_metrics() -> [(family, type, help, samples)]`
+        # contributes — the progress module's per-PG gauges ride this
+        for module in mgr.modules:
+            metrics = getattr(module, "prometheus_metrics", None)
+            if metrics is None:
+                continue
+            try:
+                families_out = metrics()
+            except Exception:
+                # same contract as Mgr._module_loop: one faulty module
+                # loses its own families, never the whole exposition
+                continue
+            for name, ftype, help_, rows in families_out:
+                family(name, ftype, help_).extend(rows)
         # per-daemon perf counters, grouped into families across daemons
         for daemon in mgr.list_daemons():
             perf = mgr.get_daemon_perf(daemon)
@@ -110,9 +144,9 @@ class PrometheusModule(HttpServedModule, MgrModule):
                         f'{metric}_count{{daemon="{daemon}"}} {value.get("avgcount", 0)}'
                     )
                     continue
-                family(metric, "counter", f"perf counter {counter}").append(
-                    f'{metric}{{daemon="{daemon}"}} {value}'
-                )
+                family(
+                    metric, _perf_type(counter), f"perf counter {counter}"
+                ).append(f'{metric}{{daemon="{daemon}"}} {value}')
         out: list[str] = []
         for name, (ftype, help_, rows) in families.items():
             out.append(f"# HELP {name} {help_}")

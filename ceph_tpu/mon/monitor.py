@@ -474,6 +474,16 @@ class Monitor(Dispatcher):
             details["TPU_BACKEND_DEGRADED"] = health.tpu_degraded_detail(
                 degraded
             )
+        # recovery/backfill events that stopped advancing (mgr progress
+        # module digest slice, ISSUE 8); clears when progress resumes or
+        # the event completes
+        stalled = (self.pg_digest.get("progress") or {}).get("stalled") or {}
+        summary = health.recovery_stalled_summary(stalled)
+        if summary:
+            checks["PG_RECOVERY_STALLED"] = summary
+            details["PG_RECOVERY_STALLED"] = health.recovery_stalled_detail(
+                stalled
+            )
         return checks, details
 
     def _mon_command_handler(self, prefix: str):
@@ -530,6 +540,12 @@ class Monitor(Dispatcher):
                             "num_up_osds": m.num_up_osds(),
                             "pools": [p.name for p in m.pools.values()],
                             "fsmap": self.mdsmon.map.status(),
+                            # per-PG progress bars with rate + ETA (mgr
+                            # progress module via the PGMap digest) —
+                            # the `ceph -s` progress block analog
+                            "progress": self.pg_digest.get(
+                                "progress", {}
+                            ),
                         }
                     ).encode(),
                 )

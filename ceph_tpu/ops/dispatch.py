@@ -131,13 +131,37 @@ def record_launch(
     PLAN_CACHE.decode_coder) so it also lands on DECODE_LAUNCHES.
     `devices` is how many mesh devices the dispatch spanned (the sharded
     dispatcher passes its stripe-shard count); > 1 additionally lands on
-    SHARDED_LAUNCHES and every value feeds the occupancy distribution."""
+    SHARDED_LAUNCHES and every value feeds the occupancy distribution.
+
+    Flight recorder hook (ISSUE 8): a dispatch running under an
+    aggregator launch annotates devices/kind onto the ACTIVE flight
+    record; a dispatch with no active record (eager bulk paths, bench
+    loops) appends a lightweight span-less record so `dump_flight` and
+    the trace export still show it on the timeline."""
     LAUNCHES.record(stripes, nbytes)
     if decode:
         DECODE_LAUNCHES.record(stripes, nbytes)
     if devices > 1:
         SHARDED_LAUNCHES.record(stripes, nbytes)
     DEVICES_PER_LAUNCH.record(devices)
+    from .flight_recorder import flight_recorder
+
+    fr = flight_recorder()
+    rec = fr.active()
+    if rec is not None:
+        # skip records that already settled: an abandoned watchdog
+        # worker whose device unwedges minutes later still holds this
+        # record through its contextvars copy, and a post-commit
+        # rewrite would corrupt the ring under readers
+        if not rec["settle_ts"]:
+            rec["devices"] = max(rec["devices"], int(devices))
+            rec["flags"]["sharded"] = rec["flags"]["sharded"] or devices > 1
+            if decode:
+                rec["kind"] = "decode"
+    else:
+        fr.record_raw(
+            "decode" if decode else "encode", stripes, nbytes, devices
+        )
 
 
 def perf_dump() -> dict[str, object]:
@@ -167,4 +191,19 @@ def perf_dump() -> dict[str, object]:
     out["backend_degraded_total"] = snap["degraded_total"]
     out["backend_probes"] = snap["probes"]
     out["backend_probe_failures"] = snap["probe_failures"]
+    # device-utilization accounting derived from the flight recorder
+    # (ISSUE 8): busy-seconds weighted by launch width, occupancy % of
+    # the observation window, and the flight-ring health scalars.  The
+    # OSD's MMgrReport re-exports the first two under their canonical
+    # prometheus names (ceph_tpu_ec_device_busy_seconds /
+    # ceph_tpu_ec_device_occupancy).
+    from .flight_recorder import flight_recorder
+
+    util = flight_recorder().utilization()
+    out["device_busy_seconds"] = round(util["device_busy_seconds"], 6)
+    out["device_occupancy"] = round(util["occupancy"], 6)
+    out["flight_records"] = int(util["span_records"])
+    out["flight_mean_queue_wait_ms"] = round(
+        util["mean_queue_wait_s"] * 1e3, 3
+    )
     return out

@@ -1,0 +1,298 @@
+"""Launch flight recorder — a bounded, lock-cheap ring of per-launch
+records for the coding hot path (ISSUE 8 tentpole).
+
+The launch counters (ops/dispatch.py) answer "how many dispatches"; the
+perf histograms answer "how were they distributed"; neither can show a
+TIMELINE.  Closing the per-chip gap to the ≥40 GB/s north star is an
+overlap problem — the next H2D must run under the current kernel — and
+an overlap problem is invisible without per-launch spans.  Each record
+carries:
+
+- identity: monotone ``seq``, ``kind`` (encode/decode/...), the
+  aggregator ``group`` key, ticket/stripe/batch/byte counts, the device
+  count the dispatch spanned (annotated by ops/dispatch.record_launch);
+- the timeline: ``submit_ts`` (first submission into the window),
+  ``dispatch_ts``, ``settle_ts``, and derived spans — ``queue_wait_s``
+  (submit→dispatch: time spent windowed), ``h2d_s`` (the synchronous
+  part of the dispatch: host→device staging + launch enqueue; JAX
+  dispatch is async so this is NOT kernel time), ``kernel_s`` (how long
+  the reaper blocked in ``block_until_ready`` — 0 when the kernel
+  finished under other work, i.e. perfect overlap), ``d2h_s`` (the
+  device→host copy of the materialization);
+- flags: ``sharded``, ``fallback`` (completed on the host oracle),
+  ``degraded_bypass`` (device skipped entirely while DEGRADED),
+  ``timeout`` (a DeviceGuard deadline fired), ``throttle_stall`` (a
+  submitter hit the inflight-byte bound), ``error`` (sticky failure).
+
+Producers hold the record through a contextvar scope
+(``active_scope``): ops/dispatch.py annotates devices/kind on the
+record its dispatch runs under, and ops/guard.py flags deadline hits —
+neither needs aggregator plumbing.  Dispatches with no active record
+(eager bulk paths, bench loops) get a lightweight span-less record from
+``record_launch`` so the ring still shows them.
+
+The ring is a ``collections.deque(maxlen=...)``; a commit takes one
+short lock to bank the utilization accumulators and append (the append
+must share the lock with ``configure``'s deque swap), and readers
+snapshot without blocking writers.  Consumers:
+
+- OSD asok ``dump_flight`` → ``dump()`` (records + utilization),
+- ``tools/trace_export.py`` → Chrome trace-event JSON (Perfetto lanes
+  per device / per aggregator group with explicit idle gaps),
+- ``ops/dispatch.perf_dump()`` → ``device_busy_seconds`` /
+  ``device_occupancy`` scalars (the mgr Prometheus scrape re-exports
+  them as ``ceph_tpu_ec_device_busy_seconds`` /
+  ``ceph_tpu_ec_device_occupancy``),
+- ``bench.py`` / ``tools/chaos.py`` fold ``summary()`` into their JSON.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+
+DEFAULT_CAPACITY = 512
+
+# the record the CURRENT dispatch runs under (a plain mutable dict):
+# set by LaunchAggregator._launch around its guarded dispatch, read by
+# ops/dispatch.record_launch and ops/guard.DeviceGuard.call.  A
+# contextvar (not a thread-local) so the guard's watchdog worker —
+# which runs the dispatch under contextvars.copy_context() — sees and
+# mutates the SAME dict.
+import contextvars
+
+_ACTIVE: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "flight_record", default=None
+)
+
+
+def new_record(
+    kind: str,
+    group: str = "",
+    tickets: int = 1,
+    stripes: int = 0,
+    batch: int = 0,
+    nbytes: int = 0,
+    submit_ts: float | None = None,
+    reason: str = "",
+) -> dict:
+    """A fresh (uncommitted) flight record.  ``submit_ts`` is the FIRST
+    submission into the launch's window (queue-wait anchors here)."""
+    now = time.monotonic()
+    return {
+        "seq": 0,  # assigned at commit
+        "kind": kind,
+        "group": group,
+        "tickets": int(tickets),
+        "stripes": int(stripes),
+        "batch": int(batch),
+        "bytes": int(nbytes),
+        "devices": 1,
+        "reason": reason,
+        "submit_ts": now if submit_ts is None else float(submit_ts),
+        "dispatch_ts": 0.0,
+        "settle_ts": 0.0,
+        "queue_wait_s": 0.0,
+        "h2d_s": 0.0,
+        "kernel_s": 0.0,
+        "d2h_s": 0.0,
+        "flags": {
+            "sharded": False,
+            "fallback": False,
+            "degraded_bypass": False,
+            "timeout": False,
+            "throttle_stall": False,
+            "error": False,
+        },
+    }
+
+
+class FlightRecorder:
+    """Process-wide bounded ring of completed launch records."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))
+        self._seq = itertools.count(1)
+        # utilization epoch: busy-seconds accumulate from here; reset()
+        # rebases it so occupancy is over the observed window, not
+        # process lifetime
+        self._epoch = time.monotonic()
+        self._busy_s = 0.0          # sum of per-launch (h2d+kernel+d2h)
+        self._device_busy_s = 0.0   # the same, weighted by device count
+        self._queue_wait_s = 0.0    # sum of queue waits (span records)
+        self._span_records = 0      # records that carried spans
+        self._committed = 0         # records committed since reset
+        self._fallbacks = 0         # cumulative, survives ring eviction
+
+    # -- configuration ---------------------------------------------------------
+
+    def configure(self, capacity: int | None = None) -> None:
+        """Apply live config (`ec_tpu_flight_records`): resizing keeps
+        the newest records, like OpTracker.resize_history."""
+        if capacity is None:
+            return
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(1, int(capacity)))
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    # -- producer side ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def active_scope(self, rec: dict | None):
+        """Make `rec` the dispatch-context record: ops/dispatch.py and
+        ops/guard.py annotate it without aggregator plumbing.  None is a
+        no-op scope (callers with nothing to record keep one code path).
+        """
+        if rec is None:
+            yield None
+            return
+        token = _ACTIVE.set(rec)
+        try:
+            yield rec
+        finally:
+            _ACTIVE.reset(token)
+
+    @staticmethod
+    def active() -> dict | None:
+        return _ACTIVE.get()
+
+    def annotate_active(self, **fields) -> None:
+        """Merge scalar fields into the active record (no-op without
+        one).  Flags go through `flag_active`."""
+        rec = _ACTIVE.get()
+        if rec is not None:
+            rec.update(fields)
+
+    def flag_active(self, name: str) -> None:
+        rec = _ACTIVE.get()
+        if rec is not None:
+            rec["flags"][name] = True
+
+    def commit(self, rec: dict) -> dict:
+        """Finalize + append a record.  Derives the spans that follow
+        from the timestamps, accumulates utilization, assigns the seq.
+        Safe from any thread (deque append is atomic; the accumulator
+        fields take the lock)."""
+        now = time.monotonic()
+        if not rec["dispatch_ts"]:
+            rec["dispatch_ts"] = now
+        if not rec["settle_ts"]:
+            rec["settle_ts"] = now
+        rec["queue_wait_s"] = max(0.0, rec["dispatch_ts"] - rec["submit_ts"])
+        rec["seq"] = next(self._seq)
+        busy = rec["h2d_s"] + rec["kernel_s"] + rec["d2h_s"]
+        with self._lock:
+            self._committed += 1
+            if rec["flags"]["fallback"]:
+                self._fallbacks += 1
+            if busy or rec["flags"]["fallback"]:
+                self._busy_s += busy
+                self._device_busy_s += busy * max(1, rec["devices"])
+                self._queue_wait_s += rec["queue_wait_s"]
+                self._span_records += 1
+            # append under the same lock: a concurrent configure()
+            # resize swaps the deque, and an append landing on the
+            # abandoned one would silently drop the record
+            self._ring.append(rec)
+        return rec
+
+    def record_raw(
+        self, kind: str, stripes: int, nbytes: int, devices: int = 1
+    ) -> None:
+        """Lightweight span-less record for a dispatch that ran OUTSIDE
+        an aggregator launch (eager bulk calls, bench loops): the ring
+        still shows when it happened and how big it was."""
+        rec = new_record(kind, group="#raw", stripes=stripes, batch=stripes,
+                         nbytes=nbytes)
+        rec["devices"] = max(1, int(devices))
+        rec["flags"]["sharded"] = devices > 1
+        rec["dispatch_ts"] = rec["submit_ts"]
+        self.commit(rec)
+
+    # -- consumer side ---------------------------------------------------------
+
+    def records(self) -> list[dict]:
+        """Snapshot, oldest first (deque iteration is atomic enough: a
+        concurrent append may or may not be included, never torn)."""
+        return list(self._ring)
+
+    def utilization(self) -> dict[str, float]:
+        """Busy-seconds and occupancy derived from the span-bearing
+        records since the last reset.  `device_busy_seconds` weights
+        each launch's busy span by the devices it spanned; `occupancy`
+        is single-lane busy time over the observation window (a proxy
+        for "was the device queue ever idle"), clamped to [0, 1]."""
+        now = time.monotonic()
+        with self._lock:
+            window = max(1e-9, now - self._epoch)
+            occupancy = min(1.0, self._busy_s / window)
+            mean_wait = (
+                self._queue_wait_s / self._span_records
+                if self._span_records
+                else 0.0
+            )
+            return {
+                "busy_seconds": self._busy_s,
+                "device_busy_seconds": self._device_busy_s,
+                "window_seconds": window,
+                "occupancy": occupancy,
+                "mean_queue_wait_s": mean_wait,
+                "span_records": self._span_records,
+            }
+
+    def summary(self) -> dict:
+        """The compact blob bench.py / tools/chaos.py fold into their
+        JSON: counts, mean queue wait, occupancy."""
+        util = self.utilization()
+        return {
+            "records": len(self._ring),
+            # both cumulative since reset: fallbacks counted at commit,
+            # NOT by scanning the ring (evicted records would undercount
+            # the numerator against the full-run launch denominator)
+            "launches": self._committed,
+            "fallbacks": self._fallbacks,
+            "mean_queue_wait_ms": round(util["mean_queue_wait_s"] * 1e3, 3),
+            "occupancy": round(util["occupancy"], 6),
+            "device_busy_seconds": round(util["device_busy_seconds"], 6),
+        }
+
+    def dump(self) -> dict:
+        """The asok `dump_flight` payload."""
+        return {
+            "capacity": self.capacity,
+            "utilization": self.utilization(),
+            "records": self.records(),
+        }
+
+    def reset(self) -> None:
+        """Drop records and rebase the utilization window (tests; bench
+        stages that want per-stage occupancy)."""
+        with self._lock:
+            self._ring.clear()
+            self._epoch = time.monotonic()
+            self._busy_s = 0.0
+            self._device_busy_s = 0.0
+            self._queue_wait_s = 0.0
+            self._span_records = 0
+            self._committed = 0
+            self._fallbacks = 0
+
+
+_RECORDER: FlightRecorder | None = None
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-wide recorder (lazy, like the device guard and the
+    default aggregators; daemons with a live Config re-size it through
+    their runtime observers)."""
+    global _RECORDER
+    if _RECORDER is None:
+        _RECORDER = FlightRecorder()
+    return _RECORDER

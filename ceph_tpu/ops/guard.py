@@ -113,6 +113,12 @@ class DeviceGuard:
         th.start()
         th.join(t_ms / 1000.0)
         if th.is_alive():
+            # annotate the launch's flight record (ISSUE 8): the deadline
+            # verdict belongs to THIS launch's timeline, not just the
+            # process-wide degraded gauge
+            from ceph_tpu.ops.flight_recorder import flight_recorder
+
+            flight_recorder().flag_active("timeout")
             raise DeviceTimeout(f"device {what} exceeded {t_ms} ms deadline")
         if err:
             raise err[0]

@@ -1096,6 +1096,18 @@ class ECBackend(PGBackend):
 
     # -- recovery (§3.2) -----------------------------------------------------
 
+    def recovery_inflight(self) -> dict[str, int]:
+        """Recovery-pipeline depth for the PG's progress event (ISSUE 8):
+        how many objects are mid-recovery and how many of those are
+        parked on the decode pipeline awaiting an (aggregated) launch
+        reap — the mgr progress module shows these as in-flight work so
+        a stall inside the DECODING stage is distinguishable from an
+        idle PG."""
+        return {
+            "recovering": len(self.recovery_ops),
+            "decoding": len(self._decode_pipe),
+        }
+
     def recover_object(
         self, oid: str, missing_on: set[int], on_complete: Callable[[int], None]
     ) -> None:
@@ -1331,6 +1343,12 @@ class ECBackend(PGBackend):
         rebuilt = rec.shard_data
         rec.state = RECOVERY_WRITING
         rec.trace.event(f"decoded; pushing to shards {sorted(want)}")
+        # progress accounting (ISSUE 8): the reconstructed bytes are the
+        # honest "bytes done" figure — the PG folds them into the
+        # progress event the mgr's progress module renders
+        note = getattr(self.listener, "note_recovery_bytes", None)
+        if note is not None:
+            note(rec.oid, sum(len(v) for v in rebuilt.values()))
         acting = self.listener.acting()
         version = 0
         if OI_ATTR in rec.attrs:

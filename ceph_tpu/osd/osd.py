@@ -238,6 +238,17 @@ class OSD(Dispatcher):
         self.conf.add_observer(
             ["ec_tpu_inflight_max_bytes"], lambda _n, v: _apply_inflight(v)
         )
+        # flight recorder ring capacity (ISSUE 8): runtime-mutable like
+        # the aggregation knobs; resizing keeps the newest records
+        from ..ops.flight_recorder import flight_recorder
+
+        flight_recorder().configure(
+            capacity=self.conf.get("ec_tpu_flight_records")
+        )
+        self.conf.add_observer(
+            ["ec_tpu_flight_records"],
+            lambda _n, v: flight_recorder().configure(capacity=int(v)),
+        )
         # device-launch watchdog (ops/guard.py): per-launch deadline +
         # degraded-mode re-probe cadence, runtime-mutable
         from ..ops.guard import device_guard
@@ -450,10 +461,31 @@ class OSD(Dispatcher):
             "arm/clear fault-injection points + runtime config sets "
             "(args: point, error, hits, one_in, clear, conf)",
         )
+        def _dump_flight(cmd: dict) -> dict:
+            # the launch flight recorder (ops/flight_recorder.py): the
+            # per-launch timeline behind the ec_dispatch counters.
+            # `reset: true` rebases the ring + utilization window so a
+            # bench stage can measure its own occupancy.
+            from ..ops.flight_recorder import flight_recorder
+
+            fr = flight_recorder()
+            if cmd.get("reset"):
+                fr.reset()
+                return {"reset": True}
+            return fr.dump()
+
+        sock.register(
+            "dump_flight",
+            _dump_flight,
+            "per-launch flight records: queue-wait + h2d/kernel/d2h "
+            "sub-spans, device width, fallback/degraded/throttle flags "
+            "(args: reset; export with tools/trace_export.py)",
+        )
         sock.register(
             "dump_historic_ops",
             lambda cmd: self.op_tracker.dump_historic(),
-            "recently completed ops with events + durations (OpTracker)",
+            "recently completed ops with events + per-stage durations "
+            "(OpTracker)",
         )
         sock.register(
             "dump_historic_slow_ops",
@@ -609,6 +641,12 @@ class OSD(Dispatcher):
 
         for name, val in ec_dispatch.perf_dump().items():
             perf[f"ec_dispatch.{name}"] = val
+        # device-utilization accounting under its canonical prometheus
+        # names (ISSUE 8): aliases of the flight-derived scalars the
+        # perf_dump() loop above just computed — one utilization
+        # snapshot per report, two export names
+        perf["ec_device_busy_seconds"] = perf["ec_dispatch.device_busy_seconds"]
+        perf["ec_device_occupancy"] = perf["ec_dispatch.device_occupancy"]
         self._send_addr(
             self.mgr_addr,
             MMgrReport(
@@ -1058,8 +1096,12 @@ def _osd_status(osd: "OSD") -> dict:
     pool_bytes: dict[str, int] = {}
     pool_stored: dict[str, int] = {}
     pool_heads: dict[str, int] = {}
+    progress: dict[str, list] = {}
     slow_count, slow_oldest = osd.op_tracker.slow_ops()
     for pg in osd.pgs.values():
+        events = pg.progress_status()
+        if events:
+            progress[f"{pg.pool.id}.{pg.ps}"] = events
         pid = str(pg.pool.id)
         pool_objects[pid] = pool_objects.get(pid, 0) + pg.local_object_count()
         pool_bytes[pid] = pool_bytes.get(pid, 0) + pg.local_bytes_used()
@@ -1088,6 +1130,11 @@ def _osd_status(osd: "OSD") -> dict:
         # in-flight ops older than osd_op_complaint_time (OpTracker) —
         # aggregated by the mgr into the digest that raises SLOW_OPS
         "slow_ops": {"count": slow_count, "oldest_sec": slow_oldest},
+        # per-PG recovery/backfill/scrub progress events from the
+        # primaries this OSD hosts (PG.progress_status) — the mgr's
+        # progress module turns them into bars with rate + ETA and the
+        # PG_RECOVERY_STALLED health check
+        "progress": progress,
         # device-backend verdict (ops/guard.py): the mgr aggregates this
         # into the digest slice the TPU_BACKEND_DEGRADED health check
         # (mon HEALTH_WARN + mgr prometheus healthcheck gauge) reads

@@ -22,6 +22,7 @@ client ops and drives recovery:
 from __future__ import annotations
 
 import asyncio
+import bisect
 import time
 from collections import OrderedDict
 from typing import TYPE_CHECKING, Callable
@@ -187,6 +188,18 @@ class PG(PGListener):
         # and dirty-clear land, else a racing write could be marked clean
         # and lost on evict (the reference's wait_for_blocked_object).
         self._flushing: dict[str, list] = {}
+        # recovery-progress accounting (ISSUE 8): the high-water total of
+        # missing objects this recovery episode and the done counters —
+        # progress_status() folds them into the OSD status blob the mgr
+        # progress module aggregates.  Reset when the episode completes.
+        self._recovery_total = 0
+        self._recovery_done = 0
+        self._recovery_done_bytes = 0
+        # completion-report repeats remaining: the final done==total
+        # event is re-emitted on a few status reports, because the mgr
+        # samples a last-write-wins status blob and a one-shot report
+        # can be overwritten before the module's next tick sees it
+        self._recovery_final_reports = 0
 
     # -- interval / peering ----------------------------------------------------
 
@@ -204,6 +217,14 @@ class PG(PGListener):
         self._ensure_local_coll()
         self.scrubber.reset()  # an interval change aborts in-flight scrubs
         self._reset_backfill()  # reservations do not survive an interval
+        # recovery-progress episode dies with the interval: a demoted
+        # primary's progress_status goes silent BEFORE its reset branch
+        # can run, and stale done counts would otherwise pre-fill the
+        # bar when this OSD becomes primary again
+        self._recovery_total = 0
+        self._recovery_done = 0
+        self._recovery_done_bytes = 0
+        self._recovery_final_reports = 0
         self.peering.start_peering_interval(epoch, acting)
 
     def tick(self) -> None:
@@ -406,6 +427,14 @@ class PG(PGListener):
         self.peering.mark_recovered(oid, self.osd.whoami)
 
     def on_global_recover(self, oid: str) -> None:
+        # progress accounting gates on the recovery driver's in-flight
+        # set: backfill pushes reuse backend.recover_object (and thus
+        # land here) without ever entering `recovering`, and the
+        # backend + _recover_one's completion BOTH call this hook for a
+        # real recovery — counting on the membership test keeps done at
+        # exactly one per recovered object and zero for backfill
+        if oid in self.recovering:
+            self._recovery_done += 1
         for osd in list(self.peering.peer_missing):
             self.peering.mark_recovered(oid, osd)
         self.peering.mark_recovered(oid, self.osd.whoami)
@@ -1672,6 +1701,119 @@ class PG(PGListener):
             self.on_global_recover(oid)
 
         self.backend.recover_object(oid, missing_on, on_complete)
+
+    def progress_active(self) -> bool:
+        """READ-ONLY: does this PG currently have progress-worthy
+        activity on this primary?  The pure predicate monitoring polls
+        (tools/chaos.py) use instead of progress_status(), whose
+        episode bookkeeping belongs to the OSD's own status reports."""
+        p = self.peering
+        return (
+            p.is_primary()
+            and p.is_active()
+            and bool(
+                p.all_missing_oids()
+                or self.recovering
+                or p.backfill_targets
+                or self.scrubber.active
+            )
+        )
+
+    def note_recovery_bytes(self, oid: str, nbytes: int) -> None:
+        """Backend hook (ECBackend._push_recovered): reconstructed bytes
+        fold into this PG's recovery-progress event.  Gated like the
+        done counter — backfill pushes ride the same backend path but
+        are not recovery."""
+        if oid in self.recovering:
+            self._recovery_done_bytes += int(nbytes)
+
+    def progress_status(self) -> list[dict]:
+        """Progress events for the OSD status blob (ISSUE 8): one entry
+        per active recovery / backfill / scrub on this PRIMARY, each
+        with objects/bytes done vs total.  The mgr's progress module
+        (mgr/progress.py) aggregates these into per-PG bars with rate +
+        ETA and raises PG_RECOVERY_STALLED when one stops advancing.
+
+        Episode bookkeeping note: this renderer maintains the recovery
+        high-water total (a monotone max) and zeroes the counters once
+        an episode drains — both IDEMPOTENT, so extra callers beyond
+        the status heartbeat are safe; they just cannot observe a
+        final done==total event (absence is the completion signal)."""
+        p = self.peering
+        if not p.is_primary() or not p.is_active():
+            return []
+        events: list[dict] = []
+        outstanding = p.all_missing_oids()
+        if outstanding or self.recovering:
+            self._recovery_final_reports = 0  # episode (re)opened
+            # high-water total: newly discovered missing objects grow
+            # the denominator, they never shrink `done`
+            self._recovery_total = max(
+                self._recovery_total, self._recovery_done + len(outstanding)
+            )
+            ev = {
+                "kind": "recovery",
+                "objects_done": self._recovery_done,
+                "objects_total": self._recovery_total,
+                "bytes_done": self._recovery_done_bytes,
+                "bytes_total": 0,  # unknown until rebuilt (best-effort)
+            }
+            inflight = getattr(self.backend, "recovery_inflight", None)
+            if inflight is not None:
+                ev["inflight"] = inflight()
+            events.append(ev)
+        elif (
+            self._recovery_total
+            or self._recovery_done
+            or self._recovery_done_bytes
+        ):
+            # episode complete: emit a final done==total report so the
+            # mgr can classify the event as completed (without it the
+            # event simply vanishes at done<total and counts as
+            # expired/lost).  Repeated on a few reports — the mgr
+            # samples a last-write-wins status blob, so a one-shot
+            # report can be overwritten before a module tick sees it —
+            # then the counters reset so the next episode starts at
+            # zero.  The done counters are checked too: an episode that
+            # starts AND finishes entirely between two status reports
+            # never set _recovery_total here, and its leftover done
+            # count would pre-fill the next episode's bar.
+            if self._recovery_done:
+                if not self._recovery_final_reports:
+                    self._recovery_final_reports = 3
+                events.append({
+                    "kind": "recovery",
+                    "objects_done": self._recovery_done,
+                    # everything still outstanding drained some other
+                    # way (overwrites); the recovered count IS the
+                    # episode's completed total
+                    "objects_total": self._recovery_done,
+                    "bytes_done": self._recovery_done_bytes,
+                    "bytes_total": 0,
+                })
+                self._recovery_final_reports -= 1
+            if not self._recovery_final_reports:
+                self._recovery_total = 0
+                self._recovery_done = 0
+                self._recovery_done_bytes = 0
+        if p.backfill_targets:
+            heads = sorted(self._list_local())
+            total = len(heads) * len(p.backfill_targets)
+            done = 0
+            for osd in p.backfill_targets:
+                cursor = p.last_backfill.get(osd, "")
+                done += bisect.bisect_right(heads, cursor)
+            events.append({
+                "kind": "backfill",
+                "objects_done": done,
+                "objects_total": max(total, done),
+                "bytes_done": 0,
+                "bytes_total": 0,
+            })
+        scrub = self.scrubber.progress()
+        if scrub is not None:
+            events.append(scrub)
+        return events
 
     def blocked_ops_summary(self) -> dict:
         """What's queued and why (OpTracker's dump_blocked_ops view):

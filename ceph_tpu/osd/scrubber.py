@@ -80,6 +80,9 @@ class PgScrubber:
         # scrubbed (write_blocked_by_scrub)
         self.waiting_writes: list[Callable[[], None]] = []
         self.gather_timeout = 10.0  # seconds before an unanswered chunk aborts
+        # progress accounting (ISSUE 8): object total snapshotted at
+        # start() so the mgr progress module can render done/total
+        self._total_objects = 0
 
     # -- lifecycle guards ------------------------------------------------------
 
@@ -207,8 +210,25 @@ class PgScrubber:
         self._on_done = on_done
         self._result = ScrubResult(deep=deep)
         self._cursor = ""
+        self._total_objects = len(self._list_local())
         self._next_chunk()
         return True
+
+    def progress(self) -> dict | None:
+        """Scrub progress event for the OSD status blob (ISSUE 8): the
+        mgr progress module aggregates these into per-PG bars.  None
+        when no scrub is running."""
+        if not self.active or self._result is None:
+            return None
+        return {
+            "kind": "deep-scrub" if self._deep else "scrub",
+            "objects_done": self._result.objects_scrubbed,
+            "objects_total": max(
+                self._total_objects, self._result.objects_scrubbed
+            ),
+            "bytes_done": 0,
+            "bytes_total": 0,
+        }
 
     def _next_chunk(self) -> None:
         """Select the next object range and gather maps (NewChunk state)."""

@@ -101,6 +101,14 @@ async def _run(cfg: dict) -> dict:
         "events": [],
     }
     fallback0 = ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"]
+    # run-start baselines: the dispatch counters and flight recorder are
+    # process-lifetime, and an embedded run (tests/test_chaos_smoke.py in
+    # a shared pytest process) must not report OTHER tests' launches as
+    # chaos metrics
+    decode0 = ec_dispatch.DECODE_LAUNCHES.snapshot()
+    from ceph_tpu.ops.flight_recorder import flight_recorder
+
+    flight_recorder().reset()
 
     monmap = MonMap(addrs=_free_port_addrs(1))
     mons = [Monitor(n, monmap, election_timeout=0.3) for n in monmap.addrs]
@@ -115,8 +123,16 @@ async def _run(cfg: dict) -> dict:
         await o.wait_for_up()
     mgr = Mgr("x", monmap)
     mgr.beacon_interval = 0.1
+    # progress module (ISSUE 8): per-PG recovery bars with rate/ETA ride
+    # the digest; the harness reports how many events it observed so the
+    # flap phase's recovery is visibly tracked end to end
+    from ceph_tpu.mgr.progress import ProgressModule
+
+    progress_mod = ProgressModule()
+    mgr.register_module(progress_mod)
     await mgr.start()
     await mgr.wait_for_active()
+    progress_pgs_seen: set[tuple] = set()
 
     client = Rados(monmap)
     await client.connect()
@@ -212,6 +228,18 @@ async def _run(cfg: dict) -> dict:
 
         # ---- convergence ------------------------------------------------
         def all_clean() -> bool:
+            # PG.progress_active() is the READ-ONLY predicate:
+            # progress_status()'s episode bookkeeping belongs to the
+            # OSD's own status reports, not a monitoring poll.  A SET of
+            # distinct PGs (not a per-poll tally) so the reported count
+            # is a property of the run, not of the poll frequency.
+            progress_pgs_seen.update(
+                (o.whoami, pg.pool.id, pg.ps)
+                for o in osds
+                if o._running
+                for pg in o.pgs.values()
+                if pg.progress_active()
+            )
             return all(
                 pg.is_clean
                 for o in osds
@@ -252,11 +280,36 @@ async def _run(cfg: dict) -> dict:
             _p99_from_histogram(o.perf.dump_histograms().get("op_latency"))
             for o in live
         ]
-        report["p99_op_latency_sec"] = max(p99) if p99 else 0.0
+        # the tracked-metric aliases ROADMAP item 4 promotes into the
+        # bench trajectory (PROGRESS/bench reporting reads these keys):
+        # p99 in milliseconds, and recovery-launch occupancy = mean
+        # stripes per aggregated decode launch (1.0 = no aggregation
+        # benefit, higher = recovery coalesced).  A p99 in the
+        # histogram's +Inf overflow bucket reports as null — json.dumps
+        # would otherwise emit the non-RFC `Infinity` token and poison
+        # every strict consumer of the --out file / bench fold.
+        p99_max = max(p99) if p99 else 0.0
+        if p99_max == float("inf"):
+            report["p99_op_latency_sec"] = None
+            report["chaos_p99_ms"] = None
+        else:
+            report["p99_op_latency_sec"] = p99_max
+            report["chaos_p99_ms"] = round(p99_max * 1e3, 3)
+        dec = ec_dispatch.DECODE_LAUNCHES.snapshot()
+        d_launches = dec["launches"] - decode0["launches"]
+        d_stripes = dec["stripes"] - decode0["stripes"]
+        report["recovery_occupancy"] = round(
+            d_stripes / d_launches, 3
+        ) if d_launches else 0.0
         occ = [
             o.decode_aggregator.perf.get("launches") for o in live
         ]
         report["recovery_decode_launches"] = int(sum(occ))
+        report["progress_events_seen"] = len(progress_pgs_seen)
+        # flight-recorder summary (ISSUE 8): launches, mean queue-wait,
+        # device occupancy over the chaos run (the recorder was reset at
+        # run start, so these are run-relative)
+        report["flight"] = flight_recorder().summary()
         report["fallback_launches"] = (
             ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"] - fallback0
         )
@@ -315,16 +368,32 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--osds", type=int, default=4)
     ap.add_argument("--objects", type=int, default=24)
     ap.add_argument("--pg-num", type=int, default=4)
+    ap.add_argument("--out", default="",
+                    help="also write the report JSON to this file (bench.py "
+                         "folds chaos_p99_ms/recovery_occupancy from it via "
+                         "BENCH_CHAOS_JSON)")
     args = ap.parse_args(argv)
     try:
         report = run_chaos(
             seed=args.seed, smoke=args.smoke, osds=args.osds,
             objects=args.objects, pg_num=args.pg_num,
         )
-    except (TimeoutError, AssertionError) as e:
-        print(json.dumps({"converged": False, "error": str(e)}))
+    except Exception as e:
+        # EVERY failure's payload must reach --out (not just the
+        # convergence errors): a stale success report from a previous
+        # run would otherwise be folded into the NEXT bench line as if
+        # this round had converged
+        payload = json.dumps({"converged": False, "error": repr(e)})
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(payload)
+        print(payload)
         return 1
-    print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    payload = json.dumps(report, indent=2, sort_keys=True, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload)
+    print(payload)
     return 0 if report.get("converged") else 1
 
 

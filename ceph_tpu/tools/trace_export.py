@@ -1,0 +1,238 @@
+"""Flight-record → Chrome trace-event JSON exporter (ISSUE 8).
+
+Turns the launch flight recorder's ring (ops/flight_recorder.py; asok
+``dump_flight``) into a Chrome trace-event file loadable in Perfetto /
+``chrome://tracing``, so an overlap gap is something you LOOK at instead
+of infer:
+
+- one process row ("devices") with a lane (tid) per device width the
+  launches spanned, carrying ``h2d`` / ``kernel`` / ``d2h`` slices per
+  launch plus explicit ``idle`` slices for the gaps between consecutive
+  launches on the lane — the idle slices ARE the optimization target of
+  ROADMAP item 2 (overlap H2D with the previous kernel);
+- one process row ("aggregator") with a lane per aggregator group,
+  carrying a ``queue_wait`` slice (submit→dispatch: time the window
+  held the work) followed by the launch slice, flags in ``args``.
+
+Usage::
+
+    # from a live daemon
+    python -m ceph_tpu.tools.trace_export --asok /path/osd.0.asok -o t.json
+    # from a saved dump_flight payload
+    python -m ceph_tpu.tools.trace_export --dump flight.json -o trace.json
+
+Library surface: ``export_chrome_trace(records)`` returns the trace
+dict; tests validate its contract (``traceEvents`` complete-event keys,
+monotonic non-overlapping same-lane slices, µs timestamps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# lanes below this duration still render (Perfetto drops dur=0); one
+# microsecond is the trace format's resolution anyway
+_MIN_DUR_US = 1
+
+# idle gaps shorter than this are rendering noise, not scheduling
+# signal: two back-to-back launches always have a few µs between the
+# reap of one and the dispatch of the next
+IDLE_MIN_US = 50
+
+
+def _us(t: float) -> int:
+    return int(round(t * 1e6))
+
+
+def _complete(name: str, pid: str, tid: str, ts_us: int, dur_us: int,
+              args: dict | None = None) -> dict:
+    ev = {
+        "name": name,
+        "ph": "X",  # complete event: ts + dur, one object per slice
+        "pid": pid,
+        "tid": tid,
+        "ts": ts_us,
+        "dur": max(_MIN_DUR_US, dur_us),
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _flags_args(rec: dict) -> dict:
+    args = {
+        "seq": rec["seq"],
+        "kind": rec["kind"],
+        "tickets": rec["tickets"],
+        "stripes": rec["stripes"],
+        "batch": rec["batch"],
+        "bytes": rec["bytes"],
+        "devices": rec["devices"],
+        "reason": rec.get("reason", ""),
+    }
+    flags = [k for k, v in rec.get("flags", {}).items() if v]
+    if flags:
+        args["flags"] = ",".join(sorted(flags))
+    return args
+
+
+def export_chrome_trace(records: list[dict]) -> dict:
+    """Chrome trace dict from flight records (oldest first — re-sorted
+    defensively).  Span-less records (raw dispatch-witness entries)
+    render as instant-like 1 µs slices so the timeline still shows
+    them."""
+    events: list[dict] = []
+    # device lanes: sequential per lane, with explicit idle gaps.  Lanes
+    # split by device width: a 1-device launch and an 8-device launch
+    # occupy different hardware, interleaving them on one lane would
+    # fabricate overlap conflicts.
+    by_lane: dict[str, list[dict]] = {}
+    for rec in sorted(records, key=lambda r: r.get("dispatch_ts", 0.0)):
+        lane = (
+            f"device x{rec['devices']}"
+            if not rec["flags"].get("fallback")
+            else "host fallback"
+        )
+        by_lane.setdefault(lane, []).append(rec)
+    for lane, recs in sorted(by_lane.items()):
+        prev_end_us: int | None = None
+        for rec in recs:
+            start = rec["dispatch_ts"] or rec["submit_ts"]
+            start_us = _us(start)
+            if prev_end_us is not None:
+                start_us = max(start_us, prev_end_us)  # never overlap a lane
+                gap = start_us - prev_end_us
+                if gap >= IDLE_MIN_US:
+                    events.append(_complete(
+                        "idle", "devices", lane, prev_end_us, gap,
+                        {"gap_us": gap},
+                    ))
+            cursor = start_us
+            spans = [
+                ("h2d", rec.get("h2d_s", 0.0)),
+                ("kernel", rec.get("kernel_s", 0.0)),
+                ("d2h", rec.get("d2h_s", 0.0)),
+            ]
+            if not any(d > 0 for _n, d in spans):
+                # span-less raw record: one marker slice
+                events.append(_complete(
+                    f"{rec['kind']} launch", "devices", lane, cursor,
+                    _MIN_DUR_US, _flags_args(rec),
+                ))
+                cursor += _MIN_DUR_US
+            else:
+                for name, dur in spans:
+                    dur_us = _us(dur)
+                    if dur_us <= 0:
+                        continue
+                    events.append(_complete(
+                        f"{rec['kind']}:{name}", "devices", lane, cursor,
+                        dur_us, _flags_args(rec),
+                    ))
+                    cursor += max(_MIN_DUR_US, dur_us)
+            prev_end_us = cursor
+    # aggregator-group lanes: queue_wait then the whole launch span, per
+    # group — shows which window held work and for how long
+    by_group: dict[str, list[dict]] = {}
+    for rec in records:
+        by_group.setdefault(rec.get("group") or "#raw", []).append(rec)
+    for group, recs in sorted(by_group.items()):
+        prev_end_us = None
+        for rec in sorted(recs, key=lambda r: r.get("submit_ts", 0.0)):
+            start_us = _us(rec["submit_ts"])
+            if prev_end_us is not None:
+                start_us = max(start_us, prev_end_us)
+            cursor = start_us
+            wait_us = _us(rec.get("queue_wait_s", 0.0))
+            if wait_us > 0:
+                events.append(_complete(
+                    "queue_wait", "aggregator", group, cursor, wait_us,
+                    {"seq": rec["seq"]},
+                ))
+                cursor += max(_MIN_DUR_US, wait_us)
+            settle = rec.get("settle_ts") or rec.get("dispatch_ts") or 0.0
+            launch_us = max(
+                _MIN_DUR_US,
+                _us(settle) - _us(rec.get("dispatch_ts") or rec["submit_ts"]),
+            )
+            events.append(_complete(
+                f"{rec['kind']} launch", "aggregator", group, cursor,
+                launch_us, _flags_args(rec),
+            ))
+            cursor += launch_us
+            prev_end_us = cursor
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "ceph_tpu flight recorder",
+            "records": len(records),
+        },
+    }
+
+
+def validate_chrome_trace(trace: dict) -> None:
+    """The contract tests pin (and Perfetto needs): every event is a
+    complete event with name/ph/pid/tid/ts/dur, ts+dur integers ≥ 0,
+    and no two slices on one (pid, tid) lane overlap."""
+    events = trace["traceEvents"]
+    lanes: dict[tuple, int] = {}
+    for ev in events:
+        for key in ("name", "ph", "pid", "tid", "ts", "dur"):
+            assert key in ev, f"event missing {key}: {ev}"
+        assert ev["ph"] == "X", f"non-complete event {ev}"
+        assert isinstance(ev["ts"], int) and ev["ts"] >= 0, ev
+        assert isinstance(ev["dur"], int) and ev["dur"] >= 1, ev
+    for ev in sorted(events, key=lambda e: (e["pid"], e["tid"], e["ts"])):
+        lane = (ev["pid"], ev["tid"])
+        last_end = lanes.get(lane, -1)
+        assert ev["ts"] >= last_end, (
+            f"overlapping slices on lane {lane}: event at {ev['ts']} "
+            f"starts before previous slice ended at {last_end}"
+        )
+        lanes[lane] = ev["ts"] + ev["dur"]
+
+
+def _load_records(args) -> list[dict]:
+    if args.asok:
+        from ceph_tpu.common.admin_socket import admin_command
+
+        return admin_command(args.asok, "dump_flight")["records"]
+    if args.dump:
+        with open(args.dump) as f:
+            payload = json.load(f)
+        return payload["records"] if isinstance(payload, dict) else payload
+    # default: the in-process recorder (useful from a REPL/bench import)
+    from ceph_tpu.ops.flight_recorder import flight_recorder
+
+    return flight_recorder().records()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--asok", help="daemon admin socket to dump_flight from")
+    src.add_argument("--dump", help="saved dump_flight JSON payload")
+    ap.add_argument("-o", "--out", default="-",
+                    help="output trace file (default stdout)")
+    args = ap.parse_args(argv)
+    trace = export_chrome_trace(_load_records(args))
+    validate_chrome_trace(trace)
+    payload = json.dumps(trace, indent=1)
+    if args.out == "-":
+        print(payload)
+    else:
+        with open(args.out, "w") as f:
+            f.write(payload)
+        print(
+            f"wrote {len(trace['traceEvents'])} events to {args.out} "
+            "(load in Perfetto / chrome://tracing)",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,7 +131,12 @@ class DevCluster:
             await self.mgr.start()
             await self.mgr.wait_for_active()
             # standard module set (vstart.sh enables the same four)
-            from ..mgr import DashboardModule, OrchestratorModule, TelemetryModule
+            from ..mgr import (
+                DashboardModule,
+                OrchestratorModule,
+                ProgressModule,
+                TelemetryModule,
+            )
             from ..mgr.prometheus import PrometheusModule
 
             for module in (
@@ -139,6 +144,9 @@ class DevCluster:
                 DashboardModule(),
                 TelemetryModule(),
                 OrchestratorModule(),
+                # recovery/backfill/scrub bars with rate + ETA in
+                # `status`, PG_RECOVERY_STALLED health (ISSUE 8)
+                ProgressModule(),
             ):
                 self.mgr.register_module(module)
         if self.with_mds:

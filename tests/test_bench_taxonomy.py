@@ -1,0 +1,72 @@
+"""bench.py TPU-child failure taxonomy (ISSUE 8 satellite): the parent
+collapses rc / deadline / watchdog-stage evidence into one of four
+machine-diffable causes, so BENCH_r*.json fallback patterns are
+comparable without parsing free-text error strings."""
+
+import json
+
+import pytest
+
+from bench import _failure_info, _parse_result_lines, classify_tpu_failure
+
+
+class TestClassifier:
+    @pytest.mark.parametrize(
+        "rc,deadline,stage,want",
+        [
+            # the watchdog's import stage overran: axon sitecustomize
+            # blocking in `import jax`
+            (5, False, "import_jax", "import_hang"),
+            # jax.devices() overran its ~45 s sub-deadline (the round
+            # 4-5 shape; the parent retries this exactly once)
+            (6, False, "backend_init", "backend_init_hang"),
+            (6, False, None, "backend_init_hang"),
+            # a later warm-up/measure stage hung
+            (5, False, "warmup_probe", "stage_hang"),
+            (5, False, "decode_warmup", "stage_hang"),
+            (5, False, None, "stage_hang"),
+            # whole-child parent deadline with no stage report
+            (None, True, None, "stage_hang"),
+            # the child FAILED rather than hung
+            (3, False, None, "device_error"),   # no TPU on host
+            (4, False, None, "device_error"),   # parity mismatch
+            (1, False, None, "device_error"),   # crash
+            (0, False, None, "device_error"),   # exited clean, no JSON
+        ],
+    )
+    def test_taxonomy(self, rc, deadline, stage, want):
+        assert classify_tpu_failure(rc, deadline, stage) == want
+
+
+class TestFailureInfo:
+    def test_reads_watchdog_stage_line_from_child_stdout(self):
+        """The child watchdog prints {"failure_stage": ...} before
+        hard-exiting; the parent folds it into the taxonomy record."""
+        stdout = (
+            b"not json\n"
+            + json.dumps({"failure_stage": "backend_init"}).encode()
+            + b"\n"
+        )
+        info = _failure_info("tpu", stdout, 6, False, "tpu child exited rc=6")
+        assert info["cause"] == "backend_init_hang"
+        assert info["stage"] == "backend_init"
+        assert info["rc"] == 6
+        assert "rc=6" in info["detail"]
+
+    def test_no_stage_line_classifies_from_rc(self):
+        info = _failure_info("tpu", b"", 4, False, "tpu child exited rc=4")
+        assert info["cause"] == "device_error"
+        assert "stage" not in info
+
+    def test_parse_result_lines_merges_stage_with_salvage(self):
+        """A salvaged child that printed its headline AND a later
+        watchdog stage line merges both (the parent keeps the result and
+        ignores the stage)."""
+        stdout = (
+            json.dumps({"gbps": 2.0, "platform": "tpu"}).encode() + b"\n"
+            + json.dumps({"failure_stage": "multichip_warmup"}).encode()
+            + b"\n"
+        )
+        merged = _parse_result_lines(stdout)
+        assert merged["gbps"] == 2.0
+        assert merged["failure_stage"] == "multichip_warmup"

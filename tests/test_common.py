@@ -361,3 +361,28 @@ class TestOpTracker:
         assert s["ops"][0]["duration"] >= s["ops"][1]["duration"]
         # finishing an unknown token is a no-op
         t.finish(99999)
+
+    def test_historic_ops_carry_per_stage_durations(self):
+        """ISSUE 8 satellite: dump_historic_ops renders the gap between
+        consecutive event marks as named stage durations, so a slow
+        historic op is attributable without diffing timestamps."""
+        import time as _time
+
+        from ceph_tpu.common.op_tracker import OpTracker
+
+        t = OpTracker(history_size=4)
+        tok = t.create("osd_op(staged)")
+        _time.sleep(0.02)
+        t.mark_event(tok, "queued")
+        _time.sleep(0.01)
+        t.mark_event(tok, "reached_pg")
+        t.finish(tok)
+        op = t.dump_historic()["ops"][0]
+        stages = op["type_data"]["stages"]
+        names = [s["stage"] for s in stages]
+        assert names == ["queued", "reached_pg", "done"]
+        assert stages[0]["duration"] >= 0.015  # initiated -> queued
+        assert stages[1]["duration"] >= 0.005  # queued -> reached_pg
+        assert all(s["duration"] >= 0.0 for s in stages)
+        # stages sum to the op duration (within rounding)
+        assert abs(sum(s["duration"] for s in stages) - op["duration"]) < 0.01

@@ -142,16 +142,41 @@ class TestLintHelper:
             lint_exposition(text)
 
 
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _docs_metric_tokens() -> set[str]:
+    """Backticked `ceph_tpu_*` tokens from docs/OBSERVABILITY.md (labels
+    stripped; a trailing `*` marks a documented prefix family)."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "OBSERVABILITY.md",
+    )
+    with open(path) as f:
+        text = f.read()
+    tokens = set()
+    for m in re.finditer(r"`(ceph_tpu_[A-Za-z0-9_.*]+)(?:\{[^`]*\})?`", text):
+        tokens.add(m.group(1))
+    return tokens
+
+
 class TestClusterScrapeLint:
     def test_scrape_from_toy_cluster_is_wellformed(self):
         """Boot mon+OSDs+mgr, drive a few ops, and lint the full scrape:
         the histogram families (op_latency et al.) must be real Prometheus
-        histograms and every family well-announced."""
+        histograms, every family well-announced, and — the ISSUE 8
+        cross-lint — every `ec_dispatch` perf-dump counter, the canonical
+        device-utilization families, and the progress gauges present in
+        BOTH the scrape and docs/OBSERVABILITY.md, and vice versa."""
 
         async def run():
             from ceph_tpu.client import Rados
-            from ceph_tpu.mgr import Mgr
+            from ceph_tpu.mgr import Mgr, ProgressModule
             from ceph_tpu.mgr.prometheus import PrometheusModule
+            from ceph_tpu.ops import dispatch as ec_dispatch
 
             from test_cluster import start_cluster, stop_cluster, wait_until
 
@@ -162,6 +187,7 @@ class TestClusterScrapeLint:
             await mgr.wait_for_active()
             prom = PrometheusModule()
             mgr.register_module(prom)
+            mgr.register_module(ProgressModule())
 
             client = Rados(monmap)
             await client.connect()
@@ -170,11 +196,33 @@ class TestClusterScrapeLint:
             for i in range(4):
                 await io.write_full(f"o{i}", b"x" * 4096)
 
-            def histograms_reported():
-                return "op_latency" in prom.scrape()
+            # one eager encode so the occupancy distribution has a
+            # bucket (devices_per_launch.<n> keys exist only once a
+            # coding dispatch ran in this process)
+            import numpy as np
+
+            from ceph_tpu.codec import ErasureCodeTpuRs
+
+            ec = ErasureCodeTpuRs()
+            ec.init({"k": "2", "m": "1"})
+            np.asarray(ec.encode_array(
+                np.zeros((1, 2, 512), dtype=np.uint8)
+            ))
+
+            # snapshot the perf-dump key set BEFORE waiting on the
+            # scrape: the OSD reports the same process-wide counters, so
+            # every key here must round-trip through MMgrReport
+            dispatch_keys = set(ec_dispatch.perf_dump())
+
+            def all_reported():
+                text = prom.scrape()
+                return "op_latency" in text and all(
+                    f"ceph_tpu_ec_dispatch_{_sanitize(k)}" in text
+                    for k in dispatch_keys
+                )
 
             await wait_until(
-                histograms_reported, 5.0, "op_latency histogram in scrape"
+                all_reported, 5.0, "op_latency + ec_dispatch in scrape"
             )
             families = lint_exposition(prom.scrape())
 
@@ -186,6 +234,58 @@ class TestClusterScrapeLint:
             op_lat = families["ceph_tpu_op_latency"]["samples"]
             assert any(n == "ceph_tpu_op_latency_count" and v > 0
                        for n, _, v in op_lat)
+
+            docs = _docs_metric_tokens()
+            docs_exact = {t for t in docs if not t.endswith("*")}
+            docs_prefix = {t[:-1] for t in docs if t.endswith("*")}
+
+            def documented(name: str) -> bool:
+                return name in docs_exact or any(
+                    name.startswith(p) for p in docs_prefix
+                )
+
+            # direction 1: every ec_dispatch perf-dump counter reaches
+            # the scrape AND is documented
+            for key in dispatch_keys:
+                fam = f"ceph_tpu_ec_dispatch_{_sanitize(key)}"
+                assert fam in families, f"{fam} missing from scrape"
+                assert documented(fam), (
+                    f"{fam} (perf-dump key {key!r}) not in "
+                    "docs/OBSERVABILITY.md metrics index"
+                )
+            # the canonical utilization names + progress gauges too
+            for fam in (
+                "ceph_tpu_ec_device_busy_seconds",
+                "ceph_tpu_ec_device_occupancy",
+                "ceph_tpu_progress_fraction",
+                "ceph_tpu_progress_rate_objects",
+                "ceph_tpu_progress_eta_seconds",
+                "ceph_tpu_progress_active",
+            ):
+                assert fam in families, f"{fam} missing from scrape"
+                assert documented(fam), f"{fam} not documented"
+
+            # direction 2 (vice versa): every documented metric exists
+            # in the scrape, and every scraped ec_dispatch/progress
+            # family maps back to a perf-dump key / module gauge
+            for token in sorted(docs_exact):
+                assert any(
+                    f == token or f.startswith(token) for f in families
+                ), f"documented {token} absent from scrape"
+            for token in sorted(docs_prefix):
+                assert any(f.startswith(token) for f in families), (
+                    f"documented prefix {token}* matches nothing in scrape"
+                )
+            sanitized_keys = {_sanitize(k) for k in dispatch_keys}
+            for fam in families:
+                if fam.startswith("ceph_tpu_ec_dispatch_"):
+                    key = fam.removeprefix("ceph_tpu_ec_dispatch_")
+                    assert key in sanitized_keys, (
+                        f"scraped {fam} has no ops/dispatch.perf_dump() "
+                        "source — update the exporter or the docs"
+                    )
+                if fam.startswith("ceph_tpu_progress_"):
+                    assert documented(fam), f"scraped {fam} undocumented"
 
             await client.shutdown()
             await mgr.stop()

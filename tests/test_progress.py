@@ -1,0 +1,516 @@
+"""ISSUE 8 recovery-progress pipeline contracts.
+
+Three layers, tested at their seams:
+
+1. OSD side — PG.progress_status() emits recovery/backfill/scrub events
+   (objects/bytes done vs total) on the primary, and completion resets
+   the episode.
+2. Mgr side — ProgressModule aggregates reports into per-PG bars with a
+   smoothed rate + ETA, a cluster-wide aggregate, prometheus gauges,
+   and the PG_RECOVERY_STALLED health check (raise on no-advance past
+   the window, clear on resumed progress or completion).
+3. Mon side — the digest's progress slice renders in `status` and the
+   stalled sub-slice raises the mon-side PG_RECOVERY_STALLED check.
+"""
+
+import time
+
+from ceph_tpu.mgr.progress import ProgressModule
+from ceph_tpu.osd.pg_log import Eversion, Missing
+
+
+class _FakeMgr:
+    def __init__(self):
+        self.statuses: dict[str, dict] = {}
+        self.modules: list = []
+
+    def list_daemons(self):
+        return sorted(self.statuses)
+
+    def get_daemon_status(self, daemon):
+        return self.statuses.get(daemon, {})
+
+    def report(self, daemon, pgid, events):
+        self.statuses[daemon] = {"progress": {pgid: events}}
+
+
+def _recovery_ev(done, total, bytes_done=0):
+    return {
+        "kind": "recovery",
+        "objects_done": done,
+        "objects_total": total,
+        "bytes_done": bytes_done,
+        "bytes_total": 0,
+    }
+
+
+class TestPgProgressEvents:
+    """PG.progress_status over a fake-OSD PG (the test_backfill rig)."""
+
+    def _pg(self, n_objects=6):
+        from test_backfill import _backfilling_pg
+
+        pg, osd = _backfilling_pg(n_objects=n_objects)
+        pg.peering.backfill_targets = set()
+        pg.peering.last_backfill = {}
+        return pg, osd
+
+    def test_recovery_event_counts_missing_and_done(self):
+        pg, _osd = self._pg()
+        pg.peering.peer_missing[1] = m = Missing()
+        m.add("o001", Eversion(1, 1))
+        m.add("o002", Eversion(1, 2))
+        events = pg.progress_status()
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["kind"] == "recovery"
+        assert ev["objects_total"] == 2
+        assert ev["objects_done"] == 0
+        # backend pipeline depth rides along (ECBackend.recovery_inflight
+        # on EC pools; the replicated fake has none — absent is fine)
+        # one object recovers: done advances, total holds.  Counting is
+        # gated on the recovery driver's in-flight set (backfill pushes
+        # share the backend completion hook but must not count)
+        pg.recovering.add("o001")
+        pg.note_recovery_bytes("o001", 4096)
+        pg.on_global_recover("o001")
+        ev = pg.progress_status()[0]
+        assert ev["objects_done"] == 1
+        assert ev["objects_total"] == 2
+        assert ev["bytes_done"] == 4096
+        # newly discovered missing grows the total, never shrinks done
+        m.add("o003", Eversion(1, 3))
+        ev = pg.progress_status()[0]
+        assert ev["objects_total"] == 3
+        assert ev["objects_done"] == 1
+
+    def test_recovery_episode_resets_after_completion(self):
+        pg, _osd = self._pg()
+        pg.peering.peer_missing[1] = m = Missing()
+        m.add("o001", Eversion(1, 1))
+        assert pg.progress_status()
+        pg.recovering.add("o001")
+        pg.on_global_recover("o001")
+        # missing drained: the final done==total report (the mgr's
+        # completed-vs-expired classification needs it) repeats on a
+        # few reports — a one-shot would race the mgr's sampling of the
+        # last-write-wins status blob — then silence
+        for _ in range(3):
+            final = pg.progress_status()
+            assert len(final) == 1
+            assert final[0]["objects_done"] == final[0]["objects_total"] == 1
+        assert pg.progress_status() == []
+        assert pg._recovery_total == 0 and pg._recovery_done == 0
+        # a NEW episode starts from zero
+        pg.peering.peer_missing[1].add("o002", Eversion(1, 2))
+        ev = pg.progress_status()[0]
+        assert (ev["objects_done"], ev["objects_total"]) == (0, 1)
+
+    def test_backfill_event_tracks_cursor(self):
+        pg, _osd = self._pg(n_objects=6)
+        pg.peering.backfill_targets = {1}
+        pg.peering.last_backfill = {1: ""}
+        ev = [e for e in pg.progress_status() if e["kind"] == "backfill"][0]
+        assert ev["objects_total"] == 6
+        assert ev["objects_done"] == 0
+        pg.peering.last_backfill[1] = "o002"  # cursor passed o000..o002
+        ev = [e for e in pg.progress_status() if e["kind"] == "backfill"][0]
+        assert ev["objects_done"] == 3
+
+    def test_scrub_event_reports_chunk_progress(self):
+        pg, _osd = self._pg(n_objects=4)
+        pg.scrubber.active = True
+        pg.scrubber._total_objects = 4
+        from ceph_tpu.osd.scrubber import ScrubResult
+
+        pg.scrubber._result = ScrubResult()
+        pg.scrubber._result.objects_scrubbed = 2
+        ev = [e for e in pg.progress_status() if "scrub" in e["kind"]][0]
+        assert ev["objects_done"] == 2
+        assert ev["objects_total"] == 4
+
+    def test_interval_change_resets_episode_counters(self):
+        """A demoted primary's progress_status goes silent before its
+        completion-reset branch can run; the interval change itself must
+        zero the episode counters or the next primaryship starts with a
+        pre-filled bar."""
+        pg, _osd = self._pg()
+        pg._recovery_total = 12
+        pg._recovery_done = 10
+        pg._recovery_done_bytes = 4096
+        pg.on_new_interval(7, [1, 0])  # acting changed: new interval
+        assert pg._recovery_total == 0
+        assert pg._recovery_done == 0
+        assert pg._recovery_done_bytes == 0
+
+    def test_backfill_pushes_do_not_count_as_recovery(self):
+        """Backfill rides backend.recover_object and its completion hook
+        calls on_global_recover — but it must not pollute the recovery
+        done counters (a later real recovery would render 98% complete
+        before it started)."""
+        pg, _osd = self._pg()
+        for oid in ("o000", "o001", "o002"):
+            pg.on_global_recover(oid)       # backfill-push completions
+            pg.note_recovery_bytes(oid, 4096)
+        assert pg._recovery_done == 0
+        assert pg._recovery_done_bytes == 0
+        pg.peering.peer_missing[1] = m = Missing()
+        m.add("o003", Eversion(1, 4))
+        ev = pg.progress_status()[0]
+        assert (ev["objects_done"], ev["objects_total"]) == (0, 1)
+
+    def test_double_completion_counts_once(self):
+        """The backend AND _recover_one's callback both invoke
+        on_global_recover for one recovered object; done advances by
+        exactly one."""
+        pg, _osd = self._pg()
+        pg.peering.peer_missing[1] = m = Missing()
+        m.add("o001", Eversion(1, 1))
+        pg.progress_status()
+        pg.recovering.add("o001")
+        pg.on_global_recover("o001")  # backend _finish_recovery
+        pg.on_global_recover("o001")  # _recover_one on_complete
+        assert pg._recovery_done == 1
+
+    def test_non_primary_reports_nothing(self):
+        pg, _osd = self._pg()
+        pg.peering.peer_missing[1] = m = Missing()
+        m.add("o001", Eversion(1, 1))
+        pg.peering.primary = 1  # not us
+        assert pg.progress_status() == []
+
+
+class TestProgressModule:
+    def _module(self, stall_sec=10.0):
+        m = ProgressModule(stall_sec=stall_sec)
+        m.mgr = _FakeMgr()
+        return m
+
+    def test_rate_and_eta_math(self):
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.tick()
+        time.sleep(0.1)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(4, 10)])
+        m.tick()
+        ev = m.progress_digest()["events"][0]
+        # ~2 objects over ~0.1s -> ~20 obj/s; 6 remaining -> ~0.3s ETA
+        assert 10 < ev["rate_objects_per_sec"] < 40, ev
+        assert 0.1 < ev["eta_seconds"] < 0.7, ev
+        assert ev["fraction"] == 0.4
+
+    def test_duplicate_same_tick_reports_never_explode_rate(self):
+        """A stale blob from the old primary next to the new primary's
+        fresh one observes the same event twice with dt ~ 0: counts
+        update, but no rate sample is taken (dividing by ~0 would EMA
+        the rate to millions of objects/sec)."""
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.tick()
+        # two daemons carry the same pgid event in one tick
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.mgr.report("osd.1", "1.0", [_recovery_ev(5, 10)])
+        m.tick()
+        ev = m.progress_digest()["events"][0]
+        assert ev["objects_done"] == 5
+        assert ev["rate_objects_per_sec"] < 1000, ev
+
+    def test_stale_regressing_report_does_not_mask_stall(self):
+        """Failover overlap: the old primary's stale blob (lower done,
+        same total) must not lower the baseline — the next fresh report
+        would otherwise register a fake advance and re-arm the stall
+        clock forever."""
+        m = self._module(stall_sec=0.15)
+        m.mgr.report("osd.1", "1.0", [_recovery_ev(50, 100)])
+        m.tick()
+        for _ in range(3):
+            time.sleep(0.08)
+            # stale old-primary blob then fresh (but unadvancing) one
+            m.mgr.statuses["osd.0"] = {
+                "progress": {"1.0": [_recovery_ev(30, 100)]}
+            }
+            m.mgr.statuses["osd.1"] = {
+                "progress": {"1.0": [_recovery_ev(50, 100)]}
+            }
+            m.tick()
+        ev = m.progress_digest()["events"][0]
+        assert ev["objects_done"] == 50  # baseline never regressed
+        assert ev["rate_objects_per_sec"] == 0.0  # no fake samples
+        assert "PG_RECOVERY_STALLED" in m.health_checks
+
+    def test_stale_lower_bytes_does_not_mask_stall(self):
+        """A stale blob with equal done but LOWER bytes must not lower
+        the baseline — the next fresh (unchanged) report would register
+        a fake advance and re-arm the stall clock on every flap."""
+        m = self._module(stall_sec=0.15)
+
+        def ev(bytes_done):
+            e = _recovery_ev(2, 10)
+            e["bytes_done"] = bytes_done
+            return e
+
+        m.mgr.report("osd.1", "1.0", [ev(100)])
+        m.tick()
+        for _ in range(3):
+            time.sleep(0.08)
+            m.mgr.statuses["osd.0"] = {"progress": {"1.0": [ev(50)]}}
+            m.mgr.statuses["osd.1"] = {"progress": {"1.0": [ev(100)]}}
+            m.tick()
+        assert m.progress_digest()["events"][0]["bytes_done"] == 100
+        assert "PG_RECOVERY_STALLED" in m.health_checks
+
+    def test_stalled_prometheus_gauges_match_render(self):
+        """The scrape must agree with render(): a stalled event exports
+        rate 0 and no ETA (not the frozen last EMA)."""
+        m = self._module(stall_sec=0.05)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.tick()
+        time.sleep(0.1)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(4, 10)])
+        m.tick()  # a rate now exists
+        time.sleep(0.1)
+        m.tick()  # ...and the event stalls
+        fams = {name: rows for name, _t, _h, rows in m.prometheus_metrics()}
+        rates = [
+            float(r.rsplit(" ", 1)[1])
+            for r in fams["ceph_tpu_progress_rate_objects"]
+        ]
+        assert rates == [0.0], rates
+        assert fams["ceph_tpu_progress_eta_seconds"] == []
+
+    def test_lower_done_with_new_total_starts_fresh_episode(self):
+        """A genuinely new episode on the same (pgid, kind) key (lower
+        done, different total) rebases instead of being dropped."""
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(5, 5)])
+        m.tick()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(0, 2)])
+        m.tick()
+        ev = m.progress_digest()["events"][0]
+        assert (ev["objects_done"], ev["objects_total"]) == (0, 2)
+
+    def test_persistent_same_total_regression_rebases(self, monkeypatch):
+        """A new episode reusing the previous episode's total must not
+        be frozen forever by the stale-blob guard: once the regression
+        persists past the failover-overlap window, it rebases (else the
+        bar shows the OLD episode complete and a FALSE stall raises)."""
+        from ceph_tpu.mgr import progress as progress_mod
+
+        monkeypatch.setattr(progress_mod, "_REGRESS_WINDOW", 0.05)
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(12, 12)])
+        m.tick()
+        # episode 2, same total, before the old event expired
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(1, 12)])
+        m.tick()  # first regressing report: treated as stale, dropped
+        assert m.progress_digest()["events"][0]["objects_done"] == 12
+        time.sleep(0.08)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 12)])
+        m.tick()  # persisted past the window: rebased as a new episode
+        ev = m.progress_digest()["events"][0]
+        assert (ev["objects_done"], ev["objects_total"]) == (2, 12)
+
+    def test_first_report_has_no_rate(self):
+        """One report = no elapsed baseline: rate 0, ETA None (a
+        fabricated dt~0 rate would render an absurd instant ETA)."""
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(5, 10)])
+        m.tick()
+        ev = m.progress_digest()["events"][0]
+        assert ev["rate_objects_per_sec"] == 0.0
+        assert ev["eta_seconds"] is None
+
+    def test_cluster_aggregate(self):
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(1, 4)])
+        m.mgr.report("osd.1", "1.1", [_recovery_ev(3, 4)])
+        m.tick()
+        cluster = m.progress_digest()["cluster"]
+        assert cluster["objects_done"] == 4
+        assert cluster["objects_total"] == 8
+        assert cluster["fraction"] == 0.5
+
+    def test_stall_raises_and_clears_on_resume(self):
+        m = self._module(stall_sec=0.15)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.tick()
+        assert "PG_RECOVERY_STALLED" not in m.health_checks
+        time.sleep(0.2)
+        m.tick()  # same counts -> no advance past the window
+        assert "PG_RECOVERY_STALLED" in m.health_checks
+        assert "1.0" in m.health_checks["PG_RECOVERY_STALLED"]["summary"]
+        stalled = m.progress_digest()["stalled"]
+        assert stalled["1.0:recovery"]["kind"] == "recovery"
+        assert stalled["1.0:recovery"]["pgid"] == "1.0"
+        # progress resumes: the check clears
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(3, 10)])
+        m.tick()
+        assert "PG_RECOVERY_STALLED" not in m.health_checks
+        assert m.progress_digest()["stalled"] == {}
+
+    def test_stall_clears_on_completion(self):
+        m = self._module(stall_sec=0.1)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.tick()
+        time.sleep(0.15)
+        m.tick()
+        assert "PG_RECOVERY_STALLED" in m.health_checks
+        # the OSD stops reporting the event (reporter went away at
+        # 2/10: dropped mid-flight, so it counts as expired — only a
+        # done >= total disappearance counts as completed)
+        m.mgr.statuses["osd.0"] = {"progress": {}}
+        m.events[("1.0", "recovery")].last_seen -= 10  # past expiry
+        m.tick()
+        assert "PG_RECOVERY_STALLED" not in m.health_checks
+        assert m.progress_digest()["events"] == []
+        assert m.completed == 0
+        assert m.expired == 1
+
+    def test_down_daemon_report_does_not_pin_event(self):
+        """A down OSD's frozen status blob must not keep refreshing its
+        events: the liveness filter (Mgr._daemon_report_live) drops it,
+        the event expires as completed, and no permanent stall sticks."""
+        m = self._module(stall_sec=0.05)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.mgr._daemon_report_live = lambda daemon: daemon != "osd.0"
+        m.tick()  # frozen report filtered: event never tracked
+        assert m.progress_digest()["events"] == []
+        time.sleep(0.1)
+        m.tick()
+        assert "PG_RECOVERY_STALLED" not in m.health_checks
+
+    def test_recovery_and_backfill_stall_report_both(self):
+        """One PG with BOTH a stalled recovery and a stalled backfill:
+        the slice keys by (pgid, kind) so neither hides the other."""
+        m = self._module(stall_sec=0.05)
+        m.mgr.report("osd.0", "1.0", [
+            _recovery_ev(2, 10),
+            {"kind": "backfill", "objects_done": 1, "objects_total": 5,
+             "bytes_done": 0, "bytes_total": 0},
+        ])
+        m.tick()
+        time.sleep(0.1)
+        m.tick()
+        stalled = m.progress_digest()["stalled"]
+        assert set(stalled) == {"1.0:recovery", "1.0:backfill"}
+        assert "2 pg event(s)" in (
+            m.health_checks["PG_RECOVERY_STALLED"]["summary"]
+        )
+
+    def test_stall_window_tracks_mgr_config(self):
+        """mgr_progress_stall_sec is runtime-mutable: an un-pinned
+        module re-reads the mgr's Config every tick."""
+        from ceph_tpu.common.config import Config
+
+        m = ProgressModule()  # no constructor pin
+        m.mgr = _FakeMgr()
+        m.mgr.conf = Config({"name": "mgr.x"}, env=False)
+        m.mgr.conf.set("mgr_progress_stall_sec", 0.07)
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.tick()
+        assert m.stall_sec == 0.07
+        time.sleep(0.12)
+        m.tick()
+        assert "PG_RECOVERY_STALLED" in m.health_checks
+
+    def test_finished_recovery_classifies_completed_not_expired(self):
+        """The PG's final done==total report lets the module tell a
+        finished recovery (completed) from a reporter that died
+        mid-flight (expired)."""
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(9, 10)])
+        m.tick()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(10, 10)])
+        m.tick()
+        m.mgr.statuses["osd.0"] = {"progress": {}}
+        m.events[("1.0", "recovery")].last_seen -= 10
+        m.tick()
+        assert m.completed == 1
+        assert m.expired == 0
+
+    def test_scrub_never_stalls(self):
+        m = self._module(stall_sec=0.05)
+        m.mgr.report("osd.0", "1.0", [{
+            "kind": "scrub", "objects_done": 1, "objects_total": 9,
+            "bytes_done": 0, "bytes_total": 0,
+        }])
+        m.tick()
+        time.sleep(0.1)
+        m.tick()
+        assert "PG_RECOVERY_STALLED" not in m.health_checks
+
+    def test_prometheus_gauges(self):
+        m = self._module()
+        m.mgr.report("osd.0", "1.0", [_recovery_ev(2, 10)])
+        m.tick()
+        fams = {name: (ftype, rows)
+                for name, ftype, _h, rows in m.prometheus_metrics()}
+        assert fams["ceph_tpu_progress_fraction"][0] == "gauge"
+        assert any('pgid="1.0"' in r
+                   for r in fams["ceph_tpu_progress_fraction"][1])
+        assert fams["ceph_tpu_progress_active"][1] == [
+            "ceph_tpu_progress_active 1"
+        ]
+
+
+class TestMonSurfaces:
+    """The mon renders the digest's progress slice in `status` and the
+    stalled sub-slice as PG_RECOVERY_STALLED."""
+
+    def _mon(self):
+        import asyncio
+
+        from ceph_tpu.mon import MonMap, Monitor
+
+        async def build():
+            monmap = MonMap(addrs={"a": "127.0.0.1:0"})
+            return Monitor("a", monmap, election_timeout=0.3)
+
+        return asyncio.new_event_loop().run_until_complete(build())
+
+    def test_status_carries_progress_and_stalled_check(self):
+        mon = self._mon()
+        mon.pg_digest = {
+            "progress": {
+                "events": [{
+                    "pgid": "1.0", "kind": "recovery", "objects_done": 3,
+                    "objects_total": 9, "fraction": 0.3333,
+                    "rate_objects_per_sec": 2.0, "eta_seconds": 3.0,
+                    "stalled": True,
+                }],
+                "cluster": {"objects_done": 3, "objects_total": 9,
+                            "fraction": 0.3333},
+                "stalled": {
+                    "1.0:recovery": {
+                        "pgid": "1.0", "kind": "recovery",
+                        "stalled_for_sec": 75.0,
+                        "objects_done": 3, "objects_total": 9,
+                    },
+                },
+            },
+        }
+        checks, details = mon.health_checks()
+        assert "PG_RECOVERY_STALLED" in checks
+        assert "1.0" in checks["PG_RECOVERY_STALLED"]
+        assert any("3/9 objects" in line
+                   for line in details["PG_RECOVERY_STALLED"])
+        # the status command payload carries the bars
+        handler = mon._mon_command_handler("status")
+        captured = {}
+
+        def reply(rv, rs, outbl):
+            captured.update(rv=rv, outbl=outbl)
+
+        handler({}, reply)
+        import json
+
+        payload = json.loads(captured["outbl"].decode())
+        assert payload["progress"]["events"][0]["pgid"] == "1.0"
+        assert payload["progress"]["events"][0]["eta_seconds"] == 3.0
+        assert "PG_RECOVERY_STALLED" in payload["health"]["checks"]
+
+    def test_clear_digest_raises_nothing(self):
+        mon = self._mon()
+        mon.pg_digest = {"progress": {"events": [], "stalled": {}}}
+        checks, _ = mon.health_checks()
+        assert "PG_RECOVERY_STALLED" not in checks
