@@ -517,6 +517,73 @@ def run_child(platform: str, mc_only: bool = False) -> None:
         decode_err = repr(e)
         clog(f"decode stage failed: {decode_err}")
 
+    # Verify stage (ISSUE 9): the deep-scrub compare-only kernel at the
+    # same RS(8,3) geometry — full (batch, k+m, chunk) codewords in, a
+    # per-stripe mismatch bitmap out.  Bytes first: the probe bitmap is
+    # checked against the pure-numpy host oracle (clean codewords AND a
+    # corrupted shard) before anything is timed.  Throughput counts full
+    # codeword input bytes per second — what a continuous background
+    # integrity sweep actually pushes through the chip.
+    verify_result = None
+    verify_err = ""
+    try:
+        watchdog.stage("verify_probe", PROBE_TIMEOUT_S)
+        clog("verify probe: bitmap vs host oracle")
+        probe_cw = np.concatenate(
+            [probe_in, np.asarray(encode_fn(jnp.asarray(probe_in)))], axis=1
+        )
+        probe_bm = np.asarray(ec.verify_array(probe_cw))
+        if not np.array_equal(probe_bm, ec.verify_array_host(probe_cw)):
+            clog("VERIFY PROBE MISMATCH vs host oracle")
+            sys.exit(4)
+        if probe_bm.any():
+            clog("VERIFY PROBE: clean codeword flagged inconsistent")
+            sys.exit(4)
+        bad_cw = probe_cw.copy()
+        bad_cw[0, 3, 11] ^= 0x5A  # silent single-shard corruption
+        bad_bm = np.asarray(ec.verify_array(bad_cw))
+        if not np.array_equal(bad_bm, ec.verify_array_host(bad_cw)) or not bad_bm[0]:
+            clog("VERIFY PROBE: corrupted shard not flagged")
+            sys.exit(4)
+        clog("verify probe vs host oracle OK")
+
+        # Serial-chain methodology, mirroring the encode/decode loops:
+        # each launch's codeword depends on the previous bitmap, so
+        # runtime caching cannot elide repeated launches.
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def vstep(cw, bm):
+            patch = (cw[:1, :1, :128] ^ bm[0] ^ jnp.uint8(1)).reshape(1, 1, 128)
+            cw2 = jax.lax.dynamic_update_slice(cw, patch, (0, 0, 0))
+            return cw2, ec.verify_array(cw2)
+
+        watchdog.stage("verify_warmup", PROBE_TIMEOUT_S)
+        clog(f"verify warm-up at batch={batch}")
+        v_host = rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8)
+        v_data = jnp.asarray(v_host)
+        cw = jnp.concatenate([v_data, encode_fn(v_data)], axis=1)
+        del v_data
+        bm = jnp.zeros((batch,), jnp.uint8)
+        cw, bm = vstep(cw, bm)  # compile + warm
+        jax.block_until_ready((cw, bm))
+        watchdog.disarm()
+        clog(f"verify measuring: batch={batch} iters={iters}")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            cw, bm = vstep(cw, bm)
+        jax.block_until_ready((cw, bm))
+        _ = np.asarray(bm[:8])
+        v_elapsed = time.perf_counter() - t0
+        v_gbps = batch * (k + m) * chunk * iters / v_elapsed / 1e9
+        del cw, bm
+        clog(f"verify done: {v_gbps:.3f} GB/s at batch={batch}")
+        verify_result = {"gbps": v_gbps, "batch": batch, "bitmap_ok": True}
+    except SystemExit:
+        raise
+    except Exception as e:  # headline survives a failed verify stage
+        watchdog.disarm()
+        verify_err = repr(e)
+        clog(f"verify stage failed: {verify_err}")
+
     result = {
         "platform": got,
         "gbps": gbps,
@@ -538,6 +605,10 @@ def run_child(platform: str, mc_only: bool = False) -> None:
         result["decode"] = decode_result
     elif decode_err:
         result["decode_error"] = decode_err
+    if verify_result is not None:
+        result["verify"] = verify_result
+    elif verify_err:
+        result["verify_error"] = verify_err
     if stages is not None:
         result["stages"] = stages
     if os.environ.get("BENCH_TRACE"):
@@ -870,6 +941,19 @@ def main() -> None:
             out["decode"]["stages"] = d["stages"]
     elif "decode_error" in result:
         out["decode_error"] = result["decode_error"]
+    # verify triplet metric (ISSUE 9): full-codeword GB/s of the
+    # deep-scrub compare-only RS(8,3) kernel — the device-speed ceiling
+    # of continuous background integrity checking
+    if "verify" in result:
+        v = result["verify"]
+        out["verify"] = {
+            "metric": "rs_8_3_verify_GBps_per_chip",
+            "value": round(v["gbps"], 3),
+            "unit": "GB/s",
+            "vs_encode": round(v["gbps"] / gbps, 4) if gbps else 0,
+        }
+    elif "verify_error" in result:
+        out["verify_error"] = result["verify_error"]
     # multichip stage (ISSUE 6): aggregate GB/s of the mesh-sharded
     # launch path, alongside (never replacing) the per-chip metrics
     if "multichip" in result:

@@ -29,7 +29,12 @@ except (ImportError, AttributeError):
 
 from ceph_tpu.gf import expand_matrix, isa_decode_matrix
 from ceph_tpu.ops.dispatch import record_launch
-from ceph_tpu.ops.packed_gf import PACKED_MIN_BYTES, PackedPlan
+from ceph_tpu.ops.packed_gf import (
+    PACKED_MIN_BYTES,
+    PackedPlan,
+    PackedVerifyPlan,
+    packed_verify_host,
+)
 from ceph_tpu.ops.pallas_gf import CodingPlan
 from ceph_tpu.ops.xor_mm import xor_matmul, xor_reduce
 
@@ -170,6 +175,10 @@ class _GlobalPlanCache:
             OrderedDict()
         )
         self._decode_coders: OrderedDict[tuple, _DeviceCoder] = OrderedDict()
+        # verify plans per parity matrix (ISSUE 9): one compiled
+        # compare-only kernel per encode matrix, unbounded like the
+        # encode tables (the matrix population is the same)
+        self._verify_plans: dict[tuple, PackedVerifyPlan] = {}
         # coder lookup hit/miss totals; the perf-smoke tier-1 test asserts
         # a steady-state hit rate so a regression to per-call plan builds
         # fails fast instead of only dilating the bench number
@@ -229,6 +238,20 @@ class _GlobalPlanCache:
             return coder
         with self._lock:
             return self._encode_coders.setdefault(key, coder)
+
+    def verify_coder(self, coding_rows: np.ndarray) -> PackedVerifyPlan:
+        """Cached compare-only verify plan for an encode matrix's parity
+        rows (ISSUE 9 deep-scrub kernel)."""
+        key = (coding_rows.shape, coding_rows.tobytes())
+        with self._lock:
+            plan = self._verify_plans.get(key)
+            if plan is not None:
+                self._hits += 1
+                return plan
+            self._misses += 1
+        plan = PackedVerifyPlan(coding_rows)
+        with self._lock:
+            return self._verify_plans.setdefault(key, plan)
 
     def lru_coder(self, matrix: np.ndarray) -> _DeviceCoder:
         """Coding operator for a decode-time matrix, bounded by the decode
@@ -528,6 +551,10 @@ class LaunchAggregator:
 
     PERF_NAME = "ec_aggregator"
     WHAT = "encode"  # used in error reports
+    # QoS lane every launch of this aggregator dispatches under (ISSUE 9
+    # launch scheduler): client encodes preempt queued background work;
+    # the decode/verify subclasses override with their own lane.
+    SCHED_CLASS = "client"
 
     def __init__(self, window: int = 0, max_bytes: int = 64 << 20,
                  pad_pow2: bool = True, inflight_max_bytes: int | None = None):
@@ -759,13 +786,56 @@ class LaunchAggregator:
                 nbytes=data.nbytes,
                 submit_ts=g.submit_ts,
                 reason=reason,
+                sched_class=self.SCHED_CLASS,
             )
             if g.stalled:
                 rec["flags"]["throttle_stall"] = True
-            t_dispatch = time.monotonic()
+            # QoS arbitration (ISSUE 9): the ready launch enters the
+            # shared device queue tagged with this aggregator's lane and
+            # leaves it in dmClock tag order — a queued client encode
+            # dequeues ahead of a queued background verify.  The
+            # scheduler runs the dispatch under THIS context (captured
+            # at submit), so the active flight record and tracer scope
+            # survive even when another submitter's drain executes it.
+            # Timing anchors live INSIDE the scheduled callable: time
+            # spent queued behind other classes' launches (or spent
+            # cooperatively executing them) is queue wait, not h2d —
+            # banking it as busy would double-count wall clock across
+            # concurrent records and overstate occupancy under exactly
+            # the contention the scheduler creates.
+            from ceph_tpu.ops.launch_scheduler import (
+                CLASS_BY_LANE,
+                launch_scheduler,
+            )
+
+            t_enqueue = time.monotonic()
+            timing: dict[str, float] = {}
+
+            def _dispatch_scheduled():
+                timing["t_dispatch"] = time.monotonic()
+                out = self._guarded_dispatch(g, data, donate)
+                timing["t_done"] = time.monotonic()
+                return out
+
+            from ceph_tpu.ops.guard import device_guard
+
             try:
                 with fr.active_scope(rec):
-                    parity = self._guarded_dispatch(g, data, donate)
+                    if device_guard().degraded:
+                        # DEGRADED bypass: this launch re-runs on the
+                        # host oracle (or at most a rate-limited compile
+                        # probe), so there is no device to arbitrate —
+                        # routing it through the device turn would
+                        # serialize every lane's numpy recompute behind
+                        # one lock, head-of-line-blocking client encodes
+                        # exactly when the backend is already hurting
+                        parity = _dispatch_scheduled()
+                    else:
+                        parity = launch_scheduler().submit(
+                            CLASS_BY_LANE[self.SCHED_CLASS],
+                            _dispatch_scheduled,
+                            cost=data.nbytes,
+                        )
             except BaseException as e:
                 # sticky: every co-rider's reap reports the launch failure
                 # instead of crashing on a half-torn group.  The group
@@ -775,26 +845,31 @@ class LaunchAggregator:
                 # launch that RAISED (deadline wait, device error with a
                 # failed host recompute, bad geometry) produced nothing
                 # — none of its elapsed time banks as busy
-                rec["dispatch_ts"] = t_dispatch
+                rec["dispatch_ts"] = timing.get("t_dispatch", t_enqueue)
                 g.error = e
                 g.pad = pad
                 with self._lock:
                     self._live.append(g)
                 raise
-            # dispatch_ts anchors where the launch LEFT the window
-            # (queue-wait ends here); h2d_s is the synchronous slice of
+            # dispatch_ts anchors where the launch LEFT the queue and
+            # actually began dispatching (queue-wait — window AND
+            # scheduler — ends here); h2d_s is the synchronous slice of
             # the dispatch — H2D staging + launch enqueue (JAX dispatch
             # is async, kernel time shows up at settle).  A fallback
             # launch gets h2d_s = 0: its host compute is already banked
             # in kernel_s, and the remainder of the elapsed time is the
             # watchdog DEADLINE wait on a wedged device — dead time that
             # must not inflate device_busy_seconds/occupancy.
+            t_dispatch = timing.get("t_dispatch", t_enqueue)
             rec["dispatch_ts"] = t_dispatch
             if rec["flags"]["fallback"]:
                 rec["h2d_s"] = 0.0
             else:
                 rec["h2d_s"] = max(
-                    0.0, time.monotonic() - t_dispatch - rec["kernel_s"]
+                    0.0,
+                    timing.get("t_done", t_dispatch)
+                    - t_dispatch
+                    - rec["kernel_s"],
                 )
             g.arrays = []
             g.pad = pad
@@ -1048,6 +1123,7 @@ class DecodeAggregator(LaunchAggregator):
 
     PERF_NAME = "ec_decode_aggregator"
     WHAT = "decode"
+    SCHED_CLASS = "recovery"
 
     def submit(
         self, ec: "MatrixCodecMixin", erasures: list[int], survivors: np.ndarray
@@ -1074,6 +1150,48 @@ class DecodeAggregator(LaunchAggregator):
     def _donate_ok(self, g: _AggGroup, data_shape) -> bool:
         check = getattr(g.ec, "decode_donatable", None)
         return bool(check(list(g.ctx), data_shape)) if check is not None else False
+
+
+class VerifyAggregator(LaunchAggregator):
+    """Cross-object VERIFY launch aggregation (ISSUE 9): deep-scrub
+    parity recomputes from one (matrix, chunk-length) geometry coalesce
+    into one compare-only device launch (knobs
+    `ec_tpu_verify_aggregate_window` / `ec_tpu_verify_aggregate_max_bytes`).
+
+    Submissions are (stripes, k+m, L) full-codeword batches — data rows
+    in encode order followed by the stored parity rows — and tickets
+    resolve to a (stripes,) uint8 per-stripe mismatch bitmap (bit j set
+    = parity row j inconsistent).  Padding stripes are all-zero
+    codewords, whose recomputed parity is zero = their stored parity,
+    so a padded launch's bitmap is exact.  Launches dispatch under the
+    `background` QoS lane: a scrub chunk's verify never preempts a
+    queued client encode, and the host-oracle fallback keeps scrub
+    byte-identical while the backend is DEGRADED."""
+
+    PERF_NAME = "ec_verify_aggregator"
+    WHAT = "verify"
+    SCHED_CLASS = "background"
+
+    def submit(self, ec: "MatrixCodecMixin", codewords: np.ndarray) -> AggTicket:
+        """Queue one (stripes, k+m, L) uint8 codeword batch; the ticket
+        resolves to its (stripes,) mismatch bitmap."""
+        return self._submit(
+            (ec.distribution_matrix().tobytes(), "#verify",
+             codewords.shape[-1]),
+            ec, None, codewords,
+        )
+
+    def _dispatch(self, g: _AggGroup, data: np.ndarray, donate):
+        return g.ec.verify_array(data)
+
+    def _dispatch_host(self, g: _AggGroup, data: np.ndarray) -> np.ndarray:
+        return g.ec.verify_array_host(data)
+
+    def _out_shape(self, g: _AggGroup, data_shape) -> tuple:
+        return (data_shape[0],)
+
+    def _donate_ok(self, g: _AggGroup, data_shape) -> bool:
+        return False  # the bitmap output is tiny; pooling buys nothing
 
 
 _DEFAULT_AGGREGATOR: EncodeAggregator | None = None
@@ -1111,6 +1229,27 @@ def default_decode_aggregator() -> DecodeAggregator:
             max_bytes=int(OPTIONS["ec_tpu_decode_aggregate_max_bytes"].default),
         )
     return _DEFAULT_DECODE_AGGREGATOR
+
+
+_DEFAULT_VERIFY_AGGREGATOR: VerifyAggregator | None = None
+
+
+def default_verify_aggregator() -> VerifyAggregator:
+    """Process-wide verify aggregator shared by every scrubber on one
+    OSD, so concurrent deep scrubs of different PGs coalesce their
+    parity recomputes into shared compare-only launches.  The default
+    window is open (unlike encode/decode): scrub is a throughput
+    workload with no commit barrier, so batching is pure win — the
+    scrubber's per-chunk reap is the flush."""
+    global _DEFAULT_VERIFY_AGGREGATOR
+    if _DEFAULT_VERIFY_AGGREGATOR is None:
+        from ceph_tpu.common.options import OPTIONS
+
+        _DEFAULT_VERIFY_AGGREGATOR = VerifyAggregator(
+            window=int(OPTIONS["ec_tpu_verify_aggregate_window"].default),
+            max_bytes=int(OPTIONS["ec_tpu_verify_aggregate_max_bytes"].default),
+        )
+    return _DEFAULT_VERIFY_AGGREGATOR
 
 
 class EncodePipeline:
@@ -1270,6 +1409,26 @@ class MatrixCodecMixin:
             self.distribution_matrix(), list(erasures), self.k
         )
         return _coder_donatable(coder, data_shape)
+
+    def verify_array(self, codewords) -> jnp.ndarray:
+        """(..., k+m, L) uint8 full codewords (data rows in encode order,
+        then the stored parity rows) -> (...,) uint8 per-stripe mismatch
+        bitmap, bit j set iff stored parity row j differs from the
+        recompute.  The deep-scrub compare-only path (ISSUE 9): one
+        fused kernel per matrix, batch-shaped exactly like encode_array
+        so scrub rides the same aggregation machinery."""
+        mat = self.distribution_matrix()
+        return PLAN_CACHE.verify_coder(mat[self.k :])(jnp.asarray(codewords))
+
+    def verify_array_host(self, codewords) -> np.ndarray:
+        """Byte-identical HOST oracle of verify_array (pure numpy end to
+        end): the DEGRADED-mode fallback the VerifyAggregator re-runs
+        scrub verifies on — same bit-matrix parity recompute, same
+        bitmap packing, and it can never hang on a wedged runtime."""
+        mat = self.distribution_matrix()
+        return packed_verify_host(
+            mat[self.k :], np.asarray(codewords, dtype=np.uint8)
+        )
 
     def encode_array_host(self, data) -> np.ndarray:
         """Byte-identical HOST oracle of encode_array: pure numpy end to

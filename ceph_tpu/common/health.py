@@ -84,6 +84,85 @@ def recovery_stalled_detail(stalled: dict[str, dict]) -> list[str]:
     ]
 
 
+def scrub_errors_total(scrub: dict[str, dict]) -> int:
+    """Total scrub errors across a per-PG slice ({pgid: {errors,
+    inconsistent, ...}})."""
+    return sum(int(v.get("errors", 0)) for v in scrub.values())
+
+
+def osd_scrub_errors_summary(scrub: dict[str, dict]) -> str | None:
+    """The OSD_SCRUB_ERRORS check summary for a per-PG scrub-error
+    slice, or None when every last scrub was clean.  Wording follows
+    the reference's `N scrub errors`."""
+    total = scrub_errors_total(scrub)
+    if not total:
+        return None
+    return f"{total} scrub errors"
+
+
+def pg_damaged_summary(scrub: dict[str, dict]) -> str | None:
+    """The PG_DAMAGED check summary (`Possible data damage: N pgs
+    inconsistent`), or None when no PG holds inconsistencies."""
+    if not scrub:
+        return None
+    return (
+        f"Possible data damage: {len(scrub)} pg(s) inconsistent: "
+        f"[{','.join(sorted(scrub))}]"
+    )
+
+
+def pg_damaged_detail(scrub: dict[str, dict]) -> list[str]:
+    """Per-PG breakdown lines (`health detail`): which objects, which
+    shards, why — the slice `osd/scrubber.py` recorded at compare time."""
+    lines: list[str] = []
+    for pgid, v in sorted(scrub.items()):
+        kind = "deep-scrub" if v.get("deep") else "scrub"
+        lines.append(
+            f"pg {pgid} is inconsistent: {v.get('errors', 0)} {kind} errors"
+        )
+        for oid, bad in sorted((v.get("inconsistent") or {}).items()):
+            for osd, why in sorted(bad.items()):
+                lines.append(f"pg {pgid} {oid}: osd.{osd} {why}")
+    return lines
+
+
+# Checks whose presence escalates overall cluster health to HEALTH_ERR
+# (possible data damage): everything else raised is a HEALTH_WARN.
+# This set is the SINGLE severity source — the mon's overall status and
+# the mgr's per-check severity field both derive from it (plus any
+# explicit severity a mgr module attaches), so the two surfaces cannot
+# drift.
+ERR_CHECKS = frozenset({"OSD_SCRUB_ERRORS", "PG_DAMAGED"})
+
+
+def check_severity(code: str) -> str:
+    """Severity for a check code: the mgr's health_checks() entries and
+    overall_status() both call this, keeping the escalation rule in one
+    place."""
+    return "HEALTH_ERR" if code in ERR_CHECKS else "HEALTH_WARN"
+
+
+def overall_status(checks) -> str:
+    """Overall health string from the raised checks: HEALTH_ERR when
+    any damage-class check is up, HEALTH_WARN for anything else,
+    HEALTH_OK when clear.  Accepts either the mon shape (code ->
+    summary string) or the mgr shape (code -> {severity, summary});
+    an explicit severity field wins over the code-derived default, so
+    a module-raised HEALTH_ERR check escalates on both surfaces."""
+    worst = "HEALTH_OK"
+    for code, info in (
+        checks.items() if hasattr(checks, "items")
+        else ((c, None) for c in checks)
+    ):
+        sev = (
+            info.get("severity") if isinstance(info, dict) else None
+        ) or check_severity(code)
+        if sev == "HEALTH_ERR":
+            return "HEALTH_ERR"
+        worst = "HEALTH_WARN"
+    return worst
+
+
 def down_in_osds(osdmap) -> list:
     """OSDs that are IN but not up — the OSD_DOWN population.  A
     decommissioned (out) osd being down is healthy by design, as in the

@@ -155,6 +155,78 @@ OPTIONS: dict[str, Option] = _opts(
         runtime=True,
     ),
     Option(
+        "ec_tpu_verify_aggregate_window",
+        int,
+        64,
+        A,
+        "EC verify launch aggregation window: deep-scrub parity "
+        "recompute submissions of one (matrix, chunk-size) geometry held "
+        "before a coalesced compare-only device launch "
+        "(codec/matrix_codec.py VerifyAggregator).  <= 1 launches every "
+        "submission immediately.  Scrub has no commit barrier, so the "
+        "window is open by default — the scrubber's per-chunk bitmap "
+        "reap is the flush",
+        see_also=("ec_tpu_verify_aggregate_max_bytes",
+                  "ec_tpu_aggregate_window"),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_verify_aggregate_max_bytes",
+        int,
+        64 << 20,
+        A,
+        "codeword-byte budget per verify aggregation group: a group "
+        "launches as soon as its queued scrub bytes reach this, whatever "
+        "the window (bounds device memory held by deferred verifies)",
+        see_also=("ec_tpu_verify_aggregate_window",),
+        runtime=True,
+    ),
+    # --- EC launch scheduler QoS (ISSUE 9; ops/launch_scheduler.py) ---------
+    # dmClock (reservation, weight, limit) per launch lane, in nominal
+    # 4 KiB items/sec (a launch of N bytes costs N/4096 items).  The
+    # scheduler is work-conserving: limits deprioritize, never idle the
+    # device.  0 = unset (no reservation / unlimited).
+    Option("ec_tpu_sched_client_res", float, 25600.0, A,
+           "launch-scheduler reservation for the client lane (encode "
+           "launches), in nominal 4 KiB items/sec: matured reservations "
+           "dequeue before any weight-phase launch.  A launch of N bytes "
+           "consumes N/4096 items, so the rate must be launch-scaled to "
+           "matter — the default 25600 guarantees ~100 MiB/s of client "
+           "launch bandwidth (a 64 MiB launch advances the reservation "
+           "tag 0.64 s); a per-op-scale value like 1.0 would push the "
+           "tag hours into the future on the first aggregated launch "
+           "and never mature again", runtime=True),
+    Option("ec_tpu_sched_client_wgt", float, 2.0, A,
+           "launch-scheduler weight for the client lane", runtime=True),
+    Option("ec_tpu_sched_client_lim", float, 0.0, A,
+           "launch-scheduler limit for the client lane (0 = unlimited)",
+           runtime=True),
+    Option("ec_tpu_sched_recovery_res", float, 0.0, A,
+           "launch-scheduler reservation for the recovery lane (decode "
+           "launches), in nominal 4 KiB items/sec (launch-scaled, see "
+           "ec_tpu_sched_client_res); 0 = no reservation", runtime=True),
+    Option("ec_tpu_sched_recovery_wgt", float, 1.0, A,
+           "launch-scheduler weight for the recovery lane", runtime=True),
+    Option("ec_tpu_sched_recovery_lim", float, 0.0, A,
+           "launch-scheduler limit for the recovery lane (0 = unlimited)",
+           runtime=True),
+    Option("ec_tpu_sched_background_res", float, 0.0, A,
+           "launch-scheduler reservation for the background lane "
+           "(deep-scrub verify, best-effort work), in nominal 4 KiB "
+           "items/sec (launch-scaled, see ec_tpu_sched_client_res); "
+           "0 = no reservation", runtime=True),
+    Option("ec_tpu_sched_background_wgt", float, 0.5, A,
+           "launch-scheduler weight for the background lane: under "
+           "contention a queued client encode dequeues ahead of a "
+           "queued scrub verify; when the queue is otherwise idle the "
+           "background lane drains at full device speed "
+           "(work-conserving)", runtime=True),
+    Option("ec_tpu_sched_background_lim", float, 0.0, A,
+           "launch-scheduler limit for the background lane (0 = "
+           "unlimited; a nonzero value deprioritizes scrub launches "
+           "past the rate without ever idling the device)",
+           runtime=True),
+    Option(
         "ec_tpu_launch_timeout_ms",
         int,
         20000,

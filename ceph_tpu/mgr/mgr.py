@@ -159,6 +159,10 @@ class Mgr(Dispatcher):
             # the host fallback); the mon-side TPU_BACKEND_DEGRADED
             # check reads this slice
             "tpu_degraded": self.tpu_degraded_by_daemon(),
+            # per-PG scrub inconsistencies from the primaries' status
+            # blobs; the mon-side OSD_SCRUB_ERRORS / PG_DAMAGED
+            # HEALTH_ERR checks read this slice
+            "scrub_errors": self.scrub_errors_by_pg(),
             # per-PG recovery/backfill/scrub bars with rate + ETA from
             # the progress module (ISSUE 8); `ceph_cli status` renders
             # them and the mon's PG_RECOVERY_STALLED check reads the
@@ -192,6 +196,19 @@ class Mgr(Dispatcher):
                 "reason": str(backend.get("reason", "")),
                 "fallback_launches": int(backend.get("fallback_launches", 0)),
             }
+        return out
+
+    def scrub_errors_by_pg(self) -> dict[str, dict]:
+        """Per-PG scrub inconsistencies reported by the primaries (the
+        OSD status blobs' scrub_errors slice).  Stale reports from down
+        daemons drop like the other health slices; a PG whose primary
+        moved clears when the new primary's clean report arrives."""
+        out: dict[str, dict] = {}
+        for daemon, st in self.daemons.items():
+            if not self._daemon_report_live(daemon):
+                continue
+            for pgid, rec in ((st.status or {}).get("scrub_errors") or {}).items():
+                out[pgid] = rec
         return out
 
     def _daemon_report_live(self, daemon: str) -> bool:
@@ -250,6 +267,21 @@ class Mgr(Dispatcher):
             checks["TPU_BACKEND_DEGRADED"] = {
                 "severity": "HEALTH_WARN",
                 "summary": degraded,
+            }
+        scrub = self.scrub_errors_by_pg()
+        summary = health.osd_scrub_errors_summary(scrub)
+        if summary:
+            # data damage is an ERR, not a WARN, in the reference too:
+            # an inconsistent PG is serving reads off shards that
+            # disagree.  Severity derives from health.ERR_CHECKS so the
+            # mon's overall_status and this gauge stay in lockstep.
+            checks["OSD_SCRUB_ERRORS"] = {
+                "severity": health.check_severity("OSD_SCRUB_ERRORS"),
+                "summary": summary,
+            }
+            checks["PG_DAMAGED"] = {
+                "severity": health.check_severity("PG_DAMAGED"),
+                "summary": health.pg_damaged_summary(scrub),
             }
         for module in self.modules:
             checks.update(getattr(module, "health_checks", {}) or {})

@@ -30,6 +30,8 @@ def _perf_type(counter: str) -> str:
         or "busy_seconds" in name
         or "flight_records" in name
         or name == "backend_degraded"
+        # launch-scheduler queue depth rises and falls with the queue
+        or name == "queue_depth"
     ):
         return "gauge"
     return "counter"

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import json
 
+from ..common.health import overall_status as health_status
 from ..common.log import dout
 from ..msg.messages import (
     MLog,
@@ -484,6 +485,17 @@ class Monitor(Dispatcher):
             details["PG_RECOVERY_STALLED"] = health.recovery_stalled_detail(
                 stalled
             )
+        # scrub inconsistencies (ISSUE 9 satellite): the per-PG slice
+        # the primaries reported through the mgr digest.  These are the
+        # two HEALTH_ERR checks — shards disagree on user data — and
+        # they clear when repair + the confirming scrub come back clean
+        scrub = self.pg_digest.get("scrub_errors") or {}
+        summary = health.osd_scrub_errors_summary(scrub)
+        if summary:
+            checks["OSD_SCRUB_ERRORS"] = summary
+            checks["PG_DAMAGED"] = health.pg_damaged_summary(scrub)
+            details["PG_DAMAGED"] = health.pg_damaged_detail(scrub)
+            details["OSD_SCRUB_ERRORS"] = details["PG_DAMAGED"]
         return checks, details
 
     def _mon_command_handler(self, prefix: str):
@@ -508,7 +520,7 @@ class Monitor(Dispatcher):
                 # adds the per-daemon breakdown lines
                 checks, details = self.health_checks()
                 payload = {
-                    "status": "HEALTH_WARN" if checks else "HEALTH_OK",
+                    "status": health_status(checks),
                     "checks": checks,
                 }
                 if cmd.get("detail"):
@@ -529,9 +541,7 @@ class Monitor(Dispatcher):
                     json.dumps(
                         {
                             "health": {
-                                "status": (
-                                    "HEALTH_WARN" if checks else "HEALTH_OK"
-                                ),
+                                "status": health_status(checks),
                                 "checks": checks,
                             },
                             "quorum": sorted(self.quorum),

@@ -76,6 +76,14 @@ DECODE_LAUNCHES = LaunchCounter()
 # consistency contract tests/test_perf_smoke.py pins.
 SHARDED_LAUNCHES = LaunchCounter()
 
+# Verify-only dispatches (ISSUE 9: the deep-scrub compare-only kernel,
+# ops/packed_gf.PackedVerifyPlan).  Counted here AND in LAUNCHES, like
+# the decode counter: LAUNCHES stays the process-wide total, while this
+# isolates the integrity-check traffic so "a whole scrub chunk verified
+# in one launch" is assertable on its own (the acceptance criterion's
+# VERIFY_LAUNCHES > 0 witness).
+VERIFY_LAUNCHES = LaunchCounter()
+
 
 class DeviceOccupancy:
     """Devices-per-launch distribution: how wide each coding dispatch
@@ -123,12 +131,15 @@ def record_fallback(stripes: int, nbytes: int) -> None:
 
 
 def record_launch(
-    stripes: int, nbytes: int, decode: bool = False, devices: int = 1
+    stripes: int, nbytes: int, decode: bool = False, devices: int = 1,
+    verify: bool = False,
 ) -> None:
     """Record one device dispatch carrying `stripes` stripes / `nbytes`
     input bytes on the global counter(s).  `decode=True` marks a dispatch
     issued on behalf of a decode (the coder's kind, threaded down from
-    PLAN_CACHE.decode_coder) so it also lands on DECODE_LAUNCHES.
+    PLAN_CACHE.decode_coder) so it also lands on DECODE_LAUNCHES;
+    `verify=True` marks a compare-only scrub dispatch
+    (PLAN_CACHE.verify_coder) landing on VERIFY_LAUNCHES the same way.
     `devices` is how many mesh devices the dispatch spanned (the sharded
     dispatcher passes its stripe-shard count); > 1 additionally lands on
     SHARDED_LAUNCHES and every value feeds the occupancy distribution.
@@ -141,6 +152,8 @@ def record_launch(
     LAUNCHES.record(stripes, nbytes)
     if decode:
         DECODE_LAUNCHES.record(stripes, nbytes)
+    if verify:
+        VERIFY_LAUNCHES.record(stripes, nbytes)
     if devices > 1:
         SHARDED_LAUNCHES.record(stripes, nbytes)
     DEVICES_PER_LAUNCH.record(devices)
@@ -148,6 +161,7 @@ def record_launch(
 
     fr = flight_recorder()
     rec = fr.active()
+    kind = "verify" if verify else ("decode" if decode else "encode")
     if rec is not None:
         # skip records that already settled: an abandoned watchdog
         # worker whose device unwedges minutes later still holds this
@@ -156,12 +170,10 @@ def record_launch(
         if not rec["settle_ts"]:
             rec["devices"] = max(rec["devices"], int(devices))
             rec["flags"]["sharded"] = rec["flags"]["sharded"] or devices > 1
-            if decode:
-                rec["kind"] = "decode"
+            if decode or verify:
+                rec["kind"] = kind
     else:
-        fr.record_raw(
-            "decode" if decode else "encode", stripes, nbytes, devices
-        )
+        fr.record_raw(kind, stripes, nbytes, devices)
 
 
 def perf_dump() -> dict[str, object]:
@@ -174,6 +186,7 @@ def perf_dump() -> dict[str, object]:
     for prefix, counter in (
         ("", LAUNCHES),
         ("decode_", DECODE_LAUNCHES),
+        ("verify_", VERIFY_LAUNCHES),
         ("sharded_", SHARDED_LAUNCHES),
         ("fallback_", FALLBACK_LAUNCHES),
     ):
@@ -206,4 +219,12 @@ def perf_dump() -> dict[str, object]:
     out["flight_mean_queue_wait_ms"] = round(
         util["mean_queue_wait_s"] * 1e3, 3
     )
+    # launch-scheduler QoS counters (ISSUE 9): per-class enqueue/dequeue
+    # totals, accumulated queue wait, and the current queue-depth gauge,
+    # as `sched.<class>.<counter>` scalars — the prometheus scrape
+    # renders one labeled-by-dot series per class/counter pair
+    from .launch_scheduler import launch_scheduler
+
+    for name, val in launch_scheduler().perf_dump().items():
+        out[f"sched.{name}"] = val
     return out

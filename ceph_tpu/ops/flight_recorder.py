@@ -78,14 +78,19 @@ def new_record(
     nbytes: int = 0,
     submit_ts: float | None = None,
     reason: str = "",
+    sched_class: str = "",
 ) -> dict:
     """A fresh (uncommitted) flight record.  ``submit_ts`` is the FIRST
-    submission into the launch's window (queue-wait anchors here)."""
+    submission into the launch's window (queue-wait anchors here);
+    ``sched_class`` is the launch scheduler's QoS lane (client /
+    recovery / background, ISSUE 9) — empty for dispatches that never
+    passed through the scheduler (raw bench/bulk paths)."""
     now = time.monotonic()
     return {
         "seq": 0,  # assigned at commit
         "kind": kind,
         "group": group,
+        "sched_class": sched_class,
         "tickets": int(tickets),
         "stripes": int(stripes),
         "batch": int(batch),

@@ -130,6 +130,82 @@ def _packed_code_into(out: jax.Array, data: jax.Array, *, sched, k: int, m: int)
     return _packed_code_impl(data, sched, k, m)
 
 
+def _packed_verify_impl(codeword: jax.Array, sched, k: int, m: int) -> jax.Array:
+    """(..., k+m, L) uint8 codeword -> (...,) uint8 per-stripe mismatch
+    bitmap: bit j set iff recomputed parity row j differs from the
+    stored row j anywhere in the chunk.  The recompute is the SAME
+    packed-plane schedule the encode kernel runs — an exact refactoring
+    of the GF(2) linear map — so a zero bitmap is a proof the stored
+    parity matches the encode kernel (and the host oracle) bit for bit."""
+    data = codeword[..., :k, :]
+    stored = codeword[..., k:, :]
+    recomputed = _packed_code_impl(data, sched, k, m)
+    # per-(stripe, parity-row) mismatch -> packed per-stripe bitmap.
+    # m <= 8 for every registered geometry (the uint8 bitmap bound is
+    # asserted host-side in PackedVerifyPlan.__init__).
+    row_bad = jnp.any(recomputed ^ stored, axis=-1)  # (..., m) bool
+    weights = (jnp.uint8(1) << jnp.arange(m, dtype=jnp.uint8))
+    return jnp.sum(row_bad.astype(jnp.uint8) * weights, axis=-1).astype(
+        jnp.uint8
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("sched", "k", "m"))
+def _packed_verify(codeword: jax.Array, *, sched, k: int, m: int) -> jax.Array:
+    return _packed_verify_impl(codeword, sched, k, m)
+
+
+class PackedVerifyPlan:
+    """Compare-only packed-plane plan (ISSUE 9): one fused jit per
+    parity matrix that recomputes parity for a (batch, k+m, L) codeword
+    window and returns the per-stripe mismatch bitmap instead of chunks
+    — the deep-scrub aggregation kernel.  Dispatches count on
+    VERIFY_LAUNCHES (and LAUNCHES) so "a whole scrub chunk verified in
+    one launch" is a testable dispatch-shape invariant."""
+
+    __slots__ = ("k", "m", "sched")
+
+    def __init__(self, gf_matrix: np.ndarray):
+        gfm = np.asarray(gf_matrix, dtype=np.uint8)
+        self.m, self.k = gfm.shape
+        assert self.m <= 8, f"mismatch bitmap is uint8; m={self.m} > 8"
+        self.sched = plane_schedule(gfm)
+
+    def __call__(self, codeword: jax.Array) -> jax.Array:
+        """(..., k+m, L) uint8 -> (...,) uint8 mismatch bitmap."""
+        lead = codeword.shape[:-2]
+        record_launch(
+            int(np.prod(lead)) if lead else 1,
+            int(np.prod(codeword.shape)),
+            verify=True,
+        )
+        return _packed_verify(codeword, sched=self.sched, k=self.k, m=self.m)
+
+
+def packed_verify_host(
+    gf_matrix: np.ndarray, codeword: np.ndarray
+) -> np.ndarray:
+    """Byte-identical HOST oracle of PackedVerifyPlan (pure numpy, never
+    touches the jax runtime): the DEGRADED-mode fallback of the verify
+    aggregator, and the reference the kernel tests pin the bitmap
+    against.  Recomputes parity through the same expanded bit-matrix the
+    host encode oracle uses, so both paths agree on every byte."""
+    from ceph_tpu.gf import expand_matrix
+    from ceph_tpu.gf.bitslice import xor_matmul_host_batch
+
+    gfm = np.asarray(gf_matrix, dtype=np.uint8)
+    m, k = gfm.shape
+    assert m <= 8, f"mismatch bitmap is uint8; m={m} > 8"
+    cw = np.asarray(codeword, dtype=np.uint8)
+    data, stored = cw[..., :k, :], cw[..., k:, :]
+    recomputed = xor_matmul_host_batch(expand_matrix(gfm), data)
+    row_bad = np.any(recomputed ^ stored, axis=-1)  # (..., m) bool
+    weights = (np.uint8(1) << np.arange(m, dtype=np.uint8))
+    return np.sum(
+        row_bad.astype(np.uint8) * weights, axis=-1, dtype=np.uint8
+    )
+
+
 class PackedPlan:
     """Host-built packed-plane plan: one fused jit per (matrix, geometry).
 

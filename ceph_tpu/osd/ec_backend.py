@@ -168,6 +168,7 @@ class ECBackend(PGBackend):
         fast_read: bool = False,
         aggregator=None,
         decode_aggregator=None,
+        verify_aggregator=None,
     ):
         super().__init__(listener, store)
         self.ec = ec
@@ -182,6 +183,7 @@ class ECBackend(PGBackend):
         from ..codec.matrix_codec import (
             default_decode_aggregator,
             default_encode_aggregator,
+            default_verify_aggregator,
         )
 
         self.encode_aggregator = (
@@ -194,6 +196,14 @@ class ECBackend(PGBackend):
             decode_aggregator
             if decode_aggregator is not None
             else default_decode_aggregator()
+        )
+        # Verify triplet (ISSUE 9): deep-scrub parity recomputes ride
+        # compare-only launches under the background QoS lane
+        # (ec_tpu_verify_aggregate_* knobs; osd/scrubber.py submits).
+        self.verify_aggregator = (
+            verify_aggregator
+            if verify_aggregator is not None
+            else default_verify_aggregator()
         )
         self.extent_cache = ExtentCache()
         self._tid = 0

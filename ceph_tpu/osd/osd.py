@@ -200,6 +200,7 @@ class OSD(Dispatcher):
         from ..codec.matrix_codec import (
             default_decode_aggregator,
             default_encode_aggregator,
+            default_verify_aggregator,
         )
 
         self.encode_aggregator = default_encode_aggregator()
@@ -227,6 +228,46 @@ class OSD(Dispatcher):
         self.conf.add_observer(
             ["ec_tpu_decode_aggregate_max_bytes"],
             lambda _n, v: self.decode_aggregator.configure(max_bytes=int(v)),
+        )
+        self.verify_aggregator = default_verify_aggregator()
+        self.verify_aggregator.configure(
+            window=self.conf.get("ec_tpu_verify_aggregate_window"),
+            max_bytes=self.conf.get("ec_tpu_verify_aggregate_max_bytes"),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_verify_aggregate_window"],
+            lambda _n, v: self.verify_aggregator.configure(window=int(v)),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_verify_aggregate_max_bytes"],
+            lambda _n, v: self.verify_aggregator.configure(max_bytes=int(v)),
+        )
+        # launch-scheduler QoS profiles (ISSUE 9): the nine
+        # ec_tpu_sched_* knobs map onto the three lanes' dmClock
+        # triples; any one changing re-derives all three profiles (the
+        # mClockScheduler config-observer pattern, reapplied to the
+        # device launch queue)
+        from ..ops.launch_scheduler import launch_scheduler
+        from .scheduler import ClientProfile
+
+        def _apply_sched_profiles(_n=None, _v=None) -> None:
+            launch_scheduler().configure(**{
+                lane: ClientProfile(
+                    reservation=self.conf.get(f"ec_tpu_sched_{lane}_res"),
+                    weight=self.conf.get(f"ec_tpu_sched_{lane}_wgt"),
+                    limit=self.conf.get(f"ec_tpu_sched_{lane}_lim"),
+                )
+                for lane in ("client", "recovery", "background")
+            })
+
+        _apply_sched_profiles()
+        self.conf.add_observer(
+            [
+                f"ec_tpu_sched_{lane}_{knob}"
+                for lane in ("client", "recovery", "background")
+                for knob in ("res", "wgt", "lim")
+            ],
+            _apply_sched_profiles,
         )
         # backpressure bound: both aggregators share the knob (ISSUE 7),
         # runtime-mutable like the window/byte-budget settings
@@ -347,6 +388,7 @@ class OSD(Dispatcher):
         # distributions alongside the daemon counters
         agg_perf = self.encode_aggregator.perf
         dec_perf = self.decode_aggregator.perf
+        ver_perf = self.verify_aggregator.perf
         from ..ops import dispatch as ec_dispatch
 
         sock.register(
@@ -355,8 +397,10 @@ class OSD(Dispatcher):
                 **self.perf.dump(),
                 "ec_aggregator": agg_perf.dump(),
                 "ec_decode_aggregator": dec_perf.dump(),
+                "ec_verify_aggregator": ver_perf.dump(),
                 # process-wide launch counters incl. the sharded-launch /
-                # devices-per-launch dimension (ops/dispatch.py)
+                # devices-per-launch dimension and the launch-scheduler
+                # per-class QoS counters (ops/dispatch.py)
                 "ec_dispatch": ec_dispatch.perf_dump(),
             },
             "dump perf counters",
@@ -382,6 +426,7 @@ class OSD(Dispatcher):
                 **self.perf.dump_histograms(),
                 "ec_aggregator": agg_perf.dump_histograms(),
                 "ec_decode_aggregator": dec_perf.dump_histograms(),
+                "ec_verify_aggregator": ver_perf.dump_histograms(),
             },
             "log2-bucketed latency (and size x latency) histograms",
         )
@@ -634,6 +679,8 @@ class OSD(Dispatcher):
             perf[f"ec_aggregator.{name}"] = val
         for name, val in self.decode_aggregator.perf.dump().items():
             perf[f"ec_decode_aggregator.{name}"] = val
+        for name, val in self.verify_aggregator.perf.dump().items():
+            perf[f"ec_verify_aggregator.{name}"] = val
         # launch counters incl. sharded launches / devices-per-launch
         # (ops/dispatch.py): flat scalars, so the mgr prometheus scrape
         # exports one ceph_tpu_ec_dispatch_* family per counter
@@ -641,6 +688,15 @@ class OSD(Dispatcher):
 
         for name, val in ec_dispatch.perf_dump().items():
             perf[f"ec_dispatch.{name}"] = val
+        # launch-scheduler QoS counters under their canonical prometheus
+        # prefix (ISSUE 9): aliases of the sched.* slice the dispatch
+        # loop above just exported, re-namespaced so the scrape renders
+        # ceph_tpu_ec_sched_<class>_<counter> families.  Copied from the
+        # snapshot already in `perf` — a second perf_dump() here could
+        # disagree with its own alias within one report
+        for name, val in list(perf.items()):
+            if name.startswith("ec_dispatch.sched."):
+                perf["ec_sched." + name[len("ec_dispatch.sched."):]] = val
         # device-utilization accounting under its canonical prometheus
         # names (ISSUE 8): aliases of the flight-derived scalars the
         # perf_dump() loop above just computed — one utilization
@@ -1097,11 +1153,40 @@ def _osd_status(osd: "OSD") -> dict:
     pool_stored: dict[str, int] = {}
     pool_heads: dict[str, int] = {}
     progress: dict[str, list] = {}
+    scrub_errors: dict[str, dict] = {}
     slow_count, slow_oldest = osd.op_tracker.slow_ops()
     for pg in osd.pgs.values():
         events = pg.progress_status()
         if events:
             progress[f"{pg.pool.id}.{pg.ps}"] = events
+        # scrub inconsistencies from the PGs this OSD primaries (ISSUE 9
+        # satellite): the last scrub's errors ride the status blob so
+        # the mgr digest and the mon's OSD_SCRUB_ERRORS / PG_DAMAGED
+        # HEALTH_ERR can see them — before this they only hit clog and
+        # vanished.  Cleared by a later clean scrub (last_result
+        # replaced) or by repair rebuilding every bad shard.
+        last = pg.scrubber.last_result
+        if (
+            pg.peering.is_primary()
+            and last is not None
+            and last.errors
+            and not last.aborted
+            # a repair scrub that re-queued every inconsistent object
+            # for recovery counts as handled: recovery rebuilds the
+            # shards, and the next scrub confirms — holding HEALTH_ERR
+            # through that window would punish the operator for
+            # running `pg repair` exactly as intended
+            and last.repaired < len(last.inconsistent)
+        ):
+            scrub_errors[f"{pg.pool.id}.{pg.ps}"] = {
+                "errors": last.errors,
+                "deep": last.deep,
+                "repaired": last.repaired,
+                "inconsistent": {
+                    oid: {str(osd_id): why for osd_id, why in bad.items()}
+                    for oid, bad in last.inconsistent.items()
+                },
+            }
         pid = str(pg.pool.id)
         pool_objects[pid] = pool_objects.get(pid, 0) + pg.local_object_count()
         pool_bytes[pid] = pool_bytes.get(pid, 0) + pg.local_bytes_used()
@@ -1139,6 +1224,10 @@ def _osd_status(osd: "OSD") -> dict:
         # into the digest slice the TPU_BACKEND_DEGRADED health check
         # (mon HEALTH_WARN + mgr prometheus healthcheck gauge) reads
         "tpu_backend": _tpu_backend_status(),
+        # per-PG scrub inconsistencies from this OSD's primaries —
+        # aggregated by the mgr into the digest slice the mon's
+        # OSD_SCRUB_ERRORS / PG_DAMAGED HEALTH_ERR checks read
+        "scrub_errors": scrub_errors,
     }
 
 

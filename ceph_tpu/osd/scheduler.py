@@ -115,8 +115,14 @@ class MClockScheduler(OpScheduler):
 
     def update_profile(self, klass: SchedClass, profile: ClientProfile) -> None:
         """Runtime reconfiguration (the reference's config-observer path,
-        mClockScheduler.h:72 md_config_obs_t)."""
+        mClockScheduler.h:72 md_config_obs_t).  The class's tag chain
+        restarts: a reservation of 0 stores r = inf as the last tag, and
+        without a reset a later nonzero reservation would compute
+        max(now, inf + 1/res) forever — the knob would be permanently
+        inert (the reference rebuilds the dmclock client info on config
+        change for the same reason)."""
         self.profiles[klass] = profile
+        self._last[klass] = _Tags()
 
     def enqueue(self, item: WorkItem) -> None:
         now = self.clock()

@@ -24,10 +24,13 @@ the comparison semantics follow the reference.
 
 from __future__ import annotations
 
+import base64
 import json
 import time
 from dataclasses import dataclass, field
 from typing import Callable
+
+import numpy as np
 
 from ..common.log import dout
 from ..msg.messages import MOSDRepScrub, MOSDRepScrubMap, PgId
@@ -49,6 +52,12 @@ class ScrubResult:
     inconsistent: dict[str, dict[int, str]] = field(default_factory=dict)
     repaired: int = 0
     aborted: bool = False
+    # oids whose parity equation is broken but whose corrupt shard could
+    # NOT be localized (every shard passed its digest-vs-hinfo check):
+    # repair must not trust any shard — re-encoding parity from a
+    # possibly-corrupt data shard would make the damage permanent and
+    # silent, so these stay inconsistent (HEALTH_ERR) for the operator
+    unrepairable: set[str] = field(default_factory=set)
 
     @property
     def clean(self) -> bool:
@@ -160,6 +169,19 @@ class PgScrubber:
                     hinfo = HashInfo.decode(attrs[HINFO_ATTR])
                     entry["hinfo_digest"] = hinfo.get_chunk_hash(shard)
                     entry["hinfo_size"] = hinfo.get_total_chunk_size()
+                    # EC deep scrub ships the shard chunk bytes to the
+                    # primary (ISSUE 9): the device verify path
+                    # recomputes parity across all k+m shards in one
+                    # aggregated compare-only launch, which the
+                    # digest-vs-hinfo check alone cannot do (a shard
+                    # whose hinfo was rewritten consistently with its
+                    # corrupt bytes passes the digest check but breaks
+                    # the parity equation).  Only for codecs that CAN
+                    # consume them — shipping ~1.33x the object size
+                    # per shard to a primary whose plugin has no verify
+                    # path would be pure network overhead.
+                    if self._ec_codec()[0] is not None:
+                        entry["data"] = base64.b64encode(data).decode()
                 else:
                     # replicated deep scrub covers omap too (be_deep_scrub
                     # omap_digest): crc over the canonical KV encoding
@@ -287,13 +309,25 @@ class PgScrubber:
         acting = self.pg.acting()
         is_ec = self.pg.pool.type == POOL_TYPE_ERASURE
         all_oids = sorted({o for m in self._maps.values() for o in m})
+        # Deep EC chunks verify parity on the device (ISSUE 9): SUBMIT
+        # the whole chunk's codewords as one verify ticket first, run
+        # the host metadata/digest compares while the launch is in
+        # flight, then reap the bitmaps below.  While the backend is
+        # DEGRADED the aggregator re-runs the identical compare on the
+        # host oracle, so the bitmap is byte-identical either way.
+        verify = None
+        if self._deep and is_ec and all_oids:
+            verify = self._submit_ec_verify(all_oids, acting)
+        host_bad: dict[str, dict[int, str]] = {}
         for oid in all_oids:
             res.objects_scrubbed += 1
-            bad: dict[int, str] = {}
             if is_ec:
-                bad = self._compare_ec_object(oid, acting)
+                host_bad[oid] = self._compare_ec_object(oid, acting)
             else:
-                bad = self._compare_replicated_object(oid, acting)
+                host_bad[oid] = self._compare_replicated_object(oid, acting)
+        if verify is not None:
+            self._reap_ec_verify(verify, host_bad, acting)
+        for oid, bad in host_bad.items():
             if bad:
                 res.errors += len(bad)
                 res.inconsistent[oid] = bad
@@ -312,6 +346,130 @@ class PgScrubber:
             self._finish()
         self._flush_waiting_writes()
 
+    # -- device-offloaded EC parity verify (ISSUE 9) ---------------------------
+
+    def _ec_codec(self):
+        """The PG backend's matrix codec + stripe info, or (None, None)
+        when the pool's codec has no device verify path (non-matrix
+        plugins): the host digest compare then stands alone, as before."""
+        backend = getattr(self.pg, "backend", None)
+        ec = getattr(backend, "ec", None)
+        sinfo = getattr(backend, "sinfo", None)
+        if ec is None or sinfo is None or not hasattr(ec, "verify_array"):
+            return None, None
+        return ec, sinfo
+
+    def _submit_ec_verify(self, oids: list[str], acting: list[int]):
+        """Stack every verifiable object's shard chunks into one
+        (stripes, k+m, L) codeword batch and SUBMIT it to the shared
+        VerifyAggregator — one ticket per scrub chunk, so the whole
+        chunk's parity recompute rides one compare-only launch (padded
+        and coalesced with other PGs' scrubs by the aggregator).
+        Returns (ticket, spans, ec) or None; spans maps oid -> (start,
+        stripes) into the batch.
+
+        An object is verifiable when every acting shard answered with
+        chunk bytes of one common length; anything else (missing shard,
+        truncated shard, no hinfo) is already the host compare's
+        business.  Ragged final chunks zero-pad to the chunk size on
+        data AND parity rows — the code is linear, encode(0) == 0, so
+        padding preserves the parity equation exactly."""
+        ec, sinfo = self._ec_codec()
+        if ec is None:
+            return None
+        k, m = ec.k, ec.m
+        n = k + m
+        if len(acting) < n or any(osd == PG_NONE for osd in acting[:n]):
+            return None
+        L = sinfo.chunk_size
+        raw_of = ec.chunk_index
+        batches: list[np.ndarray] = []
+        spans: dict[str, tuple[int, int]] = {}
+        start = 0
+        for oid in oids:
+            rows: list[bytes] = []
+            for i in range(n):
+                entry = self._maps.get(acting[raw_of(i)], {}).get(oid)
+                blob = entry.get("data") if entry else None
+                if blob is None:
+                    rows = []
+                    break
+                rows.append(base64.b64decode(blob))
+            if not rows or len({len(r) for r in rows}) != 1 or not len(rows[0]):
+                continue
+            shard_len = len(rows[0])
+            stripes = -(-shard_len // L)
+            padded = np.zeros((n, stripes * L), dtype=np.uint8)
+            for i, r in enumerate(rows):
+                padded[i, :shard_len] = np.frombuffer(r, dtype=np.uint8)
+            # (n, stripes*L) -> (stripes, n, L): each stripe's rows stay
+            # in encode order, matching verify_array's contract
+            batches.append(
+                padded.reshape(n, stripes, L).transpose(1, 0, 2)
+            )
+            spans[oid] = (start, stripes)
+            start += stripes
+        if not batches:
+            return None
+        agg = getattr(self.pg.backend, "verify_aggregator", None)
+        if agg is None:
+            from ..codec.matrix_codec import default_verify_aggregator
+
+            agg = default_verify_aggregator()
+        try:
+            ticket = agg.submit(ec, np.ascontiguousarray(np.concatenate(batches)))
+        except Exception as e:
+            dout("osd", 1,
+                 f"pg {self.pg.pgid} scrub: verify submit failed ({e!r}); "
+                 "host compare stands alone")
+            return None
+        return ticket, spans, ec
+
+    def _reap_ec_verify(
+        self,
+        verify,
+        host_bad: dict[str, dict[int, str]],
+        acting: list[int],
+    ) -> None:
+        """Reap the chunk's mismatch bitmaps and merge attributions into
+        the host compare's verdict.  A nonzero per-object bitmap whose
+        shards all passed the digest check is the case the offload
+        exists for: the parity equation is broken even though every
+        shard is self-consistent — attribute the mismatched parity
+        row(s).  A reap failure (device error whose host recompute also
+        failed) degrades to the digest-only verdict, never to a scrub
+        abort."""
+        ticket, spans, ec = verify
+        try:
+            bitmap = np.asarray(ticket)
+        except Exception as e:
+            dout("osd", 1,
+                 f"pg {self.pg.pgid} scrub: verify reap failed ({e!r}); "
+                 "host compare stands alone")
+            return
+        raw_of = ec.chunk_index
+        for oid, (start, stripes) in spans.items():
+            bits = int(np.bitwise_or.reduce(bitmap[start : start + stripes]))
+            if not bits or host_bad.get(oid):
+                # clean, or the digest compare already attributed the
+                # corrupt shard (don't double-report one object)
+                continue
+            # the equation is broken but every shard passed its own
+            # digest check: the bitmap proves damage, not WHICH shard.
+            # Report it on the mismatched parity row(s) for visibility,
+            # but flag the object unrepairable — auto-repair re-encodes
+            # parity from the data shards, and if the corrupt shard is a
+            # data shard that would cement the corruption and clear the
+            # health check over permanently damaged user data.
+            self._result.unrepairable.add(oid)
+            bad = host_bad.setdefault(oid, {})
+            for j in range(ec.m):
+                if bits >> j & 1:
+                    bad[acting[raw_of(ec.k + j)]] = (
+                        f"ec parity recompute mismatch (row {j}; corrupt "
+                        "shard not localized — not auto-repairable)"
+                    )
+
     def _compare_ec_object(self, oid: str, acting: list[int]) -> dict[int, str]:
         """EC comparison: every acting shard must hold the object, sized
         per hinfo (a truncated shard is as lost as an absent one), with
@@ -319,16 +477,30 @@ class PgScrubber:
         against the hinfo crc persisted at write time (be_deep_scrub)."""
         bad: dict[int, str] = {}
         # Shallow metadata authority: the modal (oi_size, version) pair.
-        metas = [
-            (e.get("oi_size"), e.get("version"))
-            for e in (
-                self._maps.get(osd, {}).get(oid)
-                for osd in acting
+        # Ties break deterministically — highest version first, then the
+        # copy held by the lowest shard — so two runs over the same maps
+        # always blame the same side (the old max(set(...)) pick
+        # depended on set iteration order, i.e. on hash seeding).
+        metas_by_shard = [
+            (shard, (e["oi_size"], e.get("version")))
+            for shard, e in (
+                (shard, self._maps.get(osd, {}).get(oid))
+                for shard, osd in enumerate(acting)
                 if osd != PG_NONE
             )
             if e is not None and "oi_size" in e
         ]
-        auth_meta = max(set(metas), key=metas.count) if metas else None
+        counts: dict[tuple, int] = {}
+        for _shard, meta in metas_by_shard:
+            counts[meta] = counts.get(meta, 0) + 1
+        auth_meta = None
+        best_key: tuple | None = None
+        for _shard, meta in sorted(metas_by_shard):
+            version = meta[1] if meta[1] is not None else -1
+            key = (counts[meta], version)
+            if best_key is None or key > best_key:  # strict: ties keep
+                best_key = key                      # the lowest shard
+                auth_meta = meta
         for shard, osd in enumerate(acting):
             if osd == PG_NONE:
                 continue
@@ -398,6 +570,17 @@ class PgScrubber:
         self.last_result = res
         if self._repair and res.inconsistent:
             for oid, bad in res.inconsistent.items():
+                if oid in res.unrepairable:
+                    # the corrupt shard was never localized: rebuilding
+                    # the flagged parity shards would re-encode from a
+                    # possibly-corrupt data shard and hide the damage
+                    self.pg.clog_error(
+                        f"pg {self.pg.pgid} repair: {oid} parity "
+                        "mismatch with no localized shard; refusing "
+                        "auto-repair (restore the object from a replica "
+                        "or backup)"
+                    )
+                    continue
                 for osd in bad:
                     self.pg.mark_shard_missing(oid, osd)
                 res.repaired += 1

@@ -10,6 +10,13 @@ failure modes the wired FaultInjector seams expose (ISSUE 7):
   escalation and reconstruction,
 - device coding-launch failures (`codec.launch`) driving the
   DEGRADED-backend host fallback + re-probe self-heal,
+- a deep-scrub-under-load phase (ISSUE 9): silent shard corruption is
+  planted on disk, every primary deep-scrubs (TPU-offloaded parity
+  verify through the VerifyAggregator's background QoS lane) WHILE
+  client writes keep flowing — the phase asserts the corruption is
+  detected, that verify launches aggregated (fewer launches than
+  objects), and that client p99 stayed within the QoS bound while the
+  scrub stream ran,
 - an OSD flap (stop, degraded writes, restart on the old store) driving
   peering + recovery pushes.
 
@@ -205,6 +212,113 @@ async def _run(cfg: dict) -> dict:
         )
         report["events"].append("launch faults absorbed by host fallback")
 
+        # ---- phase 3.5: deep scrub under load (ISSUE 9 QoS) -------------
+        # Plant silent shard corruption, then deep-scrub every primary
+        # WHILE client writes keep flowing.  The scrub's parity verify
+        # rides aggregated compare-only launches on the background QoS
+        # lane, so the phase proves three things at once: the corruption
+        # is DETECTED (integrity), verify launches COALESCE (fewer
+        # launches than objects scrubbed), and client write p99 stays
+        # within the configured bound (QoS actually works — scrub never
+        # starves the client lane).
+        from ceph_tpu.os.transaction import Transaction
+        from ceph_tpu.osd.pg_backend import shard_coll
+
+        verify0 = ec_dispatch.VERIFY_LAUNCHES.snapshot()
+        primaries = [
+            (o, pg)
+            for o in osds
+            if o._running
+            for pg in o.pgs.values()
+            if pg.pool.name == "chaospool" and pg.peering.is_primary()
+        ]
+        # victim: the first object of the first primary PG that has one,
+        # corrupted on a non-primary acting shard (the write path never
+        # sees it; only deep scrub can)
+        victim_oid = victim_pg = None
+        for o, pg in primaries:
+            coll = shard_coll(pg.pgid, pg.whoami_shard())
+            oids = sorted(o.store.list_objects(coll))
+            if oids:
+                victim_oid, victim_pg = oids[0], pg
+                break
+        assert victim_oid is not None, "chaos: no scrubable objects"
+        acting = victim_pg.acting()
+        bad_shard = next(
+            s for s, w in enumerate(acting) if w != victim_pg.whoami()
+        )
+        bad_osd = next(o for o in osds if o.whoami == acting[bad_shard])
+        coll = shard_coll(victim_pg.pgid, bad_shard)
+        good_bytes = bad_osd.store.read(coll, victim_oid, 0, 0)
+        bad_osd.store.queue_transaction(
+            Transaction().write(
+                coll, victim_oid, 0,
+                bytes([good_bytes[0] ^ 0xFF]) + good_bytes[1:],
+            )
+        )
+        scrub_results: list = []
+        pending_scrubs = 0
+        for _o, pg in primaries:
+            if pg.scrub(deep=True, on_done=scrub_results.append):
+                pending_scrubs += 1
+        # client load WHILE the scrub stream runs, per-op latency sampled
+        scrub_lat_s: list[float] = []
+        i = 0
+        while len(scrub_results) < pending_scrubs:
+            t0 = time.monotonic()
+            await put(f"scrubload{i}", 8192)
+            scrub_lat_s.append(time.monotonic() - t0)
+            i += 1
+            if i > 500:  # scrub wedged: fail via the wait below
+                break
+        await _wait_until(
+            lambda: len(scrub_results) >= pending_scrubs,
+            cfg["converge_timeout"], "deep scrubs under load to finish",
+        )
+        detected = any(
+            victim_oid in res.inconsistent
+            and acting[bad_shard] in res.inconsistent[victim_oid]
+            for res in scrub_results
+        )
+        assert detected, "chaos: planted shard corruption not detected"
+        vdelta = ec_dispatch.VERIFY_LAUNCHES.snapshot()
+        v_launches = vdelta["launches"] - verify0["launches"]
+        v_stripes = vdelta["stripes"] - verify0["stripes"]
+        assert v_launches >= 1, "chaos: scrub never reached the verify kernel"
+        objects_scrubbed = sum(r.objects_scrubbed for r in scrub_results)
+        assert v_launches < max(2, objects_scrubbed), (
+            "chaos: verify launches did not aggregate "
+            f"({v_launches} launches for {objects_scrubbed} objects)"
+        )
+        scrub_lat_s.sort()
+        scrub_p99 = (
+            scrub_lat_s[int(0.99 * (len(scrub_lat_s) - 1))]
+            if scrub_lat_s else 0.0
+        )
+        report["scrub_p99_ms"] = round(scrub_p99 * 1e3, 3)
+        report["scrub_errors_detected"] = sum(r.errors for r in scrub_results)
+        report["verify_launches"] = v_launches
+        report["verify_stripes"] = v_stripes
+        report["scrub_objects"] = objects_scrubbed
+        assert scrub_p99 * 1e3 <= cfg["scrub_p99_bound_ms"], (
+            f"chaos: client p99 {scrub_p99 * 1e3:.1f} ms exceeded the "
+            f"{cfg['scrub_p99_bound_ms']} ms QoS bound under deep scrub"
+        )
+        # repair + rebuild so the run still converges damage-free: the
+        # detected inconsistency raises PG_DAMAGED until the repair
+        # scrub re-queues the shard and recovery rewrites it
+        repair_done: list = []
+        assert victim_pg.scrub(
+            deep=True, repair=True, on_done=repair_done.append
+        )
+        await _wait_until(lambda: bool(repair_done), cfg["converge_timeout"],
+                          "repair scrub to finish")
+        await _wait_until(
+            lambda: bad_osd.store.read(coll, victim_oid, 0, 0) == good_bytes,
+            cfg["converge_timeout"], "repair to rewrite the corrupt shard",
+        )
+        report["events"].append("deep scrub under load detected + repaired")
+
         # ---- phase 4: OSD flap + recovery -------------------------------
         victim_id = rng.randrange(cfg["osds"])
         victim = osds[victim_id]
@@ -356,6 +470,11 @@ def run_chaos(
         "eio_hits": 3 if smoke else 8,
         "launch_faults": 2 if smoke else 4,
         "converge_timeout": 30.0 if smoke else 90.0,
+        # client write p99 bound while the deep-scrub verify stream runs
+        # (the QoS acceptance gate).  Deliberately generous for shared
+        # CI hosts — the assertion exists to catch scrub BLOCKING the
+        # client lane (seconds-scale stalls), not to benchmark
+        "scrub_p99_bound_ms": 2000.0 if smoke else 1000.0,
     }
     return asyncio.run(_run(cfg))
 

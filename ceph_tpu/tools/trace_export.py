@@ -12,7 +12,12 @@ of infer:
   ROADMAP item 2 (overlap H2D with the previous kernel);
 - one process row ("aggregator") with a lane per aggregator group,
   carrying a ``queue_wait`` slice (submit→dispatch: time the window
-  held the work) followed by the launch slice, flags in ``args``.
+  held the work) followed by the launch slice, flags in ``args``;
+- one process row ("sched class") with a lane per QoS class (client /
+  recovery / background — the ISSUE 9 launch scheduler's lanes), same
+  queue_wait + launch slices: a priority inversion is a background
+  launch slice sitting in front of a client lane's queue_wait, visible
+  at a glance.
 
 Usage::
 
@@ -134,35 +139,48 @@ def export_chrome_trace(records: list[dict]) -> dict:
                     cursor += max(_MIN_DUR_US, dur_us)
             prev_end_us = cursor
     # aggregator-group lanes: queue_wait then the whole launch span, per
-    # group — shows which window held work and for how long
-    by_group: dict[str, list[dict]] = {}
-    for rec in records:
-        by_group.setdefault(rec.get("group") or "#raw", []).append(rec)
-    for group, recs in sorted(by_group.items()):
-        prev_end_us = None
-        for rec in sorted(recs, key=lambda r: r.get("submit_ts", 0.0)):
-            start_us = _us(rec["submit_ts"])
-            if prev_end_us is not None:
-                start_us = max(start_us, prev_end_us)
-            cursor = start_us
-            wait_us = _us(rec.get("queue_wait_s", 0.0))
-            if wait_us > 0:
+    # group — shows which window held work and for how long.  The same
+    # rendering repeats on the "sched class" row with one lane per QoS
+    # class (ISSUE 9), so client / recovery / background contention is
+    # directly comparable: a background launch slice overlapping a
+    # client lane's queue_wait IS the priority inversion.
+    def _sequential_lanes(pid: str, lane_of) -> None:
+        by_lane_: dict[str, list[dict]] = {}
+        for rec in records:
+            lane = lane_of(rec)
+            if lane is not None:
+                by_lane_.setdefault(lane, []).append(rec)
+        for lane, recs in sorted(by_lane_.items()):
+            prev_end = None
+            for rec in sorted(recs, key=lambda r: r.get("submit_ts", 0.0)):
+                start_us = _us(rec["submit_ts"])
+                if prev_end is not None:
+                    start_us = max(start_us, prev_end)
+                cursor = start_us
+                wait_us = _us(rec.get("queue_wait_s", 0.0))
+                if wait_us > 0:
+                    events.append(_complete(
+                        "queue_wait", pid, lane, cursor, wait_us,
+                        {"seq": rec["seq"]},
+                    ))
+                    cursor += max(_MIN_DUR_US, wait_us)
+                settle = rec.get("settle_ts") or rec.get("dispatch_ts") or 0.0
+                launch_us = max(
+                    _MIN_DUR_US,
+                    _us(settle)
+                    - _us(rec.get("dispatch_ts") or rec["submit_ts"]),
+                )
                 events.append(_complete(
-                    "queue_wait", "aggregator", group, cursor, wait_us,
-                    {"seq": rec["seq"]},
+                    f"{rec['kind']} launch", pid, lane, cursor,
+                    launch_us, _flags_args(rec),
                 ))
-                cursor += max(_MIN_DUR_US, wait_us)
-            settle = rec.get("settle_ts") or rec.get("dispatch_ts") or 0.0
-            launch_us = max(
-                _MIN_DUR_US,
-                _us(settle) - _us(rec.get("dispatch_ts") or rec["submit_ts"]),
-            )
-            events.append(_complete(
-                f"{rec['kind']} launch", "aggregator", group, cursor,
-                launch_us, _flags_args(rec),
-            ))
-            cursor += launch_us
-            prev_end_us = cursor
+                cursor += launch_us
+                prev_end = cursor
+
+    _sequential_lanes("aggregator", lambda rec: rec.get("group") or "#raw")
+    # records that never passed through the launch scheduler (raw bench
+    # loops, bulk eager calls) have no class and stay off this row
+    _sequential_lanes("sched class", lambda rec: rec.get("sched_class") or None)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",

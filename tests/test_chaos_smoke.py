@@ -1,8 +1,10 @@
 """Seed-fixed chaos smoke in tier-1 (ISSUE 7 acceptance): a real
 mon+mgr+OSD cluster under mixed load survives socket faults, shard-read
-EIO bursts, device-launch failures (host fallback), and an OSD flap —
-converging to all-PGs-clean with ZERO lost writes and health clear of
-SLOW_OPS / TPU_BACKEND_DEGRADED.
+EIO bursts, device-launch failures (host fallback), a deep scrub under
+client load with planted shard corruption (ISSUE 9: detected via
+aggregated TPU verify launches, client p99 inside the QoS bound), and
+an OSD flap — converging to all-PGs-clean with ZERO lost writes and
+health clear of SLOW_OPS / TPU_BACKEND_DEGRADED.
 
 The full-size variant lives in `python -m ceph_tpu.tools.chaos`; this is
 the `--smoke` configuration run in-process so tier-1 exercises the same
@@ -17,10 +19,20 @@ class TestChaosSmoke:
         assert report["converged"], report
         assert report["lost_writes"] == 0, report
         # every chaos phase actually ran
-        assert len(report["events"]) == 5, report["events"]
+        assert len(report["events"]) == 6, report["events"]
         # the launch-fault phase really drove the host fallback
         assert report["degraded_entered"], report
         assert report["fallback_launches"] >= 1, report
+        # ISSUE 9: the deep-scrub-under-load phase detected the planted
+        # corruption through aggregated device verify launches (fewer
+        # launches than objects = one launch covered many), and client
+        # writes stayed inside the QoS bound while the scrub ran (the
+        # bound itself is asserted inside the phase — a violation fails
+        # the run, not just this check)
+        assert report["scrub_errors_detected"] >= 1, report
+        assert report["verify_launches"] >= 1, report
+        assert report["verify_launches"] < report["scrub_objects"], report
+        assert report["scrub_p99_ms"] >= 0.0, report
         # health settled: no stuck SLOW_OPS, no lingering degraded check
         assert "SLOW_OPS" not in report["health_checks"], report
         assert "TPU_BACKEND_DEGRADED" not in report["health_checks"], report

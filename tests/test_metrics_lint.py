@@ -213,16 +213,38 @@ class TestClusterScrapeLint:
             # scrape: the OSD reports the same process-wide counters, so
             # every key here must round-trip through MMgrReport
             dispatch_keys = set(ec_dispatch.perf_dump())
+            # ISSUE 9 cross-lint: the verify counters ride the dispatch
+            # namespace, and the launch scheduler's per-class slice
+            # round-trips twice — inside ec_dispatch (sched.*) and under
+            # its canonical ec_sched prefix on MMgrReport
+            from ceph_tpu.ops.launch_scheduler import launch_scheduler
+
+            assert {"verify_launches", "verify_stripes",
+                    "verify_bytes"} <= dispatch_keys
+            sched_keys = set(launch_scheduler().perf_dump())
+            assert {f"sched.{k}" for k in sched_keys} <= dispatch_keys
 
             def all_reported():
                 text = prom.scrape()
-                return "op_latency" in text and all(
+                if "op_latency" not in text or not all(
                     f"ceph_tpu_ec_dispatch_{_sanitize(k)}" in text
                     for k in dispatch_keys
-                )
+                ):
+                    return False
+                # ..and the report carrying op SAMPLES arrived: the
+                # dispatch counters are process-wide, so when earlier
+                # tests already ran coding dispatches every key exists
+                # in the OSD's FIRST report — which may have been sent
+                # before the writes above completed.  Waiting on the
+                # announcement alone races the next beacon against the
+                # count>0 assertion below.
+                op_lat = lint_exposition(text)[
+                    "ceph_tpu_op_latency"]["samples"]
+                return any(n == "ceph_tpu_op_latency_count" and v > 0
+                           for n, _, v in op_lat)
 
             await wait_until(
-                all_reported, 5.0, "op_latency + ec_dispatch in scrape"
+                all_reported, 5.0, "op_latency samples + ec_dispatch in scrape"
             )
             families = lint_exposition(prom.scrape())
 
@@ -264,6 +286,25 @@ class TestClusterScrapeLint:
             ):
                 assert fam in families, f"{fam} missing from scrape"
                 assert documented(fam), f"{fam} not documented"
+            # ...and the canonical ec_sched families (ISSUE 9): every
+            # scheduler perf-dump key reaches the scrape under its
+            # ceph_tpu_ec_sched_* name AND is documented
+            for key in sched_keys:
+                fam = f"ceph_tpu_ec_sched_{_sanitize(key)}"
+                assert fam in families, f"{fam} missing from scrape"
+                assert documented(fam), f"{fam} not documented"
+            # the scheduler's queue-depth export must be a gauge — a
+            # counter-typed depth would corrupt PromQL rate() queries
+            assert (
+                families["ceph_tpu_ec_sched_client_queue_depth"]["type"]
+                == "gauge"
+            )
+            # verify-aggregator families round-trip like the encode/
+            # decode aggregators'
+            assert any(
+                f.startswith("ceph_tpu_ec_verify_aggregator_")
+                for f in families
+            ), "verify aggregator families missing from scrape"
 
             # direction 2 (vice versa): every documented metric exists
             # in the scrape, and every scraped ec_dispatch/progress
@@ -277,12 +318,20 @@ class TestClusterScrapeLint:
                     f"documented prefix {token}* matches nothing in scrape"
                 )
             sanitized_keys = {_sanitize(k) for k in dispatch_keys}
+            sanitized_sched = {_sanitize(k) for k in sched_keys}
             for fam in families:
                 if fam.startswith("ceph_tpu_ec_dispatch_"):
                     key = fam.removeprefix("ceph_tpu_ec_dispatch_")
                     assert key in sanitized_keys, (
                         f"scraped {fam} has no ops/dispatch.perf_dump() "
                         "source — update the exporter or the docs"
+                    )
+                if fam.startswith("ceph_tpu_ec_sched_"):
+                    key = fam.removeprefix("ceph_tpu_ec_sched_")
+                    assert key in sanitized_sched, (
+                        f"scraped {fam} has no launch_scheduler "
+                        "perf_dump() source — update the exporter or "
+                        "the docs"
                     )
                 if fam.startswith("ceph_tpu_progress_"):
                     assert documented(fam), f"scraped {fam} undocumented"

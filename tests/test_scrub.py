@@ -235,3 +235,207 @@ class TestScrubRobustness:
             await stop_cluster(mons, osds)
 
         asyncio.run(run())
+
+
+class TestScrubOffloadAndHealth:
+    """ISSUE 9: TPU-offloaded deep-scrub verify + the scrub-errors →
+    health pipeline (OSD status blob → mgr digest → mon HEALTH_ERR)."""
+
+    def test_deep_scrub_verifies_on_device_in_aggregated_launches(self):
+        """A multi-object deep scrub routes parity verification through
+        the VerifyAggregator: VERIFY_LAUNCHES advances by ~one launch
+        per chunk, covering every object (the acceptance criterion's
+        one-launch-many-objects witness)."""
+        from ceph_tpu.ops import dispatch as ec_dispatch
+
+        async def run():
+            objs = {f"v{i}": bytes([i + 1]) * 8192 for i in range(8)}
+            monmap, mons, osds, client, ioctx = await make_ec_cluster(objs)
+            osd, pg = find_primary_pg(osds)
+            v0 = ec_dispatch.VERIFY_LAUNCHES.snapshot()
+            res = await run_scrub(pg, deep=True, timeout=15.0)
+            assert res.clean and res.objects_scrubbed == 8
+            after = ec_dispatch.VERIFY_LAUNCHES.snapshot()
+            launches = after["launches"] - v0["launches"]
+            stripes = after["stripes"] - v0["stripes"]
+            assert launches >= 1, "deep scrub never reached the verify kernel"
+            assert launches < 8, (
+                f"verify did not aggregate: {launches} launches for 8 objects"
+            )
+            # every object's stripes rode the launches (one stripe each
+            # at 8 KiB / k=2 / 4 KiB chunks, plus padding)
+            assert stripes >= 8, (launches, stripes)
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_parity_verify_catches_hinfo_consistent_corruption(self):
+        """The case the offload exists for: a shard whose hinfo was
+        rewritten consistently with its corrupt bytes passes the
+        digest-vs-hinfo check, but the parity equation still breaks —
+        only the device recompute can see it."""
+        from ceph_tpu.osd.ec_transaction import HINFO_ATTR
+        from ceph_tpu.stripe import HashInfo
+        from ceph_tpu.utils.crc32c import crc32c
+
+        async def run():
+            payload = bytes(range(256)) * 32  # 8 KiB
+            monmap, mons, osds, client, ioctx = await make_ec_cluster(
+                {"sneaky": payload}
+            )
+            osd, pg = find_primary_pg(osds)
+            acting = pg.acting()
+            bad_shard = 1
+            bad_osd = next(o for o in osds if o.whoami == acting[bad_shard])
+            coll = shard_coll(pg.pgid, bad_shard)
+            good = bad_osd.store.read(coll, "sneaky", 0, 0)
+            corrupted = bytes([good[0] ^ 0xFF]) + good[1:]
+            # forge the hinfo so the digest check passes on the corrupt
+            # bytes — a digest-only scrub is blind to this
+            hinfo = HashInfo.decode(
+                bad_osd.store.getattr(coll, "sneaky", HINFO_ATTR)
+            )
+            hinfo.cumulative_shard_hashes[bad_shard] = crc32c(
+                corrupted, HashInfo.SEED
+            )
+            bad_osd.store.queue_transaction(
+                Transaction()
+                .write(coll, "sneaky", 0, corrupted)
+                .setattr(coll, "sneaky", HINFO_ATTR, hinfo.encode())
+            )
+            res = await run_scrub(pg, deep=True)
+            assert not res.clean, "hinfo-consistent corruption slipped through"
+            assert "sneaky" in res.inconsistent, res.inconsistent
+            reasons = " ".join(res.inconsistent["sneaky"].values())
+            assert "parity recompute mismatch" in reasons, reasons
+            assert "sneaky" in res.unrepairable
+            # auto-repair must REFUSE an unlocalized mismatch: rebuilding
+            # parity from the (corrupt) data shard would cement the
+            # damage and silently clear the health check.  The corrupt
+            # bytes stay on disk and the object stays inconsistent.
+            res2 = await run_scrub(pg, deep=True, repair=True)
+            assert res2.repaired == 0, res2
+            assert bad_osd.store.read(coll, "sneaky", 0, 0) == corrupted
+            res3 = await run_scrub(pg, deep=True)
+            assert not res3.clean, "refused repair must keep flagging"
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_scrub_errors_reach_mon_health_and_clear_on_repair(self):
+        """Satellite: ScrubResult errors flow OSD status blob → mgr
+        digest → mon OSD_SCRUB_ERRORS / PG_DAMAGED HEALTH_ERR with
+        per-PG detail — and clear after repair + recovery + a clean
+        re-scrub."""
+        from ceph_tpu.common.health import overall_status
+        from ceph_tpu.mgr import Mgr
+
+        async def run():
+            payload = bytes(range(256)) * 64
+            monmap, mons, osds, client, ioctx = await make_ec_cluster(
+                {"victim": payload}
+            )
+            mgr = Mgr("x", monmap)
+            mgr.beacon_interval = 0.1
+            await mgr.start()
+            await mgr.wait_for_active()
+            osd, pg = find_primary_pg(osds)
+            acting = pg.acting()
+            bad_shard = 1
+            bad_osd = next(o for o in osds if o.whoami == acting[bad_shard])
+            coll = shard_coll(pg.pgid, bad_shard)
+            good = bad_osd.store.read(coll, "victim", 0, 0)
+            bad_osd.store.queue_transaction(
+                Transaction().write(
+                    coll, "victim", 0, bytes([good[0] ^ 0xFF]) + good[1:]
+                )
+            )
+            res = await run_scrub(pg, deep=True)
+            assert not res.clean
+
+            def damage_raised():
+                checks, details = mons[0].health_checks()
+                return (
+                    "OSD_SCRUB_ERRORS" in checks
+                    and "PG_DAMAGED" in checks
+                    and any("victim" in line
+                            for line in details.get("PG_DAMAGED", []))
+                )
+
+            await wait_until(damage_raised, 10.0,
+                             "scrub errors to reach mon health")
+            checks, _ = mons[0].health_checks()
+            assert overall_status(checks) == "HEALTH_ERR"
+            assert "scrub errors" in checks["OSD_SCRUB_ERRORS"]
+            assert "inconsistent" in checks["PG_DAMAGED"]
+            # the mgr-side checks agree (prometheus healthcheck gauge)
+            assert (
+                mgr.health_checks()
+                .get("OSD_SCRUB_ERRORS", {})
+                .get("severity")
+                == "HEALTH_ERR"
+            )
+
+            # repair: recovery rebuilds the shard; the repaired result
+            # suppresses the check, and the confirming clean scrub (plus
+            # a fresh mgr report cycle) keeps it clear
+            res2 = await run_scrub(pg, deep=True, repair=True)
+            assert res2.repaired == 1
+            await wait_until(lambda: pg.is_clean, 5.0, "repair recovery")
+            res3 = await run_scrub(pg, deep=True)
+            assert res3.clean
+
+            def damage_cleared():
+                checks, _ = mons[0].health_checks()
+                return (
+                    "OSD_SCRUB_ERRORS" not in checks
+                    and "PG_DAMAGED" not in checks
+                )
+
+            await wait_until(damage_cleared, 10.0,
+                             "health to clear after repair")
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+    def test_authority_pick_is_deterministic(self):
+        """Satellite: modal-metadata ties break by highest version, then
+        lowest shard — never by set iteration order."""
+        from ceph_tpu.osd.scrubber import PgScrubber
+
+        class _Pool:
+            type = 0
+
+        class _PG:
+            pool = _Pool()
+            pgid = "t"
+
+            class peering:
+                @staticmethod
+                def osds_missing(oid):
+                    return set()
+
+        scrubber = PgScrubber(_PG())
+        scrubber._deep = False
+        # two-way tie at count 1: (size 10, v 3) on shard 0 vs
+        # (size 10, v 7) on shard 1 — highest version must win, so the
+        # shard 0 copy is the odd one out, on EVERY run
+        for _ in range(8):
+            scrubber._maps = {
+                100: {"o": {"size": 10, "oi_size": 10, "version": 3}},
+                101: {"o": {"size": 10, "oi_size": 10, "version": 7}},
+                102: {"o": {"size": 10, "oi_size": 10, "version": 7}},
+            }
+            bad = scrubber._compare_ec_object("o", [100, 101, 102])
+            assert list(bad) == [100], bad
+            # exact tie in count AND version: lowest shard is authority
+            scrubber._maps = {
+                100: {"o": {"size": 10, "oi_size": 10, "version": 5}},
+                101: {"o": {"size": 12, "oi_size": 12, "version": 5}},
+            }
+            bad = scrubber._compare_ec_object("o", [100, 101])
+            assert list(bad) == [101], bad

@@ -1,0 +1,336 @@
+"""ISSUE 9: the compare-only verify kernel, the VerifyAggregator, and
+the unified launch scheduler's QoS ordering.
+
+Three contracts:
+
+1. **Bitmap fidelity** — `verify_array` (device kernel) and
+   `verify_array_host` (pure-numpy oracle) are byte-identical across
+   RS(4,2) and RS(8,3), for clean codewords, for a corrupted shard at
+   EVERY position, and for ragged final chunks (the scrubber's
+   zero-padding: linear code, encode(0) == 0, so padding preserves the
+   parity equation exactly).
+2. **Aggregation** — a scrub chunk's worth of submissions coalesces
+   into one VERIFY_LAUNCHES dispatch, and the DEGRADED/fault fallback
+   reproduces the identical bitmap on the host oracle.
+3. **QoS ordering** — with a deterministic clock, queued client
+   launches dequeue ahead of a saturating background verify stream
+   (clients never starve behind scrub), and a background-only queue
+   drains completely when the device is otherwise idle (scrub never
+   starves either).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codec import ErasureCodeTpuRs
+from ceph_tpu.codec.matrix_codec import VerifyAggregator
+from ceph_tpu.ops import dispatch as ec_dispatch
+from ceph_tpu.ops.launch_scheduler import LaunchScheduler, lane_name
+from ceph_tpu.osd.scheduler import ClientProfile, SchedClass
+
+
+def make_rs(k: int, m: int) -> ErasureCodeTpuRs:
+    ec = ErasureCodeTpuRs()
+    ec.init({"k": str(k), "m": str(m)})
+    return ec
+
+
+def codewords(ec, stripes: int, L: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (stripes, ec.k, L), dtype=np.uint8)
+    parity = np.asarray(ec.encode_array(data))
+    return np.concatenate([data, parity], axis=1)
+
+
+GEOMETRIES = [(4, 2), (8, 3)]
+
+
+class TestVerifyKernel:
+    @pytest.mark.parametrize("k,m", GEOMETRIES)
+    def test_clean_codewords_bitmap_zero_and_matches_host(self, k, m):
+        ec = make_rs(k, m)
+        cw = codewords(ec, 5, 512, seed=k)
+        dev = np.asarray(ec.verify_array(cw))
+        host = ec.verify_array_host(cw)
+        assert np.array_equal(dev, host)
+        assert not dev.any(), "clean codewords must verify clean"
+        assert dev.shape == (5,) and dev.dtype == np.uint8
+
+    @pytest.mark.parametrize("k,m", GEOMETRIES)
+    def test_corrupted_shard_at_every_position(self, k, m):
+        """A single flipped byte in ANY of the k+m shards must flag
+        exactly the corrupted stripe, identically on device and host."""
+        ec = make_rs(k, m)
+        cw = codewords(ec, 4, 256, seed=10 * k + m)
+        for shard in range(k + m):
+            bad = cw.copy()
+            bad[2, shard, 13] ^= 0x5A
+            dev = np.asarray(ec.verify_array(bad))
+            host = ec.verify_array_host(bad)
+            assert np.array_equal(dev, host), (shard, dev, host)
+            assert dev[2] != 0, f"corrupt shard {shard} not flagged"
+            clean = [i for i in range(4) if i != 2]
+            assert not dev[clean].any(), f"shard {shard} over-flagged"
+            if shard >= k:
+                # a corrupt PARITY shard flags exactly its own row
+                assert dev[2] == 1 << (shard - k), (shard, dev[2])
+
+    @pytest.mark.parametrize("k,m", GEOMETRIES)
+    def test_ragged_final_chunk_zero_padding(self, k, m):
+        """The scrubber pads a ragged final chunk with zeros on data AND
+        parity rows.  encode(0) == 0 for a linear code, so the padded
+        stripe must verify clean — and corruption INSIDE the ragged tail
+        must still be caught, identically on device and host."""
+        ec = make_rs(k, m)
+        L = 512
+        ragged = 137  # final chunk occupies 137 of 512 bytes
+        full = codewords(ec, 3, L, seed=k + m)
+        # rebuild the last stripe from a ragged tail: zero-pad the data,
+        # re-encode, keep only the ragged prefix of data + parity (what
+        # the shards actually store), zero-pad both back to L
+        tail_data = np.zeros((1, k, L), dtype=np.uint8)
+        tail_data[0, :, :ragged] = full[2, :k, :ragged]
+        tail_parity = np.asarray(ec.encode_array(tail_data))
+        padded = np.concatenate([tail_data, tail_parity], axis=1)
+        cw = np.concatenate([full[:2], padded])
+        dev = np.asarray(ec.verify_array(cw))
+        host = ec.verify_array_host(cw)
+        assert np.array_equal(dev, host)
+        assert not dev.any(), "zero-padded ragged chunk must verify clean"
+        bad = cw.copy()
+        bad[2, k - 1, ragged - 1] ^= 0xFF  # inside the ragged tail
+        dev = np.asarray(ec.verify_array(bad))
+        assert np.array_equal(dev, ec.verify_array_host(bad))
+        assert dev[2] != 0, "corruption in the ragged tail missed"
+
+
+class TestVerifyAggregator:
+    def test_chunk_of_objects_coalesces_into_one_launch(self):
+        ec = make_rs(4, 2)
+        agg = VerifyAggregator(window=16)
+        v0 = ec_dispatch.VERIFY_LAUNCHES.snapshot()
+        cw = codewords(ec, 12, 1024, seed=3)
+        # 6 "objects" of 2 stripes each, submitted like one scrub chunk
+        tickets = [agg.submit(ec, cw[i : i + 2]) for i in range(0, 12, 2)]
+        bitmaps = [np.asarray(t) for t in tickets]
+        after = ec_dispatch.VERIFY_LAUNCHES.snapshot()
+        assert after["launches"] - v0["launches"] == 1, (
+            "a chunk's verifies must coalesce into ONE device launch"
+        )
+        assert after["stripes"] - v0["stripes"] >= 12
+        for bm in bitmaps:
+            assert bm.shape == (2,) and not bm.any()
+
+    def test_fault_fallback_bitmap_is_byte_identical(self):
+        """An injected launch fault re-runs the verify on the host
+        oracle: the reaped bitmap must be identical, and the scrub must
+        still detect the corruption."""
+        from ceph_tpu.common.fault_injector import global_injector
+        from ceph_tpu.ops.guard import device_guard
+
+        ec = make_rs(4, 2)
+        agg = VerifyAggregator(window=4)
+        cw = codewords(ec, 3, 512, seed=9)
+        cw[1, 2, 5] ^= 0x77
+        want = ec.verify_array_host(cw)
+        inj = global_injector()
+        inj.inject("codec.launch", 5, hits=1)
+        try:
+            ticket = agg.submit(ec, cw)
+            got = np.asarray(ticket)
+        finally:
+            inj.clear("codec.launch")
+            device_guard().mark_healthy()
+        assert np.array_equal(got, want)
+        assert got[1] != 0 and not got[0] and not got[2]
+        assert agg.perf.get("host_fallbacks") >= 1
+
+
+def make_sched(clock) -> LaunchScheduler:
+    return LaunchScheduler(
+        profiles={
+            SchedClass.CLIENT: ClientProfile(reservation=1.0, weight=2.0),
+            SchedClass.RECOVERY: ClientProfile(weight=1.0),
+            SchedClass.SCRUB: ClientProfile(weight=0.5),
+            SchedClass.BEST_EFFORT: ClientProfile(weight=0.5),
+        },
+        clock=clock,
+    )
+
+
+class TestLaunchSchedulerOrdering:
+    def test_client_dequeues_ahead_of_saturating_background(self):
+        """A saturating background verify stream is queued FIRST; client
+        launches enqueued after it must still dequeue ahead of (all but
+        the already-matured head of) the background backlog."""
+        sched = make_sched(clock=lambda: 0.0)
+        order: list[str] = []
+        for i in range(20):
+            sched.submit_async(
+                SchedClass.SCRUB, lambda i=i: order.append(f"bg{i}"),
+                cost=1 << 20,
+            )
+        for i in range(4):
+            sched.submit_async(
+                SchedClass.CLIENT, lambda i=i: order.append(f"client{i}"),
+                cost=4096,
+            )
+        assert sched.queue_depths() == {
+            "client": 4, "recovery": 0, "background": 20,
+        }
+        ran = sched.drain()
+        assert ran == 24
+        client_pos = [order.index(f"client{i}") for i in range(4)]
+        # every client launch runs before the background backlog's tail:
+        # at most the head background item (whose proportional tag had
+        # already matured) may precede them
+        assert max(client_pos) < 5, order[:8]
+        assert order.index("client0") < order.index("bg1"), order[:6]
+        # FIFO within the class
+        assert client_pos == sorted(client_pos)
+        counters = sched.perf_dump()
+        assert counters["client.dequeued"] == 4
+        assert counters["background.dequeued"] == 20
+        assert counters["background.queue_depth"] == 0
+
+    def test_background_drains_when_idle(self):
+        """No starvation the other way: with nothing else queued, the
+        background lane drains at full speed (work-conserving — limits
+        deprioritize, never idle the device)."""
+        now = [0.0]
+        sched = make_sched(clock=lambda: now[0])
+        done: list[int] = []
+        for i in range(10):
+            sched.submit_async(
+                SchedClass.SCRUB, lambda i=i: done.append(i), cost=1 << 20
+            )
+        assert sched.drain() == 10
+        assert done == list(range(10)), "idle background must drain FIFO"
+        assert sched.queue_depths()["background"] == 0
+
+    def test_limited_background_still_drains(self):
+        """Even with a hard limit configured, the scheduler serves the
+        nearest limit tag rather than idling (the work-conserving
+        clause) — scrub slows under contention but never wedges."""
+        sched = make_sched(clock=lambda: 0.0)
+        sched.configure(background=ClientProfile(weight=0.5, limit=1.0))
+        done: list[int] = []
+        for i in range(5):
+            sched.submit_async(
+                SchedClass.SCRUB, lambda i=i: done.append(i), cost=1 << 20
+            )
+        assert sched.drain() == 5
+        assert done == list(range(5))
+
+    def test_submit_blocks_until_own_launch_ran_cross_thread(self):
+        """A submitter whose launch is executed by ANOTHER thread's
+        drain still gets its own result (the cross-thread rendezvous),
+        and a raising launch surfaces at its own submitter."""
+        sched = make_sched(clock=lambda: 0.0)
+        release = threading.Event()
+        started = threading.Event()
+
+        def blocker():
+            started.set()
+            release.wait(5.0)
+            return "bg-done"
+
+        results: dict[str, object] = {}
+
+        def bg():
+            results["bg"] = sched.submit(SchedClass.SCRUB, blocker, cost=4096)
+
+        def client_ok():
+            results["ok"] = sched.submit(
+                SchedClass.CLIENT, lambda: "client-done", cost=4096
+            )
+
+        def client_raise():
+            try:
+                sched.submit(
+                    SchedClass.CLIENT,
+                    lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+                    cost=4096,
+                )
+            except RuntimeError as e:
+                results["err"] = str(e)
+
+        threads = [threading.Thread(target=bg)]
+        threads[0].start()
+        assert started.wait(5.0), "background launch never started"
+        threads += [
+            threading.Thread(target=client_ok),
+            threading.Thread(target=client_raise),
+        ]
+        for t in threads[1:]:
+            t.start()
+        release.set()
+        for t in threads:
+            t.join(10.0)
+            assert not t.is_alive(), "scheduler deadlocked a submitter"
+        assert results == {
+            "bg": "bg-done", "ok": "client-done", "err": "boom"
+        }
+
+    def test_reservation_knob_works_after_zero_reservation_history(self):
+        """Runtime-raising a lane's reservation must take effect even
+        after the lane enqueued under reservation=0: enqueue stores
+        r = inf as the class's last tag, and without the
+        update_profile tag reset the knob would be permanently inert
+        (max(now, inf + 1/res) stays inf forever)."""
+        now = [100.0]
+        sched = make_sched(clock=lambda: now[0])
+        # poison: background enqueues (and drains) with no reservation —
+        # the class's last R tag is stored as inf
+        sched.submit_async(SchedClass.SCRUB, lambda: None)
+        sched.drain()
+        # operator raises the background reservation at runtime
+        sched.configure(
+            background=ClientProfile(reservation=2.0, weight=0.5)
+        )
+        now[0] = 200.0
+        sched.submit_async(SchedClass.SCRUB, lambda: None)
+        tags = sched._mclock._queues[SchedClass.SCRUB][0][0]
+        assert tags.r != float("inf"), (
+            "reservation knob inert: last.r = inf survived update_profile"
+        )
+        assert tags.r <= now[0], "raised reservation must mature immediately"
+        sched.drain()
+
+    def test_lane_names(self):
+        assert lane_name(SchedClass.CLIENT) == "client"
+        assert lane_name(SchedClass.RECOVERY) == "recovery"
+        assert lane_name(SchedClass.SCRUB) == "background"
+        assert lane_name(SchedClass.BEST_EFFORT) == "background"
+
+
+class TestVerifyFlightRecords:
+    def test_verify_launch_record_carries_background_class(self):
+        """Aggregated verify launches stamp kind=verify and
+        sched_class=background on their flight records, and the trace
+        export renders the per-class lane (satellite: priority
+        inversions visible in Perfetto)."""
+        from ceph_tpu.ops.flight_recorder import flight_recorder
+        from ceph_tpu.tools.trace_export import (
+            export_chrome_trace,
+            validate_chrome_trace,
+        )
+
+        fr = flight_recorder()
+        fr.reset()
+        ec = make_rs(4, 2)
+        agg = VerifyAggregator(window=4)
+        np.asarray(agg.submit(ec, codewords(ec, 2, 256, seed=1)))
+        recs = [r for r in fr.records() if r["kind"] == "verify"]
+        assert recs, "verify launch left no flight record"
+        assert recs[-1]["sched_class"] == "background"
+        trace = export_chrome_trace(fr.records())
+        validate_chrome_trace(trace)
+        lanes = {
+            (e["pid"], e["tid"])
+            for e in trace["traceEvents"]
+            if e["pid"] == "sched class"
+        }
+        assert ("sched class", "background") in lanes, lanes
