@@ -1002,7 +1002,13 @@ def main() -> None:
                 chaos = json.load(f)
             out["chaos"] = {
                 k: chaos[k]
-                for k in ("chaos_p99_ms", "recovery_occupancy", "converged")
+                for k in (
+                    "chaos_p99_ms", "recovery_occupancy", "converged",
+                    # ISSUE 10 workload-attribution keys: the SLO burn
+                    # rate under mixed load, per-pool windowed p99, and
+                    # the trace-sampling verdicts (budget adherence)
+                    "slo_worst_burn_rate", "pool_p99_ms", "trace_sampling",
+                )
                 if k in chaos
             }
         except (OSError, json.JSONDecodeError) as e:

@@ -203,6 +203,11 @@ class Objecter(Dispatcher):
                 pool_id, oid, ops, timeout, ps, snap_seq, snaps, snap_id,
                 reqid, span,
             )
+        except TimeoutError:
+            # tail-based always-keep (ISSUE 10): a timed-out op keeps
+            # its trace even when head sampling dropped it
+            self.tracer.mark_keep(span)
+            raise
         finally:
             span.finish()
 

@@ -84,6 +84,38 @@ def recovery_stalled_detail(stalled: dict[str, dict]) -> list[str]:
     ]
 
 
+def slo_breach_summary(breaches: dict[str, dict]) -> str | None:
+    """The SLO_LATENCY_BREACH check summary for a per-pool breach slice
+    ({pid: {pool, target_ms, burn_fast, burn_slow, p99_ms}}), or None
+    when every pool is inside its latency objective.  Shared by the mgr
+    iostat module and the mon health check so the two surfaces agree."""
+    if not breaches:
+        return None
+    worst = max(v.get("burn_slow", 0.0) for v in breaches.values())
+    pools = ",".join(
+        sorted(str(v.get("pool", pid)) for pid, v in breaches.items())
+    )
+    return (
+        f"{len(breaches)} pool(s) burning their latency SLO error "
+        f"budget (worst burn rate {worst:.1f}x): [{pools}]"
+    )
+
+
+def slo_breach_detail(breaches: dict[str, dict]) -> list[str]:
+    """Per-pool breakdown lines (`health detail`)."""
+    lines = []
+    for pid, v in sorted(breaches.items()):
+        p99 = v.get("p99_ms")
+        p99_s = f"{p99:.1f} ms" if p99 is not None else "overflow"
+        lines.append(
+            f"pool {v.get('pool', pid)} (id {pid}): p99 {p99_s} vs "
+            f"{v.get('target_ms', 0.0):.1f} ms target, burn rate "
+            f"fast {v.get('burn_fast', 0.0):.1f}x / "
+            f"slow {v.get('burn_slow', 0.0):.1f}x"
+        )
+    return lines
+
+
 def scrub_errors_total(scrub: dict[str, dict]) -> int:
     """Total scrub errors across a per-PG slice ({pgid: {errors,
     inconsistent, ...}})."""

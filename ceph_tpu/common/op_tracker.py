@@ -16,13 +16,28 @@ from collections import deque
 
 
 class TrackedOp:
-    __slots__ = ("desc", "start", "events", "duration")
+    __slots__ = (
+        "desc", "start", "events", "duration",
+        # workload-attribution tags (ISSUE 10): which pool/client this op
+        # belongs to and its class (read/write/recovery) — what the OSD's
+        # IOAccountant and the mgr iostat module aggregate by
+        "pool_id", "client", "op_class",
+    )
 
-    def __init__(self, desc: str):
+    def __init__(
+        self,
+        desc: str,
+        pool_id: int = -1,
+        client: str = "",
+        op_class: str = "",
+    ):
         self.desc = desc
         self.start = time.monotonic()
         self.events: list[tuple[float, str]] = [(self.start, "initiated")]
         self.duration: float | None = None
+        self.pool_id = pool_id
+        self.client = client
+        self.op_class = op_class
 
     def mark_event(self, what: str) -> None:
         self.events.append((time.monotonic(), what))
@@ -31,6 +46,9 @@ class TrackedOp:
         now = time.monotonic()
         return {
             "description": self.desc,
+            "pool": self.pool_id,
+            "client": self.client,
+            "op_class": self.op_class,
             "age": round(now - self.start, 6),
             "duration": None if self.duration is None else round(self.duration, 6),
             "type_data": {
@@ -79,10 +97,25 @@ class OpTracker:
         """Runtime osd_op_history_size change (config observer)."""
         self.history = deque(self.history, maxlen=max(1, int(history_size)))
 
-    def create(self, desc: str) -> int:
-        """Register an op; returns the token finish() takes."""
+    def create(
+        self,
+        desc: str,
+        pool_id: int = -1,
+        client: str = "",
+        op_class: str = "",
+    ) -> int:
+        """Register an op; returns the token finish() takes.  The
+        attribution tags (pool, client, op class) ride the tracked op so
+        `dump_ops_in_flight` answers "whose op is stuck", and the OSD's
+        reply path feeds them into the IOAccountant at finish.
+
+        Registration is UNCONDITIONAL — trace sampling (ISSUE 10 layer 3)
+        gates span *retention*, never this registry, so a sampled-out op
+        still ages into the SLOW_OPS complaint accounting."""
         self._seq += 1
-        self._inflight[self._seq] = TrackedOp(desc)
+        self._inflight[self._seq] = TrackedOp(
+            desc, pool_id=pool_id, client=client, op_class=op_class
+        )
         if self._seq % 256 == 0:
             self._sweep_aborted()
         return self._seq

@@ -282,6 +282,46 @@ OPTIONS: dict[str, Option] = _opts(
         see_also=("ec_tpu_aggregate_window",),
         runtime=True,
     ),
+    # --- workload attribution + SLOs (ISSUE 10; mgr/iostat.py) --------------
+    Option("mgr_iostat_window_sec", float, 10.0, A,
+           "iostat rate window: per-pool/per-client IOPS, bytes/sec and "
+           "windowed p99 are computed over the last this-many seconds "
+           "of merged OSD reports (EMA-smoothed like the progress "
+           "module's rates)", runtime=True),
+    Option("mgr_iostat_top_clients", int, 10, A,
+           "how many clients the iostat module ranks in its "
+           "top-by-IOPS/bytes/p99 views (mgr asok `iostat top`, mon "
+           "`status`, and the ceph_tpu_top_client_* scrape families — "
+           "the scrape cardinality bound)", runtime=True),
+    Option("mgr_slo_latency_target_ms", float, 0.0, A,
+           "default per-pool op latency SLO target in milliseconds: the "
+           "objective is `mgr_slo_objective` of ops under this latency. "
+           "0 disables SLO evaluation.  Per-pool overrides via "
+           "mgr_slo_pool_latency_targets",
+           see_also=("mgr_slo_pool_latency_targets", "mgr_slo_objective"),
+           runtime=True),
+    Option("mgr_slo_pool_latency_targets", str, "", A,
+           "per-pool latency-target overrides as comma-separated "
+           "`<pool id or name>:<ms>` entries, e.g. `rbd:50,7:10`; pools "
+           "not listed use mgr_slo_latency_target_ms",
+           see_also=("mgr_slo_latency_target_ms",), runtime=True),
+    Option("mgr_slo_objective", float, 0.99, A,
+           "latency SLO objective: the target fraction of ops under the "
+           "pool's latency target; the error budget is 1 - objective "
+           "and burn rate = observed bad fraction / error budget",
+           runtime=True),
+    Option("mgr_slo_burn_threshold", float, 1.0, A,
+           "burn-rate threshold: SLO_LATENCY_BREACH raises when BOTH "
+           "the fast and slow windows burn above this (the multi-window "
+           "burn-rate alert shape: the fast window confirms it is "
+           "happening now, the slow window that it is not a blip); "
+           "clears when either window drops back under", runtime=True),
+    Option("mgr_slo_fast_window_sec", float, 10.0, A,
+           "fast burn-rate window (seconds)",
+           see_also=("mgr_slo_slow_window_sec",), runtime=True),
+    Option("mgr_slo_slow_window_sec", float, 60.0, A,
+           "slow burn-rate window (seconds)",
+           see_also=("mgr_slo_fast_window_sec",), runtime=True),
     Option(
         "mgr_progress_stall_sec",
         float,
@@ -422,6 +462,25 @@ OPTIONS: dict[str, Option] = _opts(
     Option("jaeger_tracing_enable", bool, False, A,
            "record spans through the EC data path in the in-process tracer "
            "(default off, matching the reference)", runtime=True),
+    Option("op_trace_sample_rate", float, 1.0, A,
+           "head-sampling probability for op traces (ISSUE 10): the "
+           "retention decision is made once at the client/messenger "
+           "entry and carried on the message envelope so every "
+           "downstream span honors it.  Sampled-out ops still register "
+           "in the OpTracker (SLOW_OPS accounting is never sampled) and "
+           "still keep their FULL trace if they exceed the complaint "
+           "age or error (tail-based always-keep).  1.0 = record "
+           "everything (pre-sampling behavior)",
+           see_also=("op_trace_budget_per_sec", "jaeger_tracing_enable"),
+           runtime=True),
+    Option("op_trace_budget_per_sec", float, 0.0, A,
+           "token-bucket retention budget: head-sampled traces retained "
+           "per second (burst = one second's worth).  Rate-accepted "
+           "traces that find the bucket empty fall back to provisional "
+           "(tail-keep still rescues slow/errored ops), so always-on "
+           "tracing under the traffic harness cannot exceed this span "
+           "budget.  <= 0 = unlimited",
+           see_also=("op_trace_sample_rate",), runtime=True),
     # --- mgr modules --------------------------------------------------------
     Option("telemetry_salt", str, "", A,
            "cluster-persistent salt for the telemetry report's anonymized "

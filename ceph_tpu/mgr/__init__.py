@@ -2,6 +2,7 @@
 src/pybind/mgr)."""
 
 from .dashboard import DashboardModule
+from .iostat import IostatModule
 from .mgr import Mgr
 from .modules import MgrModule
 from .orchestrator import OrchBackend, OrchestratorModule, ServiceSpec
@@ -10,6 +11,7 @@ from .telemetry import TelemetryModule
 
 __all__ = [
     "DashboardModule",
+    "IostatModule",
     "Mgr",
     "MgrModule",
     "OrchBackend",

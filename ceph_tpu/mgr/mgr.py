@@ -68,6 +68,7 @@ class Mgr(Dispatcher):
         self._tasks: list[asyncio.Task] = []
         self._running = False
         self.beacon_interval = 1.0
+        self.admin_socket = None
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -81,12 +82,55 @@ class Mgr(Dispatcher):
         await self.monc.subscribe("mgrmap")
         self._tasks.append(asyncio.create_task(self._beacon_loop()))
         self._tasks.append(asyncio.create_task(self._module_loop()))
+        await self._start_admin_socket()
+
+    async def _start_admin_socket(self) -> None:
+        """Mgr admin socket (the `ceph tell mgr.*` surface): the iostat
+        / top-clients views live here so an operator can ask "who is
+        driving the load" without a prometheus stack (ISSUE 10)."""
+        try:
+            path = self.conf.get("admin_socket")
+        except KeyError:
+            path = ""
+        if not path:
+            return
+        from ..common.admin_socket import AdminSocket
+
+        sock = AdminSocket(path)
+
+        def _iostat_module():
+            for module in self.modules:
+                if getattr(module, "NAME", "") == "iostat":
+                    return module
+            raise ValueError("iostat module not registered")
+
+        sock.register(
+            "iostat top",
+            lambda cmd: {
+                "clients": _iostat_module().top_clients(
+                    n=int(cmd["n"]) if "n" in cmd else None,
+                    by=cmd.get("by", "ops_rate"),
+                )
+            },
+            "top-N clients by IOPS/bytes/p99 (args: n, "
+            "by=ops_rate|bytes_rate|p99)",
+        )
+        sock.register(
+            "iostat",
+            lambda cmd: {"pools": _iostat_module().iostat()},
+            "per-pool IO rates, windowed p99, cumulative totals",
+        )
+        await sock.start()
+        self.admin_socket = sock
 
     async def stop(self) -> None:
         self._running = False
         for t in self._tasks:
             t.cancel()
         self._tasks.clear()
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         await self.msgr.shutdown()
         await self.monc.msgr.shutdown()
 
@@ -138,10 +182,23 @@ class Mgr(Dispatcher):
                 ("pool_bytes", "used_raw"),
             ):
                 for pid, v in (status.get(key) or {}).items():
-                    name = names.get(pid, f"pool{pid}")
+                    # a pool deleted mid-report has stats from OSDs that
+                    # have not yet dropped its PGs but no name in our
+                    # osdmap: keep the record id-keyed and flagged
+                    # rather than fabricating a "pool<N>" name that
+                    # could shadow (or be shadowed by) a real pool.
+                    # The "id:" prefix keeps the key out of the name
+                    # namespace entirely — pool NAMES are arbitrary
+                    # strings, so a live pool literally named "7" must
+                    # not merge with deleted pool id 7
+                    name = names.get(pid)
                     rec = pools.setdefault(
-                        name, {"stored": 0, "objects": 0, "used_raw": 0}
+                        name if name is not None else f"id:{pid}",
+                        {"stored": 0, "objects": 0, "used_raw": 0},
                     )
+                    if name is None:
+                        rec["deleted"] = True
+                        rec["id"] = int(pid)
                     rec[field] += v
         osds = {
             daemon: sum((st.status or {}).get("pool_bytes", {}).values())
@@ -168,16 +225,29 @@ class Mgr(Dispatcher):
             # them and the mon's PG_RECOVERY_STALLED check reads the
             # `stalled` sub-slice.  Empty when no module is registered.
             "progress": self.progress_digest(),
+            # per-pool IO rates + top clients from the iostat module
+            # (ISSUE 10); `ceph_cli status` renders the pool rates and
+            # operators read top-N through the mgr asok
+            "iostat": self._module_digest("iostat_digest"),
+            # per-pool SLO burn-rate slice: the mon-side
+            # SLO_LATENCY_BREACH check reads `breaches`
+            "slo": self._module_digest("slo_digest"),
         }
 
-    def progress_digest(self) -> dict:
-        """The registered progress module's digest slice, or {} when the
-        module isn't loaded (modules are opt-in, like the reference's)."""
+    def _module_digest(self, hook: str) -> dict:
+        """A registered module's digest slice by hook name, or {} when
+        no module provides it (modules are opt-in, like the
+        reference's)."""
         for module in self.modules:
-            digest = getattr(module, "progress_digest", None)
+            digest = getattr(module, hook, None)
             if digest is not None:
                 return digest()
         return {}
+
+    def progress_digest(self) -> dict:
+        """The registered progress module's digest slice, or {} when the
+        module isn't loaded."""
+        return self._module_digest("progress_digest")
 
     def tpu_degraded_by_daemon(self) -> dict[str, dict]:
         """Daemons reporting a DEGRADED device backend (the OSD status'

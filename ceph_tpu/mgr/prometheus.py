@@ -32,6 +32,9 @@ def _perf_type(counter: str) -> str:
         or name == "backend_degraded"
         # launch-scheduler queue depth rises and falls with the queue
         or name == "queue_depth"
+        # trace-sampling exports (ISSUE 10): the live knobs and the
+        # provisional-trace depth are levels, not monotone counters
+        or name in ("sample_rate", "budget_per_sec", "pending_traces")
     ):
         return "gauge"
     return "counter"

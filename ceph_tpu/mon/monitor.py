@@ -485,6 +485,17 @@ class Monitor(Dispatcher):
             details["PG_RECOVERY_STALLED"] = health.recovery_stalled_detail(
                 stalled
             )
+        # pools burning their latency-SLO error budget (mgr iostat
+        # module digest slice, ISSUE 10): raise/clear like
+        # PG_RECOVERY_STALLED — the check drops when the load stops or
+        # either burn window recovers
+        breaches = (self.pg_digest.get("slo") or {}).get("breaches") or {}
+        summary = health.slo_breach_summary(breaches)
+        if summary:
+            checks["SLO_LATENCY_BREACH"] = summary
+            details["SLO_LATENCY_BREACH"] = health.slo_breach_detail(
+                breaches
+            )
         # scrub inconsistencies (ISSUE 9 satellite): the per-PG slice
         # the primaries reported through the mgr digest.  These are the
         # two HEALTH_ERR checks — shards disagree on user data — and
@@ -556,6 +567,13 @@ class Monitor(Dispatcher):
                             "progress": self.pg_digest.get(
                                 "progress", {}
                             ),
+                            # per-pool IO rates / windowed p99 + top
+                            # clients (mgr iostat module, ISSUE 10) —
+                            # who is driving the load, from `status`
+                            "iostat": self.pg_digest.get("iostat", {}),
+                            # per-pool SLO burn-rate slice (the health
+                            # check's evidence, machine-readable)
+                            "slo": self.pg_digest.get("slo", {}),
                         }
                     ).encode(),
                 )

@@ -120,6 +120,10 @@ class Message(Encodable):
     # across daemons; 0 = untraced
     trace_id = 0
     span_id = 0
+    # head-sampling decision carried with the context (ISSUE 10):
+    # 0 = no decision (untraced / legacy sender), 1 = sampled (keep),
+    # 2 = head-sampled out (downstream spans stay provisional)
+    trace_sampled = 0
 
     def __init__(self, **kwargs):
         self.src = ""
@@ -129,6 +133,7 @@ class Message(Encodable):
         for k, v in kwargs.items():
             if k not in {n for n, _ in self.FIELDS} | {
                 "src", "seq", "priority", "trace_id", "span_id",
+                "trace_sampled",
             }:
                 raise TypeError(f"{type(self).__name__} has no field {k}")
             setattr(self, k, v)
@@ -167,6 +172,7 @@ def encode_message(msg: Message) -> tuple[bytes, bytes]:
         .u8(msg.priority)
         .u64(msg.trace_id)
         .u64(msg.span_id)
+        .u8(msg.trace_sampled)
         .tobytes()
     )
     return env, msg.tobytes()
@@ -180,6 +186,7 @@ def decode_message(envelope: bytes, payload: bytes) -> Message:
     priority = d.u8()
     trace_id = d.u64()
     span_id = d.u64()
+    trace_sampled = d.u8()
     cls = _REGISTRY.get(type_id)
     if cls is None:
         raise ValueError(f"unknown message type {type_id}")
@@ -189,4 +196,5 @@ def decode_message(envelope: bytes, payload: bytes) -> Message:
     msg.priority = priority
     msg.trace_id = trace_id
     msg.span_id = span_id
+    msg.trace_sampled = trace_sampled
     return msg

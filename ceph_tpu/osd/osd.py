@@ -166,6 +166,13 @@ class OSD(Dispatcher):
         # op tracking (TrackedOp.h OpTracker; dumped via the admin socket)
         from ..common.op_tracker import OpTracker
 
+        # workload attribution (ISSUE 10): per-pool / per-client ops,
+        # bytes and log2 latency histograms sampled on the op reply and
+        # recovery paths; shipped in the status blob for the mgr iostat
+        # module to merge into cluster-wide rates
+        from ..common.io_accounting import IOAccountant
+
+        self.io_accountant = IOAccountant()
         self.op_tracker = OpTracker(
             history_size=self.conf.get("osd_op_history_size")
         )
@@ -189,6 +196,23 @@ class OSD(Dispatcher):
         self.conf.add_observer(
             ["jaeger_tracing_enable"],
             lambda _n, v: setattr(self.tracer, "enabled", bool(v)),
+        )
+        # budgeted trace sampling (ISSUE 10): head-sampling rate + span
+        # retention budget, runtime-mutable via the same observer
+        # pattern — what makes always-on tracing safe at harness scale
+        self.tracer.configure_sampling(
+            sample_rate=self.conf.get("op_trace_sample_rate"),
+            budget_per_sec=self.conf.get("op_trace_budget_per_sec"),
+        )
+        self.conf.add_observer(
+            ["op_trace_sample_rate"],
+            lambda _n, v: self.tracer.configure_sampling(sample_rate=float(v)),
+        )
+        self.conf.add_observer(
+            ["op_trace_budget_per_sec"],
+            lambda _n, v: self.tracer.configure_sampling(
+                budget_per_sec=float(v)
+            ),
         )
         # incoming trace-carrying messages get a messenger hop span
         # parent-linked to the sender (tracer.py inject/extract)
@@ -411,8 +435,21 @@ class OSD(Dispatcher):
                       "config values differing from defaults")
         sock.register(
             "dump_tracer",
-            lambda cmd: {"spans": self.tracer.export()},
-            "dump collected trace spans (EC data path)",
+            lambda cmd: {
+                "spans": self.tracer.export(),
+                "sampling": self.tracer.sampling_stats(),
+            },
+            "dump collected trace spans (EC data path) + sampling stats",
+        )
+        sock.register(
+            "dump_io_accounting",
+            lambda cmd: {
+                "pools": self.io_accountant.dump_pools(),
+                "clients": self.io_accountant.dump_clients(),
+                "totals": self.io_accountant.totals(),
+            },
+            "per-pool / per-client cumulative IO counters + latency "
+            "histograms (the iostat module's per-OSD input)",
         )
         sock.register(
             "dump_tracing",
@@ -681,6 +718,12 @@ class OSD(Dispatcher):
             perf[f"ec_decode_aggregator.{name}"] = val
         for name, val in self.verify_aggregator.perf.dump().items():
             perf[f"ec_verify_aggregator.{name}"] = val
+        # trace-sampling counters (ISSUE 10): sampled/kept/dropped +
+        # live knobs ride the report flat so the scrape carries
+        # ceph_tpu_trace_* families (rate/budget/pending are gauges,
+        # the rest monotonic counters — mgr/prometheus._perf_type)
+        for name, val in self.tracer.sampling_stats().items():
+            perf[f"trace.{name}"] = val
         # launch counters incl. sharded launches / devices-per-launch
         # (ops/dispatch.py): flat scalars, so the mgr prometheus scrape
         # exports one ceph_tpu_ec_dispatch_* family per counter
@@ -809,14 +852,23 @@ class OSD(Dispatcher):
 
     def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
         """enqueue_op (OSD.cc:9431): into the QoS scheduler."""
+        from .pg import op_class_of
+
         cost = sum(len(op.data) for op in msg.ops) or 4096
         self.perf.inc("op")
+        op_class = op_class_of(msg.ops)
         # OpTracker registration (OpRequest created at dispatch,
-        # TrackedOp::mark_event through the pipeline)
+        # TrackedOp::mark_event through the pipeline) with the
+        # attribution tags (ISSUE 10): pool, client, op class.
+        # UNCONDITIONAL — trace sampling gates span retention only, so
+        # a sampled-out op still ages into SLOW_OPS accounting.
         token = self.op_tracker.create(
             f"osd_op({msg.reqid.client}:{msg.reqid.tid} "
             f"{msg.pgid.pool}.{msg.pgid.ps} {msg.oid} "
-            f"[{','.join(str(op.op) for op in msg.ops)}])"
+            f"[{','.join(str(op.op) for op in msg.ops)}])",
+            pool_id=msg.pgid.pool,
+            client=msg.reqid.client,
+            op_class=op_class,
         )
         # op span: child of the messenger hop span when the delivery is
         # being traced, else adopted from the message's remote context
@@ -834,7 +886,10 @@ class OSD(Dispatcher):
             self.op_tracker.mark_event(token, "dequeued")
             span.event("dequeued")
             with tracer_mod.span_scope(span):
-                self._do_dispatch_op(conn, msg, token, span=span, cost=cost)
+                self._do_dispatch_op(
+                    conn, msg, token, span=span, cost=cost,
+                    op_class=op_class,
+                )
 
         self.sched.enqueue(
             WorkItem(run=run, klass=SchedClass.CLIENT, cost=cost)
@@ -843,7 +898,7 @@ class OSD(Dispatcher):
 
     def _do_dispatch_op(
         self, conn: Connection, msg: MOSDOp, token: int = 0, span=None,
-        cost: int | None = None,
+        cost: int | None = None, op_class: str | None = None,
     ) -> None:
         """dequeue_op (OSD.cc:9491) → PG::do_op."""
         pg = self._get_pg(msg.pgid)
@@ -851,12 +906,43 @@ class OSD(Dispatcher):
         t0 = time.monotonic()
         if cost is None:
             cost = sum(len(op.data) for op in msg.ops) or 4096
+        if op_class is None:
+            from .pg import op_class_of
+
+            op_class = op_class_of(msg.ops)
 
         def reply(rep: MOSDOpReply) -> None:
             self.op_tracker.finish(token)
             lat = time.monotonic() - t0
             self.perf.hinc("op_latency", lat)
             self.perf.hinc2("op_size_latency", cost, lat)
+            # workload attribution (ISSUE 10): writes account their
+            # payload bytes, reads what they returned.  -EAGAIN bounces
+            # (misdirected / not-yet-peered) are NOT accounted — the op
+            # was never executed and the client's retry will be, so
+            # counting both would inflate the pool's ops over what the
+            # client actually submitted
+            from ..common.errs import EAGAIN
+
+            if rep.result != -EAGAIN:
+                # real payload bytes, NOT `cost` — the QoS cost floors
+                # zero-payload ops (delete/create/truncate) at 4096,
+                # which would add phantom write bytes to the pool and
+                # client views
+                nbytes = (
+                    sum(len(op.data) for op in msg.ops)
+                    if op_class == "write"
+                    else sum(len(d) for d in (rep.outdata or []))
+                )
+                self.io_accountant.account(
+                    msg.pgid.pool, msg.reqid.client, op_class, nbytes, lat
+                )
+            # tail-based always-keep (ISSUE 10 sampling): an op that
+            # crossed the complaint age or errored keeps its FULL trace
+            # even when head sampling dropped it — the traces worth
+            # reading are exactly the ones sampling must not lose
+            if lat >= self.op_tracker.complaint_time or rep.result < 0:
+                self.tracer.mark_keep(op_span)
             op_span.event("reply sent")
             op_span.finish()
 
@@ -1215,6 +1301,15 @@ def _osd_status(osd: "OSD") -> dict:
         # in-flight ops older than osd_op_complaint_time (OpTracker) —
         # aggregated by the mgr into the digest that raises SLOW_OPS
         "slow_ops": {"count": slow_count, "oldest_sec": slow_oldest},
+        # workload attribution (ISSUE 10): cumulative per-pool /
+        # per-client ops, bytes and log2 latency histograms from the op
+        # reply + recovery paths — the mgr iostat module merges these
+        # across OSDs into windowed rates, top-client views, and the
+        # SLO burn-rate evaluation
+        "pool_io": osd.io_accountant.dump_pools(),
+        "client_io": osd.io_accountant.dump_clients(),
+        # trace-sampling verdicts (sampled/kept/dropped + live knobs)
+        "trace_sampling": osd.tracer.sampling_stats(),
         # per-PG recovery/backfill/scrub progress events from the
         # primaries this OSD hosts (PG.progress_status) — the mgr's
         # progress module turns them into bars with rate + ETA and the

@@ -124,6 +124,13 @@ def op_is_write(op: OSDOp) -> bool:
     return op.op in WRITE_OPS
 
 
+def op_class_of(ops) -> str:
+    """Attribution class for a whole MOSDOp (ISSUE 10): write if ANY
+    sub-op writes, else read — the single source for the QoS/accounting
+    classification."""
+    return "write" if any(op_is_write(op) for op in ops) else "read"
+
+
 class PG(PGListener):
     """One placement group hosted by an OSD (possibly one shard of it)."""
 
@@ -1726,6 +1733,14 @@ class PG(PGListener):
         are not recovery."""
         if oid in self.recovering:
             self._recovery_done_bytes += int(nbytes)
+            # workload attribution (ISSUE 10): recovery traffic counts
+            # against its pool under the `recovery` op class, so the
+            # iostat view separates tenant load from the cluster's own
+            accountant = getattr(self.osd, "io_accountant", None)
+            if accountant is not None:
+                accountant.account(
+                    self.pool.id, "recovery", "recovery", nbytes
+                )
 
     def progress_status(self) -> list[dict]:
         """Progress events for the OSD status blob (ISSUE 8): one entry

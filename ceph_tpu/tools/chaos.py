@@ -63,6 +63,12 @@ def _osd_conf(i: int):
     )
 
 
+def _osd_complaint_default() -> float:
+    from ceph_tpu.common.options import OPTIONS
+
+    return float(OPTIONS["osd_op_complaint_time"].default)
+
+
 async def _wait_until(pred, timeout: float, what: str) -> None:
     deadline = time.monotonic() + timeout
     while not pred():
@@ -137,6 +143,20 @@ async def _run(cfg: dict) -> dict:
 
     progress_mod = ProgressModule()
     mgr.register_module(progress_mod)
+    # iostat module (ISSUE 10): per-pool/per-client rates + SLO burn
+    # rates over short pinned windows so the mixed-load phase can
+    # assert the burn stays under bound within a smoke-scale run.  The
+    # latency target is the scrub QoS bound — generous for shared CI
+    # hosts; the assertion catches seconds-scale starvation, not noise.
+    from ceph_tpu.mgr.iostat import IostatModule
+
+    iostat_mod = IostatModule(
+        window_sec=2.0,
+        slo_target_ms=cfg["slo_target_ms"],
+        slo_fast_window_sec=0.5,
+        slo_slow_window_sec=1.5,
+    )
+    mgr.register_module(iostat_mod)
     await mgr.start()
     await mgr.wait_for_active()
     progress_pgs_seen: set[tuple] = set()
@@ -177,6 +197,92 @@ async def _run(cfg: dict) -> dict:
             assert back == expected[f"base{i % cfg['objects']}"]
         inj.clear("msgr.send")
         report["events"].append("socket-fault load survived")
+
+        # ---- phase 1.5: workload attribution + SLO + budgeted tracing ---
+        # Mixed multi-pool load (EC chaospool + a replicated pool) with
+        # ALWAYS-ON sampled tracing: head rate 1%, a token-bucket span
+        # budget, and a forced complaint-age op proving tail always-keep.
+        # Asserts the three ISSUE 10 promises at once: per-pool rates
+        # and p99 attribute the load, the SLO burn rate stays under
+        # bound while the cluster is healthy, and span retention honors
+        # the budget WITHOUT losing the slow op's trace.
+        await client.pool_create(
+            "chaosrep", "replicated", size=min(2, cfg["osds"]),
+            pg_num=cfg["pg_num"],
+        )
+        io_rep = await client.open_ioctx("chaosrep")
+        expected_rep: dict[str, bytes] = {}
+        for o in osds:
+            o.conf.set("jaeger_tracing_enable", True)
+            o.conf.set("op_trace_sample_rate", cfg["trace_sample_rate"])
+            o.conf.set("op_trace_budget_per_sec", cfg["trace_budget"])
+        sample_t0 = time.monotonic()
+        for i in range(cfg["objects"]):
+            await put(f"mix{i}", 8192)
+            data = bytes(rng.getrandbits(8) for _ in range(4096))
+            await io_rep.write_full(f"rep{i}", data)
+            expected_rep[f"rep{i}"] = data
+            back = await io.read(f"base{i % cfg['objects']}")
+            assert back == expected[f"base{i % cfg['objects']}"]
+            if i % 4 == 0:
+                iostat_mod.tick()
+        # force complaint-age ops: with the complaint window at zero,
+        # every op finishing counts as slow — its trace must be KEPT
+        # whatever the 1% head rate said
+        for o in osds:
+            o.op_tracker.complaint_time = 0.0
+        await put("slowmix", 8192)
+        await io_rep.write_full("repslow", b"s" * 4096)
+        for o in osds:
+            o.op_tracker.complaint_time = _osd_complaint_default()
+        sample_elapsed = time.monotonic() - sample_t0
+        iostat_mod.tick()
+        for o in osds:
+            o.conf.set("jaeger_tracing_enable", False)
+            o.conf.set("op_trace_sample_rate", 1.0)
+            o.conf.set("op_trace_budget_per_sec", 0.0)
+        stats = [o.tracer.sampling_stats() for o in osds]
+        agg = {
+            k: sum(s[k] for s in stats)
+            for k in ("sampled", "unsampled", "dropped_budget",
+                      "kept_tail", "retained_spans")
+        }
+        report["trace_sampling"] = agg
+        # retention within the token-bucket budget: head-sampled traces
+        # are the budget-charged ones, bounded per daemon by refill
+        # over the phase plus one burst
+        budget_bound = len(osds) * (
+            cfg["trace_budget"] * sample_elapsed + cfg["trace_budget"] + 1
+        )
+        assert agg["sampled"] <= budget_bound, (
+            f"chaos: {agg['sampled']} head-sampled traces exceeded the "
+            f"budget bound {budget_bound:.0f}"
+        )
+        assert agg["unsampled"] >= 1, (
+            "chaos: a 1% sample rate under mixed load sampled everything"
+            f" ({agg})"
+        )
+        assert agg["kept_tail"] >= 1, (
+            f"chaos: complaint-age ops lost their traces to sampling ({agg})"
+        )
+        # SLO burn under bound while healthy + per-pool p99 attribution
+        report["slo_worst_burn_rate"] = round(
+            iostat_mod.worst_burn_rate("slow"), 3
+        )
+        assert report["slo_worst_burn_rate"] <= cfg["slo_burn_bound"], (
+            f"chaos: SLO burn rate {report['slo_worst_burn_rate']} over "
+            f"the {cfg['slo_burn_bound']} bound during mixed load"
+        )
+        iostat_view = iostat_mod.iostat()
+        report["pool_p99_ms"] = {
+            rec["pool"]: rec["p99_ms"] for rec in iostat_view.values()
+        }
+        assert any(
+            rec["ops_total"] > 0 for rec in iostat_view.values()
+        ), "chaos: iostat attributed no ops to any pool"
+        report["events"].append(
+            "mixed-load attribution + SLO + sampled tracing held"
+        )
 
         # ---- phase 2: shard-read EIO burst ------------------------------
         # counted hits so the run converges deterministically: early reads
@@ -385,6 +491,9 @@ async def _run(cfg: dict) -> dict:
         for oid, data in expected.items():
             if await io.read(oid) != data:
                 lost += 1
+        for oid, data in expected_rep.items():
+            if await io_rep.read(oid) != data:
+                lost += 1
         report["lost_writes"] = lost
         report["converged"] = lost == 0
 
@@ -431,6 +540,12 @@ async def _run(cfg: dict) -> dict:
             o.msgr.resends + o.monc.msgr.resends for o in live
         ) + client.objecter.msgr.resends
         report["op_resends"] = int(client.objecter.perf.get("op_resend"))
+        # the final snapshot re-waits health_clear: the metrics section
+        # above takes long enough for one stale beacon (e.g. a status
+        # blob sampled mid-probe) to transiently re-raise a check the
+        # run already proved clear — capture a settled view, not a race
+        await _wait_until(health_clear, 10.0,
+                          "health to settle for the final snapshot")
         report["health_checks"] = mons[0].health_checks()[0]
     finally:
         inj.clear()
@@ -475,6 +590,15 @@ def run_chaos(
         # CI hosts — the assertion exists to catch scrub BLOCKING the
         # client lane (seconds-scale stalls), not to benchmark
         "scrub_p99_bound_ms": 2000.0 if smoke else 1000.0,
+        # ISSUE 10 mixed-load gates: the pool latency SLO target (same
+        # generosity rationale as the scrub bound — the burn-rate
+        # assertion catches seconds-scale starvation, not CI noise),
+        # the burn bound the mixed phase must stay under, and the
+        # always-on trace sampling knobs (1% head rate + span budget)
+        "slo_target_ms": 2000.0 if smoke else 1000.0,
+        "slo_burn_bound": 1.0,
+        "trace_sample_rate": 0.01,
+        "trace_budget": 10.0,
     }
     return asyncio.run(_run(cfg))
 

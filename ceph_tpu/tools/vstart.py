@@ -125,7 +125,18 @@ class DevCluster:
             self.mgr = Mgr(
                 "x",
                 self.monmap,
-                conf=Config({"name": "mgr.x", **self.conf_overrides}, env=False),
+                conf=Config(
+                    {
+                        "name": "mgr.x",
+                        **(
+                            {"admin_socket": self._asok("mgr.x")}
+                            if self.asok_dir
+                            else {}
+                        ),
+                        **self.conf_overrides,
+                    },
+                    env=False,
+                ),
             )
             self.mgr.beacon_interval = 0.5
             await self.mgr.start()
@@ -133,6 +144,7 @@ class DevCluster:
             # standard module set (vstart.sh enables the same four)
             from ..mgr import (
                 DashboardModule,
+                IostatModule,
                 OrchestratorModule,
                 ProgressModule,
                 TelemetryModule,
@@ -147,6 +159,12 @@ class DevCluster:
                 # recovery/backfill/scrub bars with rate + ETA in
                 # `status`, PG_RECOVERY_STALLED health (ISSUE 8)
                 ProgressModule(),
+                # per-pool IO rates / top clients in `status`, the SLO
+                # burn-rate health check, and the ceph_tpu_pool_*
+                # scrape families (ISSUE 10) — registered here so the
+                # operator path sees pool rates out of the box (the
+                # same gap PR 6 closed for progress)
+                IostatModule(),
             ):
                 self.mgr.register_module(module)
         if self.with_mds:
@@ -267,6 +285,11 @@ class DevCluster:
                 for o in self.osds
                 if o.conf.get("admin_socket")
             },
+            **(
+                {"mgr.x": self.mgr.conf.get("admin_socket")}
+                if self.mgr is not None and self.mgr.conf.get("admin_socket")
+                else {}
+            ),
         }
         if socks:
             info["admin_sockets"] = socks

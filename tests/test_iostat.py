@@ -1,0 +1,1046 @@
+"""Workload attribution at scale (ISSUE 10): per-pool/per-client IO
+accounting, the mgr iostat module (rates / top clients / windowed p99),
+SLO burn-rate health, and budgeted trace sampling.
+
+The acceptance test boots an 8-OSD multi-pool cluster under mixed load
+and checks the whole spine end to end: per-pool IOPS/bytes/p99 in mon
+`status` and on the prometheus scrape whose totals reconcile with the
+OSD-side op counters; driving one pool past its latency target raises
+``SLO_LATENCY_BREACH`` with that pool in the detail and clears when the
+load stops; and with a 1% sample rate under the same load, span
+retention stays within the token-bucket budget while every
+complaint-age-exceeding op keeps its full trace.
+"""
+
+import asyncio
+import json
+import time
+from types import SimpleNamespace
+
+from ceph_tpu.common import tracer as tracer_mod
+from ceph_tpu.common.io_accounting import OTHER_CLIENT, IOAccountant
+from ceph_tpu.mgr.iostat import IostatModule
+
+
+class TestIOAccountant:
+    def test_per_pool_per_class_accumulation(self):
+        acc = IOAccountant()
+        for _ in range(10):
+            acc.account(1, "client.a", "write", 4096, 0.002)
+        for _ in range(5):
+            acc.account(1, "client.b", "read", 8192, 0.01)
+        acc.account(2, "recovery", "recovery", 65536)
+        pools = acc.dump_pools()
+        assert pools["1"]["write"]["ops"] == 10
+        assert pools["1"]["write"]["bytes"] == 10 * 4096
+        assert pools["1"]["read"]["ops"] == 5
+        assert pools["2"]["recovery"]["bytes"] == 65536
+        # latency histograms are the standard cumulative dump shape
+        h = pools["1"]["write"]["lat"]["histogram"]
+        assert h["count"] == 10
+        assert h["buckets"][-1][0] == "+Inf"
+        assert h["buckets"][-1][1] == 10
+        clients = acc.dump_clients()
+        assert clients["1"]["client.a"]["ops"] == 10
+        assert clients["1"]["client.b"]["ops"] == 5
+        assert acc.totals() == {
+            "ops": 16, "bytes": 10 * 4096 + 5 * 8192 + 65536,
+        }
+
+    def test_idle_tracked_client_evicted_for_new_one(self):
+        """Client churn must not saturate the tracked slice forever:
+        an idle tracked client is folded into _other to admit a new
+        one, while an all-active slice never churns."""
+        acc = IOAccountant(max_clients_per_pool=2)
+        acc.account(1, "client.old", "write", 100, 0.001)
+        acc.account(1, "client.hot", "write", 100, 0.001)
+        # client.old departs (idle past the eviction bound)
+        acc._clients[1]["client.old"].last -= 2 * IOAccountant.IDLE_EVICT_SEC
+        acc.account(1, "client.new", "write", 100, 0.001)
+        clients = acc.dump_clients()["1"]
+        assert "client.new" in clients
+        assert "client.old" not in clients
+        assert clients[OTHER_CLIENT]["ops"] == 1  # folded, not lost
+        assert sum(c["ops"] for c in clients.values()) == 3
+        # everyone tracked is active: the next new client overflows
+        # into _other instead of displacing a live one
+        acc.account(1, "client.newer", "write", 100, 0.001)
+        clients = acc.dump_clients()["1"]
+        assert "client.newer" not in clients
+        assert "client.hot" in clients and "client.new" in clients
+        assert sum(c["ops"] for c in clients.values()) == 4
+
+    def test_client_slice_is_bounded(self):
+        acc = IOAccountant(max_clients_per_pool=4)
+        for i in range(32):
+            acc.account(1, f"client.{i}", "write", 100, 0.001)
+        clients = acc.dump_clients()["1"]
+        assert len(clients) <= 5  # 4 tracked + the overflow bucket
+        assert OTHER_CLIENT in clients
+        # nothing lost: the fold preserves totals
+        assert sum(c["ops"] for c in clients.values()) == 32
+        assert acc.totals()["ops"] == 32
+
+
+class _FakeMgr:
+    """The MgrModule surface the iostat module consumes."""
+
+    def __init__(self):
+        self.daemons: dict[str, dict] = {}
+        self.osdmap = SimpleNamespace(pools={})
+
+    def list_daemons(self):
+        return sorted(self.daemons)
+
+    def get_daemon_status(self, daemon):
+        return self.daemons[daemon]
+
+
+def _feed(mod, mgr, acc, daemon="osd.0"):
+    mgr.daemons[daemon] = {
+        "pool_io": acc.dump_pools(),
+        "client_io": acc.dump_clients(),
+    }
+    mod.tick()
+
+
+class TestIostatModule:
+    def test_rates_p99_and_totals(self):
+        mod = IostatModule(window_sec=5.0)
+        mgr = _FakeMgr()
+        mgr.osdmap.pools = {1: SimpleNamespace(id=1, name="rbd")}
+        mod.mgr = mgr
+        acc = IOAccountant()
+        for _ in range(50):
+            acc.account(1, "client.a", "write", 4096, 0.004)
+        _feed(mod, mgr, acc)
+        time.sleep(0.05)
+        for _ in range(50):
+            acc.account(1, "client.a", "write", 4096, 0.004)
+        _feed(mod, mgr, acc)
+        view = mod.iostat()
+        rec = view["1"]
+        assert rec["pool"] == "rbd"
+        assert rec["write_ops"] == 100
+        assert rec["write_bytes"] == 100 * 4096
+        assert rec["ops_total"] == 100
+        assert rec["write_ops_per_sec"] > 0
+        assert rec["write_bytes_per_sec"] > 0
+        # 4 ms samples land in the (4.096, 8.192] ms log2 bucket
+        assert rec["p99_ms"] is not None
+        assert 4.0 <= rec["p99_ms"] <= 10.0
+
+    def test_restart_rebases_instead_of_negative_rates(self):
+        mod = IostatModule(window_sec=5.0)
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        acc = IOAccountant()
+        for _ in range(100):
+            acc.account(1, "client.a", "write", 1000, 0.001)
+        _feed(mod, mgr, acc)
+        assert mod.iostat()["1"]["write_ops"] == 100
+        # the daemon restarts: fresh accountant, counters rebase to 0
+        acc2 = IOAccountant()
+        for _ in range(3):
+            acc2.account(1, "client.a", "write", 1000, 0.001)
+        time.sleep(0.02)
+        _feed(mod, mgr, acc2)
+        rec = mod.iostat()["1"]
+        # the regression re-anchored: no double count, no negative delta
+        assert rec["write_ops"] == 100
+        assert rec["write_ops_per_sec"] >= 0.0
+        # post-restart deltas resume from the new baseline
+        for _ in range(7):
+            acc2.account(1, "client.a", "write", 1000, 0.001)
+        time.sleep(0.02)
+        _feed(mod, mgr, acc2)
+        assert mod.iostat()["1"]["write_ops"] == 107
+
+    def test_first_sight_import_does_not_seed_ema_rates(self):
+        """A fresh module (mgr failover) imports each OSD's boot-to-now
+        cumulative history as one first-sight delta; the totals want it
+        but the EMA rates must NOT — hours of ops divided by one tick
+        would report absurd IOPS until the 0.7-EMA decays (the same
+        failover hazard the window-delta warm-up anchor fixes for the
+        SLO/p99 path)."""
+        mod = IostatModule(window_sec=5.0)
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        acc = IOAccountant()
+        # long-running cluster history: 100k ops before the failover
+        for _ in range(100_000):
+            acc.account(1, "client.a", "write", 1000, 0.001)
+        mod.tick()  # dt-anchor tick (a fresh module's first tick)
+        time.sleep(0.02)
+        _feed(mod, mgr, acc)  # first sight: full cumulative import
+        rec = mod.iostat()["1"]
+        assert rec["write_ops"] == 100_000  # totals keep the import
+        assert rec["write_ops_per_sec"] == 0.0, rec  # rates do not
+        # genuine post-import deltas seed the rate normally
+        for _ in range(10):
+            acc.account(1, "client.a", "write", 1000, 0.001)
+        time.sleep(0.02)
+        _feed(mod, mgr, acc)
+        rec = mod.iostat()["1"]
+        assert rec["write_ops"] == 100_010
+        # the rate reflects the 10-op delta, not the 100k import
+        assert 0.0 < rec["write_ops_per_sec"] < 10_000, rec
+
+    def test_top_clients_ranks_and_bounds(self):
+        mod = IostatModule(window_sec=5.0, top_n=2)
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        acc = IOAccountant()
+        _feed(mod, mgr, acc)
+        time.sleep(0.05)
+        for i, n in (("a", 30), ("b", 10), ("c", 3)):
+            for _ in range(n):
+                acc.account(1, f"client.{i}", "write", 1000, 0.001)
+        _feed(mod, mgr, acc)
+        top = mod.top_clients()
+        assert len(top) == 2  # bounded by the pinned top_n
+        assert top[0]["client"] == "client.a"
+        assert top[0]["ops_per_sec"] >= top[1]["ops_per_sec"]
+        by_bytes = mod.top_clients(n=3, by="bytes_rate")
+        assert [r["client"] for r in by_bytes] == [
+            "client.a", "client.b", "client.c",
+        ]
+
+    def test_idle_client_expires_and_does_not_resurrect(self):
+        """OSDs keep reporting an expired client's (unchanged)
+        cumulative record forever; the zero delta must not resurrect
+        the series as a permanent zero row that can never expire."""
+        mod = IostatModule(window_sec=5.0)
+        mod.CLIENT_IDLE_EXPIRE_SEC = 0.05
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        acc = IOAccountant()
+        acc.account(1, "client.gone", "write", 1000, 0.001)
+        _feed(mod, mgr, acc)
+        assert ("1", "client.gone") in mod.clients
+        time.sleep(0.08)
+        _feed(mod, mgr, acc)  # idle past the expiry bound
+        assert ("1", "client.gone") not in mod.clients
+        # ...and STAYS gone while the OSD keeps re-reporting the record
+        for _ in range(3):
+            _feed(mod, mgr, acc)
+            assert ("1", "client.gone") not in mod.clients
+        assert mod.top_clients() == []
+        # a genuinely returning client re-tracks from its reappearance
+        acc.account(1, "client.gone", "write", 1000, 0.001)
+        _feed(mod, mgr, acc)
+        assert mod.clients[("1", "client.gone")].ops == 1
+
+    def test_prev_anchor_pruned_for_dropped_keys_only(self):
+        """The _prev delta anchors must not grow forever under client
+        churn: a key a LIVE daemon stopped reporting (evicted OSD-side)
+        is pruned after the grace period, while a DOWN daemon's anchors
+        survive so a partition heal resumes deltas instead of
+        re-importing boot-to-now history as one double-counting
+        delta."""
+        mod = IostatModule(window_sec=5.0)
+        mod.PREV_PRUNE_SEC = 0.05
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        acc = IOAccountant()
+        for _ in range(10):
+            acc.account(1, "client.churn", "write", 1000, 0.001)
+        _feed(mod, mgr, acc)
+        assert ("osd.0", "client", "1", "client.churn") in mod._prev
+        # the OSD evicts the client (key leaves the blob) but keeps
+        # reporting its pool counters
+        dump = {"pool_io": acc.dump_pools(), "client_io": {"1": {}}}
+        mgr.daemons["osd.0"] = dump
+        time.sleep(0.08)
+        mod.tick()
+        assert ("osd.0", "client", "1", "client.churn") not in mod._prev
+        # ...while the still-reported pool anchor survives
+        assert ("osd.0", "pool", "1", "write") in mod._prev
+        # now the daemon goes dark: its anchors must NOT age out
+        mgr._daemon_report_live = lambda d: False
+        time.sleep(0.08)
+        mod.tick()
+        assert ("osd.0", "pool", "1", "write") in mod._prev
+        # the partition heals with 5 more cumulative ops: the preserved
+        # anchor yields a delta of 5, not a re-import of all 15
+        for _ in range(5):
+            acc.account(1, "client.churn", "write", 1000, 0.001)
+        mgr._daemon_report_live = lambda d: True
+        _feed(mod, mgr, acc)
+        assert mod.pools[("1", "write")].ops == 15
+
+    def test_multi_osd_merge_reconciles(self):
+        mod = IostatModule(window_sec=5.0)
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        accs = [IOAccountant() for _ in range(3)]
+        for i, acc in enumerate(accs):
+            for _ in range(10 * (i + 1)):
+                acc.account(1, "client.a", "write", 500, 0.002)
+        for i, acc in enumerate(accs):
+            mgr.daemons[f"osd.{i}"] = {
+                "pool_io": acc.dump_pools(),
+                "client_io": acc.dump_clients(),
+            }
+        mod.tick()
+        rec = mod.iostat()["1"]
+        assert rec["write_ops"] == 60  # 10 + 20 + 30 across the OSDs
+        assert rec["write_bytes"] == 60 * 500
+        # the merged histogram count reconciles too
+        series = mod.pools[("1", "write")]
+        assert series.lat_count == 60
+
+
+class TestSLOBurnRate:
+    def _module(self, target_ms=10.0):
+        mod = IostatModule(
+            window_sec=2.0,
+            slo_target_ms=target_ms,
+            slo_fast_window_sec=0.4,
+            slo_slow_window_sec=0.8,
+            slo_burn_threshold=1.0,
+        )
+        mgr = _FakeMgr()
+        mgr.osdmap.pools = {1: SimpleNamespace(id=1, name="rbd")}
+        mod.mgr = mgr
+        return mod, mgr
+
+    def test_breach_raises_and_clears(self):
+        mod, mgr = self._module(target_ms=10.0)
+        acc = IOAccountant()
+        _feed(mod, mgr, acc)
+        # saturate both windows with over-target (50 ms) ops
+        for _round in range(3):
+            time.sleep(0.05)
+            for _ in range(40):
+                acc.account(1, "client.a", "write", 1000, 0.05)
+            _feed(mod, mgr, acc)
+        assert "1" in mod.breaches, mod.breaches
+        assert "SLO_LATENCY_BREACH" in mod.health_checks
+        detail = mod.breaches["1"]
+        assert detail["pool"] == "rbd"
+        assert detail["burn_fast"] > 1.0 and detail["burn_slow"] > 1.0
+        assert mod.worst_burn_rate("slow") > 1.0
+        # load stops: the windows drain and the check clears (the slow
+        # window outlives the breach clearing — the check drops as soon
+        # as EITHER window recovers)
+        deadline = time.monotonic() + 5.0
+        while (
+            mod.breaches or mod.worst_burn_rate("slow") > 0.0
+        ) and time.monotonic() < deadline:
+            time.sleep(0.1)
+            _feed(mod, mgr, acc)
+        assert not mod.breaches
+        assert "SLO_LATENCY_BREACH" not in mod.health_checks
+        assert mod.worst_burn_rate("slow") == 0.0
+
+    def test_straddling_bucket_does_not_breach(self):
+        """A pool fully MEETING its target must not breach: 9 ms ops
+        land in the (8.192, 16.384] ms log2 bucket, and counting that
+        straddling bucket as bad would snap a 10 ms target down to an
+        effective 8.192 ms — every op "slow", burn rate 100x, spurious
+        SLO_LATENCY_BREACH.  Only buckets entirely past the target
+        count."""
+        mod, mgr = self._module(target_ms=10.0)
+        acc = IOAccountant()
+        _feed(mod, mgr, acc)
+        for _round in range(3):
+            time.sleep(0.05)
+            for _ in range(40):
+                acc.account(1, "client.a", "write", 1000, 0.009)
+            _feed(mod, mgr, acc)
+        assert not mod.breaches, mod.breaches
+        assert mod.worst_burn_rate("fast") == 0.0
+        # 17 ms ops sit in (16.384, 32.768] — entirely past 10 ms: bad
+        for _round in range(3):
+            time.sleep(0.05)
+            for _ in range(40):
+                acc.account(1, "client.a", "write", 1000, 0.017)
+            _feed(mod, mgr, acc)
+        assert "1" in mod.breaches, mod.breaches
+
+    def test_mgr_restart_does_not_burn_imported_history(self):
+        """A fresh module (mgr failover) imports each OSD's entire
+        boot-to-now cumulative history as one first-sight delta; the
+        burn-rate windows must anchor past it, not treat hours of old
+        incident as if it happened inside a seconds-wide window."""
+        mod, mgr = self._module(target_ms=10.0)
+        acc = IOAccountant()
+        # an old incident: 500 ops way over target, long before failover
+        for _ in range(500):
+            acc.account(1, "client.a", "write", 1000, 0.5)
+        _feed(mod, mgr, acc)  # first sight: full cumulative import
+        assert not mod.breaches, mod.breaches
+        # healthy traffic keeps it clear right through warm-up
+        for _ in range(3):
+            time.sleep(0.05)
+            for _ in range(20):
+                acc.account(1, "client.a", "write", 1000, 0.001)
+            _feed(mod, mgr, acc)
+            assert not mod.breaches, mod.breaches
+        # ...while the cumulative totals still reconcile with the OSD
+        assert mod.pools[("1", "write")].ops == 560
+
+    def test_top_clients_p99_overflow_ranks_slowest_first(self):
+        """A client whose p99 lands in the +Inf overflow bucket is the
+        SLOWEST client — `iostat top by=p99` must rank it first, not
+        sort its None p99 as 0.0 and bury it."""
+        mod = IostatModule(window_sec=5.0)
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        acc = IOAccountant()
+        # birth feed: the windowed p99 cannot see a series' first-sight
+        # import (the blind spot), so the measured ops come after it
+        for c in ("client.slow", "client.ok"):
+            acc.account(1, c, "write", 100, 0.005)
+        _feed(mod, mgr, acc)
+        time.sleep(0.02)
+        for _ in range(5):
+            acc.account(1, "client.slow", "write", 100, 20.0)  # > 8.4 s
+        for _ in range(5):
+            acc.account(1, "client.ok", "write", 100, 0.005)
+        _feed(mod, mgr, acc)
+        top = mod.top_clients(n=2, by="p99")
+        assert [r["client"] for r in top] == ["client.slow", "client.ok"]
+        assert top[0]["p99_ms"] is None  # overflow renders unbounded
+
+    def test_top_clients_p99_is_windowed_not_lifetime(self):
+        """`iostat top by=p99` answers "who is slow NOW": a startup blip
+        (or a failover's boot-to-now import) must not keep a busy,
+        now-fast client ranked slowest forever — the ranking uses the
+        same windowed delta as the pool p99, not the lifetime cumulative
+        histogram."""
+        mod = IostatModule(window_sec=0.2)
+        mgr = _FakeMgr()
+        mod.mgr = mgr
+        acc = IOAccountant()
+        for c in ("client.a", "client.b"):  # birth feed (blind spot)
+            acc.account(1, c, "write", 100, 0.001)
+        _feed(mod, mgr, acc)
+        time.sleep(0.02)
+        # old incident: client.a very slow, client.b mildly slow
+        for _ in range(50):
+            acc.account(1, "client.a", "write", 100, 2.0)
+        for _ in range(50):
+            acc.account(1, "client.b", "write", 100, 0.1)
+        _feed(mod, mgr, acc)
+        assert [r["client"] for r in mod.top_clients(n=2, by="p99")][0] \
+            == "client.a"
+        # the incident ages out of the window; NOW client.b is slower
+        time.sleep(0.3)
+        for _ in range(20):
+            acc.account(1, "client.a", "write", 100, 0.001)
+        for _ in range(20):
+            acc.account(1, "client.b", "write", 100, 0.1)
+        _feed(mod, mgr, acc)
+        top = mod.top_clients(n=2, by="p99")
+        assert [r["client"] for r in top] == ["client.b", "client.a"], top
+        # and the rendered p99 reflects the window, not the 2 s history
+        assert top[1]["p99_ms"] is not None and top[1]["p99_ms"] < 100
+
+    def test_under_target_load_never_breaches(self):
+        mod, mgr = self._module(target_ms=1000.0)
+        acc = IOAccountant()
+        _feed(mod, mgr, acc)
+        for _round in range(3):
+            time.sleep(0.05)
+            for _ in range(40):
+                acc.account(1, "client.a", "write", 1000, 0.002)
+            _feed(mod, mgr, acc)
+        assert not mod.breaches
+        assert mod.worst_burn_rate("slow") == 0.0
+
+    def test_per_pool_override_wins(self):
+        mod, mgr = self._module(target_ms=1000.0)
+        mod._pins["mgr_slo_pool_latency_targets"] = "rbd:5"
+        mod._conf["mgr_slo_pool_latency_targets"] = "rbd:5"
+        # name-matched override: 5 ms for pool "rbd" (id 1)
+        assert abs(mod.slo_target_sec("1") - 0.005) < 1e-9
+        # id-matched syntax works too
+        mod._conf["mgr_slo_pool_latency_targets"] = "1:7"
+        assert abs(mod.slo_target_sec("1") - 0.007) < 1e-9
+        # unlisted pools use the default
+        assert abs(mod.slo_target_sec("9") - 1.0) < 1e-9
+
+
+class TestTraceSampling:
+    def test_head_rate_zero_drops_everything(self):
+        t = tracer_mod.Tracer("x", enabled=True, sample_rate=0.0)
+        root = t.start_span("client:op")
+        child = root.child("osd:op")
+        child.finish()
+        root.finish()
+        assert t.export() == []
+        stats = t.sampling_stats()
+        assert stats["unsampled"] == 1
+        assert stats["dropped_tail"] == 1
+        assert stats["pending_traces"] == 0
+
+    def test_tail_keep_retains_full_trace(self):
+        t = tracer_mod.Tracer("x", enabled=True, sample_rate=0.0)
+        root = t.start_span("client:op")
+        child = root.child("osd:op")
+        child.event("reached_pg")
+        t.mark_keep(child)  # complaint-age / error verdict
+        child.finish()
+        root.finish()
+        names = sorted(s["name"] for s in t.export())
+        assert names == ["client:op", "osd:op"]
+        # the rescued spans kept their collected events
+        osd = next(s for s in t.export() if s["name"] == "osd:op")
+        assert [e["name"] for e in osd["events"]] == ["reached_pg"]
+        assert t.sampling_stats()["kept_tail"] == 1
+
+    def test_token_bucket_budget_bounds_retention(self):
+        t = tracer_mod.Tracer(
+            "x", enabled=True, sample_rate=1.0, budget_per_sec=3.0
+        )
+        for _ in range(20):
+            t.start_span("r").finish()
+        stats = t.sampling_stats()
+        # burst = one second's refill: exactly 3 head-sampled through
+        assert stats["sampled"] == 3, stats
+        assert stats["dropped_budget"] == 17, stats
+        assert len(t.export()) == 3
+
+    def test_budget_rejected_still_tail_keepable(self):
+        t = tracer_mod.Tracer(
+            "x", enabled=True, sample_rate=1.0, budget_per_sec=1.0
+        )
+        t.start_span("a").finish()  # consumes the only token
+        slow = t.start_span("slow-op")
+        assert slow.provisional
+        t.mark_keep(slow)
+        slow.finish()
+        assert {s["name"] for s in t.export()} == {"a", "slow-op"}
+
+    def test_enabling_budget_at_runtime_starts_with_full_burst(self):
+        """Raising op_trace_budget_per_sec from 0 (disabled) must start
+        the token bucket at the documented one-second burst — not empty,
+        which would count the first traces dropped_budget."""
+        t = tracer_mod.Tracer(
+            "x", enabled=True, sample_rate=1.0, budget_per_sec=0.0
+        )
+        t.configure_sampling(budget_per_sec=2.0)
+        for _ in range(3):
+            t.start_span("r").finish()
+        stats = t.sampling_stats()
+        assert stats["sampled"] == 2, stats
+        assert stats["dropped_budget"] == 1, stats
+        # lowering still clamps the bucket to the new capacity
+        t.configure_sampling(budget_per_sec=0.5)
+        assert t._tokens <= t._budget_cap()
+
+    def test_fractional_budget_still_admits_traces(self):
+        """0 < op_trace_budget_per_sec < 1 means "one trace every
+        1/budget seconds", not "none": the bucket capacity must hold at
+        least one whole token or a fractional budget silently drops
+        every head-sampled trace forever."""
+        t = tracer_mod.Tracer(
+            "x", enabled=True, sample_rate=1.0, budget_per_sec=0.5
+        )
+        t.start_span("first").finish()
+        stats = t.sampling_stats()
+        assert stats["sampled"] == 1, stats
+        assert stats["dropped_budget"] == 0, stats
+        # the next trace waits for refill (~2s away), it is not admitted
+        # immediately — the budget still bounds the rate
+        t.start_span("second").finish()
+        stats = t.sampling_stats()
+        assert stats["sampled"] == 1, stats
+        assert stats["dropped_budget"] == 1, stats
+        # ...and a runtime enable of a fractional budget bursts to one
+        # whole token, not a forever-starved fraction
+        t2 = tracer_mod.Tracer(
+            "y", enabled=True, sample_rate=1.0, budget_per_sec=0.0
+        )
+        t2.configure_sampling(budget_per_sec=0.25)
+        t2.start_span("r").finish()
+        assert t2.sampling_stats()["sampled"] == 1
+
+    def test_envelope_carries_one_decision(self):
+        class Msg:
+            pass
+
+        cli = tracer_mod.Tracer("client", enabled=True, sample_rate=0.0)
+        root = cli.start_span("client:op")
+        msg = Msg()
+        tracer_mod.inject(root, msg)
+        assert msg.trace_sampled == tracer_mod.SAMPLED_DROP
+        # the receiving daemon samples at 100% locally, but honors the
+        # envelope: no re-rolling the decision downstream
+        osd = tracer_mod.Tracer("osd", enabled=True)
+        ctx = tracer_mod.extract(msg)
+        assert ctx.sampled == tracer_mod.SAMPLED_DROP
+        span = osd.start_span("osd:op", remote=ctx)
+        assert span.provisional
+        span.finish()
+        assert osd.export() == []
+        # a KEEP decision (from a sampling-ACTIVE sender that head-kept
+        # the trace) flows through untouched
+        cli2 = tracer_mod.Tracer(
+            "client", enabled=True, budget_per_sec=100.0
+        )
+        msg2 = Msg()
+        tracer_mod.inject(cli2.start_span("client:op"), msg2)
+        assert msg2.trace_sampled == tracer_mod.SAMPLED_KEEP
+        span2 = osd.start_span("osd:op", remote=tracer_mod.extract(msg2))
+        assert not span2.provisional
+        assert len(osd.export()) == 1
+
+    def test_unconfigured_client_defers_decision_to_osd(self):
+        """A tracing client WITHOUT the sampling knobs must not stamp
+        KEEP — that would silently bypass the OSD's head sampling and
+        span budget.  It stamps NONE; the first sampling-configured
+        daemon downstream makes the head decision."""
+
+        class Msg:
+            pass
+
+        cli = tracer_mod.Tracer("client", enabled=True)  # no knobs
+        msg = Msg()
+        tracer_mod.inject(cli.start_span("client:op"), msg)
+        assert msg.trace_sampled == tracer_mod.SAMPLED_NONE
+        # a sampling-configured OSD decides for itself
+        osd = tracer_mod.Tracer("osd", enabled=True, sample_rate=0.0)
+        span = osd.start_span("osd:op", remote=tracer_mod.extract(msg))
+        assert span.provisional
+        assert osd.sampling_stats()["unsampled"] == 1
+        # an unconfigured receiver keeps — the pre-sampling behavior
+        osd2 = tracer_mod.Tracer("osd2", enabled=True)
+        span2 = osd2.start_span("osd:op", remote=tracer_mod.extract(msg))
+        assert not span2.provisional
+
+    def test_none_envelope_decision_memoized_per_trace(self):
+        """The objecter re-injects the SAME context on every resend: a
+        NONE-stamped trace must get ONE head decision at the receiver —
+        not a fresh roll (and a fresh budget charge) per delivery that
+        could split the trace keep/drop."""
+
+        class Msg:
+            pass
+
+        cli = tracer_mod.Tracer("client", enabled=True)  # no knobs
+        msg = Msg()
+        tracer_mod.inject(cli.start_span("client:op"), msg)
+        assert msg.trace_sampled == tracer_mod.SAMPLED_NONE
+        ctx = tracer_mod.extract(msg)
+        # a keeping receiver charges its budget once for the whole trace
+        osd = tracer_mod.Tracer(
+            "osd", enabled=True, sample_rate=1.0, budget_per_sec=100.0
+        )
+        spans = [osd.start_span("osd:op", remote=ctx) for _ in range(10)]
+        assert not any(s.provisional for s in spans)
+        assert osd.sampling_stats()["sampled"] == 1
+        # a dropping receiver rejects once, and every delivery agrees
+        osd2 = tracer_mod.Tracer("osd2", enabled=True, sample_rate=0.0)
+        spans2 = [osd2.start_span("osd:op", remote=ctx) for _ in range(10)]
+        assert all(s.provisional for s in spans2)
+        assert osd2.sampling_stats()["unsampled"] == 1
+
+    def test_pending_eviction_prefers_nonkeep_and_commits_keep(self):
+        """The MAX_PENDING memory bound must not silently drop traces
+        mark_keep already rescued: eviction picks the oldest NON-keep
+        trace, and when everything pending is keep-flagged the evictee
+        is committed to the export ring instead of dropped."""
+        t = tracer_mod.Tracer("x", enabled=True, sample_rate=0.0)
+        t.MAX_PENDING = 4
+        spans = [t.start_span(f"s{i}") for i in range(4)]
+        t.mark_keep(spans[0])  # the oldest is a rescued slow op
+        s4 = t.start_span("s4")  # 5th trace forces an eviction
+        assert t.sampling_stats()["dropped_tail"] == 1
+        assert spans[0].trace_id in t._pending  # keep survived
+        assert spans[1].trace_id not in t._pending  # non-keep evicted
+        # all-keep: the next eviction commits rather than drops
+        for sp in (spans[2], spans[3], s4):
+            t.mark_keep(sp)
+        t.start_span("s5")
+        assert any(s["name"] == "s0" for s in t.export())
+        assert t.sampling_stats()["kept_tail"] == 1
+
+    def test_legacy_envelope_defaults_to_keep(self):
+        class Msg:
+            trace_id = 42
+            span_id = 7  # no trace_sampled attribute at all
+
+        ctx = tracer_mod.extract(Msg())
+        assert ctx.sampled == tracer_mod.SAMPLED_KEEP
+
+    def test_envelope_field_survives_the_wire(self):
+        from ceph_tpu.msg.message import decode_message, encode_message
+        from ceph_tpu.msg.messages import MPing
+
+        msg = MPing(stamp=1.0)
+        msg.trace_id = 99
+        msg.span_id = 5
+        msg.trace_sampled = tracer_mod.SAMPLED_DROP
+        env, payload = encode_message(msg)
+        back = decode_message(env, payload)
+        assert back.trace_id == 99
+        assert back.trace_sampled == tracer_mod.SAMPLED_DROP
+
+    def test_defaults_behave_like_pre_sampling(self):
+        t = tracer_mod.Tracer("x", enabled=True)
+        span = t.start_span("a")
+        assert not span.provisional
+        assert len(t.export()) == 1  # retained at start, as before
+
+
+class TestSlowOpsUnderSampling:
+    def test_one_percent_sampling_still_raises_slow_ops(self):
+        """The ISSUE 10 bugfix regression: sampling gates span
+        retention, NOT OpTracker registration — a 1% sample rate must
+        not silence the PR 1 SLOW_OPS health warning."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.mgr import Mgr
+
+            from test_cluster import start_cluster, stop_cluster, wait_until
+
+            monmap, mons, osds = await start_cluster(1, 1)
+            mgr = Mgr("x", monmap)
+            mgr.beacon_interval = 0.1
+            await mgr.start()
+            await mgr.wait_for_active()
+            client = Rados(monmap)
+            await client.connect()
+
+            osd = osds[0]
+            osd.conf.set("jaeger_tracing_enable", True)
+            osd.conf.set("op_trace_sample_rate", 0.01)
+            osd.op_tracker.complaint_time = 0.05
+            token = osd.op_tracker.create(
+                "artificially stuck op", pool_id=1,
+                client="client.stuck", op_class="write",
+            )
+
+            def mon_sees_slow():
+                slow = mons[0].pg_digest.get("slow_ops") or {}
+                return bool(slow.get("osd.0", {}).get("count"))
+
+            await wait_until(mon_sees_slow, 5.0, "slow op reaching the mon")
+            rv, rs, out = await client.mon_command(
+                {"prefix": "health", "detail": True}
+            )
+            assert rv == 0, rs
+            payload = json.loads(out)
+            assert "SLOW_OPS" in payload["checks"]
+            # the stuck op's attribution tags are visible in-flight
+            dump = osd.op_tracker.dump_in_flight()
+            assert any(
+                op["client"] == "client.stuck" and op["op_class"] == "write"
+                for op in dump["ops"]
+            )
+            osd.op_tracker.finish(token)
+            await wait_until(
+                lambda: not mon_sees_slow(), 5.0, "slow op draining"
+            )
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestZeroPayloadWriteAccounting:
+    def test_delete_accounts_zero_bytes(self):
+        """Zero-payload write-class ops (delete/create/truncate) must
+        account their real payload (0 bytes) — not the 4096 QoS cost
+        floor, which would add phantom bytes to the pool/client views."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+
+            from test_cluster import start_cluster, stop_cluster
+
+            monmap, mons, osds = await start_cluster(1, 1)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("zp", "replicated", size=1, pg_num=1)
+            io = await client.open_ioctx("zp")
+            await io.write_full("o", b"x" * 1024)
+            await io.remove("o")
+            pools = {}
+            for o in osds:
+                for pid, classes in o.io_accountant.dump_pools().items():
+                    rec = pools.setdefault(pid, {"ops": 0, "bytes": 0})
+                    w = classes.get("write") or {}
+                    rec["ops"] += w.get("ops", 0)
+                    rec["bytes"] += w.get("bytes", 0)
+            (rec,) = pools.values()
+            assert rec["ops"] == 2, rec  # write_full + remove
+            assert rec["bytes"] == 1024, rec  # the delete added nothing
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestMgrAsokIostat:
+    def test_mgr_asok_serves_iostat_and_top(self, tmp_path):
+        """The operator path: `ceph tell mgr.x iostat` / `iostat top`
+        over the mgr's admin socket, plus the OSD-side raw dump."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.common.admin_socket import admin_command
+            from ceph_tpu.common.config import Config
+            from ceph_tpu.mgr import Mgr
+            from ceph_tpu.mgr.iostat import IostatModule
+
+            from test_cluster import start_cluster, stop_cluster, wait_until
+
+            monmap, mons, osds = await start_cluster(1, 2)
+            sock = str(tmp_path / "mgr.x.asok")
+            mgr = Mgr(
+                "x", monmap,
+                conf=Config({"name": "mgr.x", "admin_socket": sock},
+                            env=False),
+            )
+            mgr.beacon_interval = 0.1
+            await mgr.start()
+            await mgr.wait_for_active()
+            iostat = IostatModule(window_sec=3.0)
+            mgr.register_module(iostat)
+
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("asokp", "replicated", size=2, pg_num=2)
+            io = await client.open_ioctx("asokp")
+            for i in range(8):
+                await io.write_full(f"o{i}", b"x" * 2048)
+            await wait_until(
+                lambda: any(s.ops for s in iostat.pools.values()),
+                10.0, "iostat module consuming reports",
+            )
+            loop = asyncio.get_event_loop()
+            view = await loop.run_in_executor(
+                None, lambda: admin_command(sock, "iostat")
+            )
+            pools = {rec["pool"]: rec for rec in view["pools"].values()}
+            assert pools["asokp"]["write_ops"] == 8
+            top = await loop.run_in_executor(
+                None,
+                lambda: admin_command(sock, "iostat top", n=3, by="ops_rate"),
+            )
+            assert top["clients"]
+            assert top["clients"][0]["ops"] >= 1
+            # the OSD-side raw accountant dump pairs with it
+            osd_sock = osds[0].conf.get("admin_socket")
+            if osd_sock:
+                raw = await loop.run_in_executor(
+                    None,
+                    lambda: admin_command(osd_sock, "dump_io_accounting"),
+                )
+                assert "pools" in raw and "totals" in raw
+            await client.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestWorkloadAttributionAcceptance:
+    def test_eight_osd_multi_pool_accounting_slo_and_sampling(self):
+        """The ISSUE 10 acceptance run: 8 OSDs, an EC pool + a
+        replicated pool under mixed two-client load."""
+
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.mgr import Mgr
+            from ceph_tpu.mgr.iostat import IostatModule
+            from ceph_tpu.mgr.prometheus import PrometheusModule
+
+            from test_cluster import start_cluster, stop_cluster, wait_until
+            from test_metrics_lint import lint_exposition
+
+            monmap, mons, osds = await start_cluster(1, 8)
+            mgr = Mgr("x", monmap)
+            mgr.beacon_interval = 0.1
+            await mgr.start()
+            await mgr.wait_for_active()
+            prom = PrometheusModule()
+            mgr.register_module(prom)
+            # short pinned windows; SLO targets track the mgr's live
+            # config so the test can flip them at runtime
+            iostat = IostatModule(
+                window_sec=3.0,
+                slo_fast_window_sec=0.5,
+                slo_slow_window_sec=1.0,
+            )
+            mgr.register_module(iostat)
+
+            client_a = Rados(monmap, name="client.alpha")
+            await client_a.connect()
+            client_b = Rados(monmap, name="client.beta")
+            await client_b.connect()
+            rv, rs, _ = await client_a.mon_command(
+                {
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "attr21",
+                    "profile": ["k=2", "m=1", "plugin=tpu"],
+                }
+            )
+            assert rv == 0, rs
+            await client_a.pool_create(
+                "attrib_ec", "erasure", profile="attr21", pg_num=4
+            )
+            await client_b.pool_create(
+                "attrib_rep", "replicated", size=2, pg_num=4
+            )
+            io_ec = await client_a.open_ioctx("attrib_ec")
+            io_rep = await client_b.open_ioctx("attrib_rep")
+
+            # mixed load: alpha writes EC, beta writes + reads replicated
+            for i in range(24):
+                await io_ec.write_full(f"e{i}", b"a" * 8192)
+            for i in range(16):
+                await io_rep.write_full(f"r{i}", b"b" * 4096)
+            for i in range(16):
+                assert await io_rep.read(f"r{i}") == b"b" * 4096
+
+            # --- totals reconcile: OSD-side counters == mgr merge -----
+            def osd_total_ops():
+                return sum(o.io_accountant.totals()["ops"] for o in osds)
+
+            def mgr_total_ops():
+                return sum(s.ops for s in iostat.pools.values())
+
+            await wait_until(
+                lambda: mgr_total_ops() == osd_total_ops()
+                and osd_total_ops() >= 56,
+                10.0,
+                "iostat merge catching up to the OSD counters",
+            )
+            view = iostat.iostat()
+            pools_by_name = {rec["pool"]: rec for rec in view.values()}
+            assert pools_by_name["attrib_ec"]["write_ops"] == 24
+            assert pools_by_name["attrib_ec"]["write_bytes"] == 24 * 8192
+            assert pools_by_name["attrib_rep"]["write_ops"] == 16
+            assert pools_by_name["attrib_rep"]["read_ops"] == 16
+            assert pools_by_name["attrib_rep"]["read_bytes"] == 16 * 4096
+
+            # --- mon `status` carries the iostat slice ----------------
+            def status_iostat():
+                return (
+                    mons[0].pg_digest.get("iostat") or {}
+                ).get("pools") or {}
+
+            await wait_until(
+                lambda: any(
+                    rec.get("ops_total", 0) > 0
+                    for rec in status_iostat().values()
+                ),
+                10.0,
+                "pool rates reaching mon status",
+            )
+            rv, _rs, out = await client_a.mon_command({"prefix": "status"})
+            assert rv == 0
+            status = json.loads(out)
+            spools = {
+                rec["pool"]: rec
+                for rec in status["iostat"]["pools"].values()
+            }
+            assert spools["attrib_ec"]["write_ops"] == 24
+            assert "top_clients" in status["iostat"]
+            top = iostat.top_clients(by="bytes_rate")
+            top_clients = {r["client"] for r in top}
+            assert any(c.startswith("client.alpha") for c in top_clients)
+            assert any(c.startswith("client.beta") for c in top_clients)
+
+            # --- scrape reconciles with the same totals ---------------
+            families = lint_exposition(prom.scrape())
+            pool_ops = families["ceph_tpu_pool_ops"]["samples"]
+            assert sum(v for _n, _l, v in pool_ops) == osd_total_ops()
+            assert families["ceph_tpu_pool_latency_seconds"]["samples"]
+
+            # --- SLO breach: drive a pool past its target -------------
+            mgr.conf.set("mgr_slo_latency_target_ms", 0.0001)
+            for _round in range(4):
+                for i in range(10):
+                    await io_ec.write_full(f"slo{i}", b"c" * 8192)
+                await asyncio.sleep(0.15)
+
+            def breach_at_mon():
+                checks, details = mons[0].health_checks()
+                if "SLO_LATENCY_BREACH" not in checks:
+                    return False
+                return any(
+                    "attrib_ec" in line
+                    for line in details["SLO_LATENCY_BREACH"]
+                )
+
+            await wait_until(
+                breach_at_mon, 15.0, "SLO breach reaching mon health"
+            )
+            rv, _rs, out = await client_a.mon_command(
+                {"prefix": "health", "detail": True}
+            )
+            payload = json.loads(out)
+            assert payload["status"] == "HEALTH_WARN"
+            assert "burning their latency SLO" in payload["checks"][
+                "SLO_LATENCY_BREACH"
+            ]
+            # the scrape carries the burn gauges while breached
+            text = prom.scrape()
+            assert "ceph_tpu_pool_slo_burn_rate{" in text
+            # load stops -> the windows drain -> the check clears
+            await wait_until(
+                lambda: "SLO_LATENCY_BREACH"
+                not in mons[0].health_checks()[0],
+                15.0,
+                "SLO breach clearing after load stops",
+            )
+            mgr.conf.set("mgr_slo_latency_target_ms", 0.0)
+
+            # --- budgeted sampling under the same load ----------------
+            budget = 5.0
+            for o in osds:
+                o.conf.set("jaeger_tracing_enable", True)
+                o.conf.set("op_trace_sample_rate", 0.01)
+                o.conf.set("op_trace_budget_per_sec", budget)
+            t0 = time.monotonic()
+            for i in range(30):
+                await io_ec.write_full(f"tr{i}", b"d" * 4096)
+                await io_rep.write_full(f"tr{i}", b"d" * 2048)
+            # complaint-age ops ALWAYS keep their trace: with the
+            # window at zero every finishing op counts as slow
+            for o in osds:
+                o.op_tracker.complaint_time = 0.0
+            await io_ec.write_full("tr-slow", b"e" * 4096)
+            await io_rep.write_full("tr-slow", b"e" * 2048)
+            for o in osds:
+                o.op_tracker.complaint_time = 30.0
+            elapsed = time.monotonic() - t0
+            stats = [o.tracer.sampling_stats() for o in osds]
+            agg = {
+                k: sum(s[k] for s in stats)
+                for k in ("sampled", "unsampled", "dropped_budget",
+                          "kept_tail", "retained_spans")
+            }
+            # retention stayed inside the per-daemon token budget
+            bound = len(osds) * (budget * elapsed + budget + 1)
+            assert agg["sampled"] <= bound, (agg, elapsed)
+            # a 1% head rate really sampled ops out...
+            assert agg["unsampled"] >= 1, agg
+            # ...while the complaint-age ops were always retained
+            assert agg["kept_tail"] >= 2, agg
+            assert agg["retained_spans"] >= agg["kept_tail"], agg
+            kept_names = {
+                s["name"]
+                for o in osds
+                for s in o.tracer.export()
+            }
+            assert "osd:op" in kept_names, kept_names
+            for o in osds:
+                o.conf.set("jaeger_tracing_enable", False)
+                o.conf.set("op_trace_sample_rate", 1.0)
+                o.conf.set("op_trace_budget_per_sec", 0.0)
+
+            await client_a.shutdown()
+            await client_b.shutdown()
+            await mgr.stop()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())

@@ -180,6 +180,8 @@ class TestClusterScrapeLint:
 
             from test_cluster import start_cluster, stop_cluster, wait_until
 
+            from ceph_tpu.mgr.iostat import IostatModule
+
             monmap, mons, osds = await start_cluster(1, 2)
             mgr = Mgr("x", monmap)
             mgr.beacon_interval = 0.1
@@ -188,6 +190,10 @@ class TestClusterScrapeLint:
             prom = PrometheusModule()
             mgr.register_module(prom)
             mgr.register_module(ProgressModule())
+            # short windows + a pinned SLO target so the burn/target
+            # gauges carry samples within the test's wait budget
+            iostat = IostatModule(window_sec=2.0, slo_target_ms=5000.0)
+            mgr.register_module(iostat)
 
             client = Rados(monmap)
             await client.connect()
@@ -230,6 +236,11 @@ class TestClusterScrapeLint:
                     f"ceph_tpu_ec_dispatch_{_sanitize(k)}" in text
                     for k in dispatch_keys
                 ):
+                    return False
+                # ...and the iostat module consumed a pool_io report:
+                # the per-pool attribution families must carry SAMPLES
+                # (ISSUE 10), not just announce themselves
+                if 'ceph_tpu_pool_ops{pool="' not in text:
                     return False
                 # ..and the report carrying op SAMPLES arrived: the
                 # dispatch counters are process-wide, so when earlier
@@ -306,6 +317,66 @@ class TestClusterScrapeLint:
                 for f in families
             ), "verify aggregator families missing from scrape"
 
+            # ISSUE 10 cross-lint: every family the iostat module
+            # exports reaches the scrape AND the docs index, with the
+            # promised gauge-vs-counter-vs-histogram typing
+            iostat_fams = {
+                name: ftype
+                for name, ftype, _h, _r in iostat.prometheus_metrics()
+            }
+            for fam, ftype in iostat_fams.items():
+                assert fam in families, f"{fam} missing from scrape"
+                assert families[fam]["type"] == ftype, (
+                    f"{fam}: scrape type {families[fam]['type']} != "
+                    f"module type {ftype}"
+                )
+                assert documented(fam), f"{fam} not documented"
+            assert iostat_fams["ceph_tpu_pool_ops"] == "counter"
+            assert iostat_fams["ceph_tpu_pool_ops_rate"] == "gauge"
+            assert iostat_fams["ceph_tpu_pool_slo_burn_rate"] == "gauge"
+            assert (
+                iostat_fams["ceph_tpu_pool_latency_seconds"] == "histogram"
+            )
+            # the attribution families carry real per-pool samples whose
+            # labels include op class
+            pool_ops = families["ceph_tpu_pool_ops"]["samples"]
+            assert any(
+                labels.get("op") in ("read", "write", "recovery")
+                and float(v) > 0
+                for _n, labels, v in pool_ops
+            ), pool_ops
+            # SLO gauges have samples (a target was pinned) and the
+            # burn family carries both windows
+            burn = families["ceph_tpu_pool_slo_burn_rate"]["samples"]
+            assert {l.get("window") for _n, l, _v in burn} >= {
+                "fast", "slow",
+            }, burn
+            assert families["ceph_tpu_pool_slo_target_seconds"]["samples"]
+
+            # trace-sampling families (ISSUE 10 layer 3): every
+            # sampling_stats() key the OSD reports round-trips onto the
+            # scrape as ceph_tpu_trace_<key>, and vice versa; knobs and
+            # the pending depth are gauges, the verdicts counters
+            trace_keys = set(osds[0].tracer.sampling_stats())
+            for key in trace_keys:
+                fam = f"ceph_tpu_trace_{_sanitize(key)}"
+                assert fam in families, f"{fam} missing from scrape"
+                assert documented(fam), f"{fam} not documented"
+            for fam in families:
+                if fam.startswith("ceph_tpu_trace_"):
+                    key = fam.removeprefix("ceph_tpu_trace_")
+                    assert key in {_sanitize(k) for k in trace_keys}, (
+                        f"scraped {fam} has no sampling_stats() source"
+                    )
+            assert families["ceph_tpu_trace_sampled"]["type"] == "counter"
+            assert families["ceph_tpu_trace_kept_tail"]["type"] == "counter"
+            for fam in (
+                "ceph_tpu_trace_sample_rate",
+                "ceph_tpu_trace_budget_per_sec",
+                "ceph_tpu_trace_pending_traces",
+            ):
+                assert families[fam]["type"] == "gauge", fam
+
             # direction 2 (vice versa): every documented metric exists
             # in the scrape, and every scraped ec_dispatch/progress
             # family maps back to a perf-dump key / module gauge
@@ -335,6 +406,21 @@ class TestClusterScrapeLint:
                     )
                 if fam.startswith("ceph_tpu_progress_"):
                     assert documented(fam), f"scraped {fam} undocumented"
+                # scraped attribution families map back to the iostat
+                # module's export list (the df pool gauges predate the
+                # module and keep their own families)
+                if fam.startswith("ceph_tpu_top_client_") or (
+                    fam.startswith("ceph_tpu_pool_")
+                    and fam not in (
+                        "ceph_tpu_pool_stored_bytes",
+                        "ceph_tpu_pool_objects",
+                        "ceph_tpu_pool_used_raw_bytes",
+                    )
+                ):
+                    assert fam in iostat_fams, (
+                        f"scraped {fam} has no iostat "
+                        "prometheus_metrics() source"
+                    )
 
             await client.shutdown()
             await mgr.stop()

@@ -109,6 +109,42 @@ class TestMgrDaemon:
 
         asyncio.run(run())
 
+    def test_df_deleted_pool_keeps_id_keyed_record(self):
+        """A pool deleted mid-report (stats still arriving from OSDs
+        that have not dropped its PGs, but no name in the osdmap) must
+        surface as an id-keyed record flagged `deleted: true` — not
+        under a fabricated `pool<N>` name that could collide with (or
+        masquerade as) a real pool (ISSUE 10 satellite)."""
+        from types import SimpleNamespace
+
+        from ceph_tpu.mgr.mgr import DaemonState
+        from ceph_tpu.mon.monmap import MonMap
+
+        mgr = Mgr("x", MonMap(addrs={"a": "127.0.0.1:6789"}))
+        # pool names are arbitrary strings: a live pool literally named
+        # "7" must NOT merge with the deleted pool id 7's stale stats
+        mgr.osdmap.pools = {
+            1: SimpleNamespace(id=1, name="rbd"),
+            2: SimpleNamespace(id=2, name="7"),
+        }
+        st = DaemonState()
+        st.status = {
+            "pool_stored": {"1": 1000, "7": 123, "2": 50},
+            "pool_heads": {"1": 2, "7": 1, "2": 1},
+            "pool_bytes": {"1": 3000, "7": 369, "2": 150},
+        }
+        mgr.daemons["osd.0"] = st
+        pools = mgr.pg_digest()["pools"]
+        # the live pools key by name, unflagged
+        assert pools["rbd"] == {"stored": 1000, "objects": 2, "used_raw": 3000}
+        assert pools["7"] == {"stored": 50, "objects": 1, "used_raw": 150}
+        # the deleted pool keys by id in its own namespace + flag
+        assert "pool7" not in pools
+        assert pools["id:7"]["deleted"] is True
+        assert pools["id:7"]["id"] == 7
+        assert pools["id:7"]["stored"] == 123
+        assert pools["id:7"]["used_raw"] == 369
+
     def test_standby_failover(self):
         async def run():
             monmap, mons, osds = await start_cluster(1, 1)
