@@ -483,6 +483,81 @@ class AggTicket:
         return out if dtype is None else out.astype(dtype)
 
 
+class DonationPool:
+    """Per-shape pool of dead device output buffers with per-buffer LIVE
+    refcounts (ISSUE 11).  At pipeline depth > 1 several launches'
+    outputs are in flight at once; a buffer becomes donatable only after
+    ITS producing launch settles — `hold` marks an output live at
+    dispatch, `release` at settle, and `take`/`put` refuse live buffers,
+    counting any violation on the process-wide invariant gauge
+    (`ec_dispatch.pipeline.donation_recycled_live`, asserted 0 by the
+    chaos pipelined-wedge phase).  Callers serialize access under the
+    aggregator-wide lock; the pool itself is not thread-safe."""
+
+    # ceiling on settled buffers retained per shape: pipeline-depth
+    # launches can settle close together, and one slot (the old
+    # dict-per-shape pool) would drop all but the last.  The aggregator
+    # syncs the effective `cap` to its ring depth — retaining more dead
+    # buffers than launches that can be in flight would just pin HBM
+    # (each pooled RS(8,3) output of a large launch is tens of MiB).
+    SLOT_CAP = 4
+
+    __slots__ = ("_free", "_live", "cap")
+
+    def __init__(self, cap: int | None = None) -> None:
+        self._free: dict[tuple, list] = {}
+        self._live: dict[int, int] = {}  # id(buf) -> refcount
+        self.cap = self.SLOT_CAP if cap is None else max(1, int(cap))
+
+    def hold(self, buf) -> None:
+        self._live[id(buf)] = self._live.get(id(buf), 0) + 1
+
+    def release(self, buf) -> None:
+        key = id(buf)
+        refs = self._live.get(key, 0) - 1
+        if refs <= 0:
+            self._live.pop(key, None)
+        else:
+            self._live[key] = refs
+
+    def take(self, shape):
+        from ceph_tpu.ops.dispatch import PIPELINE
+
+        slot = self._free.get(tuple(shape))
+        if not slot:
+            return None
+        buf = slot.pop()
+        if id(buf) in self._live:
+            PIPELINE.record_donation(reused=False, live=True)
+            return None  # never hand out a live buffer
+        PIPELINE.record_donation(reused=True)
+        return buf
+
+    def put(self, shape, buf) -> None:
+        from ceph_tpu.ops.dispatch import PIPELINE
+
+        if id(buf) in self._live:
+            # pooling an unsettled launch's output would let a later
+            # launch donate (and XLA invalidate) bytes a reaper still
+            # needs — refuse and count the invariant violation
+            PIPELINE.record_donation(reused=False, live=True)
+            return
+        slot = self._free.setdefault(tuple(shape), [])
+        slot.append(buf)
+        while len(slot) > self.cap:
+            # oldest out — also trims promptly after a runtime cap
+            # shrink (a pipeline-depth config drop)
+            slot.pop(0)
+
+    # mapping-ish view (tests and introspection): the shapes with at
+    # least one FREE buffer pooled
+    def __iter__(self):
+        return iter([s for s, slot in self._free.items() if slot])
+
+    def __len__(self) -> int:
+        return sum(1 for slot in self._free.values() if slot)
+
+
 class _AggGroup:
     """Pending submissions sharing one (matrix, chunk-length) geometry —
     the unit that concatenates into a single padded device launch."""
@@ -490,7 +565,7 @@ class _AggGroup:
     __slots__ = (
         "key", "ec", "ctx", "arrays", "tickets", "stripes", "nbytes",
         "parity", "host", "pad", "error", "donatable", "lock",
-        "input", "credit", "flight", "submit_ts", "stalled",
+        "input", "credit", "flight", "submit_ts", "stalled", "held",
     )
 
     def __init__(self, key, ec, ctx=None):
@@ -506,6 +581,9 @@ class _AggGroup:
         self.pad = 0
         self.error: BaseException | None = None  # a failed launch, sticky
         self.donatable = False  # launch path can reuse a donated buffer
+        # the in-flight launch's device output, refcounted in the
+        # donation pool from dispatch until settle (pipeline depth > 1)
+        self.held = None
         # concatenated padded launch input, retained from launch until
         # settle so a device that wedges AFTER dispatch can still be
         # recomputed on the host oracle
@@ -557,18 +635,37 @@ class LaunchAggregator:
     SCHED_CLASS = "client"
 
     def __init__(self, window: int = 0, max_bytes: int = 64 << 20,
-                 pad_pow2: bool = True, inflight_max_bytes: int | None = None):
+                 pad_pow2: bool = True, inflight_max_bytes: int | None = None,
+                 pipeline_depth: int | None = None):
         from ceph_tpu.common.perf_counters import PerfCountersBuilder
         from ceph_tpu.common.throttle import Throttle
 
         self.window = int(window)
         self.max_bytes = int(max_bytes)
         self.pad_pow2 = pad_pow2
+        # depth-N asynchronous launch pipeline (ISSUE 11): how many
+        # launched-but-unsettled groups may be in flight before a new
+        # launch first settles the oldest — the settle happens AFTER the
+        # new dispatch, so window N+1's H2D overlaps window N's kernel.
+        # <= 0 disables the ring (in-flight bounded only by the byte
+        # throttle, the pre-ISSUE-11 behavior).
+        if pipeline_depth is None:
+            from ceph_tpu.common.options import OPTIONS
+
+            pipeline_depth = int(OPTIONS["ec_tpu_pipeline_depth"].default)
+        self.pipeline_depth = int(pipeline_depth)
+        from ceph_tpu.ops.dispatch import PIPELINE
+
+        PIPELINE.set_depth(self.pipeline_depth)
         # RLock: a reap (`_materialize`) forces its group's launch from
         # inside the lock; lockdep's DebugLock is not reentrant
         self._lock = threading.RLock()
         self._groups: "OrderedDict[tuple, _AggGroup]" = OrderedDict()
-        self._donate_pool: dict[tuple, object] = {}  # shape -> dead output buf
+        # per-shape retention follows the ring depth: more dead buffers
+        # than launches that can be in flight would only pin HBM
+        self._donate_pool = DonationPool(
+            cap=min(DonationPool.SLOT_CAP, max(1, self.pipeline_depth))
+        )
         # end-to-end backpressure (ec_tpu_inflight_max_bytes): byte credit
         # over everything admitted but not yet settled — windowed groups
         # AND launched-but-unreaped ones.  Over the bound, _admit makes
@@ -599,7 +696,8 @@ class LaunchAggregator:
         self.perf = b.create_perf_counters()
 
     def configure(self, window: int | None = None, max_bytes: int | None = None,
-                  inflight_max_bytes: int | None = None) -> None:
+                  inflight_max_bytes: int | None = None,
+                  pipeline_depth: int | None = None) -> None:
         """Apply live config (the OSD wires its Config + runtime observers
         here, so the aggregate_* settings reach the shared instance)."""
         if window is not None:
@@ -608,6 +706,15 @@ class LaunchAggregator:
             self.max_bytes = int(max_bytes)
         if inflight_max_bytes is not None:
             self.inflight.limit = int(inflight_max_bytes)
+        if pipeline_depth is not None:
+            self.pipeline_depth = int(pipeline_depth)
+            with self._lock:
+                self._donate_pool.cap = min(
+                    DonationPool.SLOT_CAP, max(1, self.pipeline_depth)
+                )
+            from ceph_tpu.ops.dispatch import PIPELINE
+
+            PIPELINE.set_depth(self.pipeline_depth)
 
     # -- subclass hooks ------------------------------------------------------
 
@@ -664,7 +771,32 @@ class LaunchAggregator:
                 # (raising here would blame an arbitrary submitter and
                 # tear down its unrelated write)
                 pass
+            # pipeline ring (ISSUE 11): AFTER the new launch dispatched,
+            # settle down to the depth bound — the new window's H2D ran
+            # before the oldest's blocking wait, which is the overlap
+            self._drain_pipeline()
         return ticket
+
+    def _drain_pipeline(self) -> None:
+        """Bound the in-flight launch set at `ec_tpu_pipeline_depth` by
+        settling the oldest launches.  Runs with NO locks held (a settle
+        takes the victim group's lock; holding another group's lock here
+        would deadlock two submitters draining each other)."""
+        depth = self.pipeline_depth
+        if depth <= 0:
+            return
+        from ceph_tpu.ops.dispatch import PIPELINE
+
+        while True:
+            with self._lock:
+                if len(self._live) <= depth:
+                    return
+                g = self._live[0]
+            PIPELINE.record_drain()
+            self._settle(g)
+            with self._lock:
+                if g in self._live:  # defensive: settle always removes
+                    return
 
     def _admit(self, nbytes: int) -> bool:
         """Backpressure admission (the byte Throttle): take credit for a
@@ -765,7 +897,7 @@ class LaunchAggregator:
             donate = None
             if g.donatable:
                 with self._lock:
-                    donate = self._donate_pool.pop(out_shape, None)
+                    donate = self._donate_pool.take(out_shape)
             # retained until settle: a device that wedges AFTER this
             # dispatch is recomputed from these exact bytes on the host
             g.input = data
@@ -850,6 +982,10 @@ class LaunchAggregator:
                 g.pad = pad
                 with self._lock:
                     self._live.append(g)
+                    rec["inflight_depth"] = len(self._live)
+                from ceph_tpu.ops.dispatch import PIPELINE
+
+                PIPELINE.launch()
                 raise
             # dispatch_ts anchors where the launch LEFT the queue and
             # actually began dispatching (queue-wait — window AND
@@ -874,11 +1010,22 @@ class LaunchAggregator:
             g.arrays = []
             g.pad = pad
             g.parity = parity
+            # donation-pool refcount (ISSUE 11): the device output is
+            # LIVE until this launch settles — at pipeline depth > 1 a
+            # same-shape co-launch settling first must not recycle it
+            if g.donatable and not isinstance(parity, np.ndarray):
+                with self._lock:
+                    self._donate_pool.hold(parity)
+                    g.held = parity
             # inside g.lock, like the error path above: appending after
             # release races a reaper that settles (and _live-removes) the
             # group first, which would pin a settled group in _live
             with self._lock:
                 self._live.append(g)
+                rec["inflight_depth"] = len(self._live)
+            from ceph_tpu.ops.dispatch import PIPELINE
+
+            PIPELINE.launch()
         self.perf.inc("launches")
         self.perf.inc(reason)
         self.perf.inc("pad_stripes", pad)
@@ -984,6 +1131,25 @@ class LaunchAggregator:
                 single = len(g.tickets) == 1 and not g.pad
                 host = parity
                 if device_side:
+                    # completion-ordered readiness probe (ISSUE 11): at
+                    # pipeline depth > 1 a launch often finished under a
+                    # LATER launch's dispatch — was_ready marks perfect
+                    # overlap on the record, and a DEGRADED backend with
+                    # an UNREADY buffer goes straight to the host oracle
+                    # so one wedged launch costs one deadline, not one
+                    # per in-flight group
+                    ready_fn = getattr(parity, "is_ready", None)
+                    try:
+                        was_ready = bool(ready_fn()) if ready_fn else False
+                    except Exception:
+                        was_ready = False
+                    if device_guard().degraded and not was_ready:
+                        try:
+                            host = self._host_fallback(g, g.input, None)
+                        except BaseException as e2:
+                            g.error = e2
+                        device_side = False  # suspect buffer: never pool it
+                if device_side:
                     # when the buffer is headed for the donation pool the
                     # copy MUST be forced (np.array): a zero-copy
                     # CPU-backend view into a later-donated buffer would
@@ -1005,6 +1171,10 @@ class LaunchAggregator:
                         # reap blocked waiting for the device (0 = the
                         # kernel finished under other work — perfect
                         # overlap); d2h_s is the device->host copy.
+                        # complete_ts anchors the record's spans in
+                        # completion order: under async dispatch the
+                        # wall clock around the (non-blocking) dispatch
+                        # no longer brackets the kernel.
                         t0 = time.monotonic()
                         wait = getattr(parity, "block_until_ready", None)
                         if wait is not None:
@@ -1017,6 +1187,7 @@ class LaunchAggregator:
                         )
                         t2 = time.monotonic()
                         spans["kernel_s"] = t1 - t0
+                        spans["complete_ts"] = t1
                         spans["d2h_s"] = t2 - t1
                         return out
 
@@ -1031,12 +1202,24 @@ class LaunchAggregator:
                         if rec is not None:
                             rec["kernel_s"] += spans.get("kernel_s", 0.0)
                             rec["d2h_s"] += spans.get("d2h_s", 0.0)
+                            rec["complete_ts"] = spans.get(
+                                "complete_ts", 0.0
+                            )
+                            if was_ready:
+                                rec["flags"]["overlap"] = True
                     except BaseException as e:
                         try:
                             host = self._host_fallback(g, g.input, e)
                         except BaseException as e2:
                             g.error = e2
                         device_side = False  # suspect buffer: never pool it
+                # the launch's output stops being LIVE at settle whatever
+                # happened to it — leaving a stale refcount would poison
+                # a later buffer that reuses the id
+                if g.held is not None:
+                    with self._lock:
+                        self._donate_pool.release(g.held)
+                    g.held = None
                 if g.error is None:
                     if single:
                         g.host = host
@@ -1044,7 +1227,9 @@ class LaunchAggregator:
                         g.host = host[: g.stripes] if g.pad else host
                         if g.donatable and device_side:
                             with self._lock:
-                                self._donate_pool[tuple(parity.shape)] = parity
+                                self._donate_pool.put(
+                                    tuple(parity.shape), parity
+                                )
                     g.parity = None
             # settled (host bytes or sticky error): release the
             # backpressure credit and the retained launch input
@@ -1062,8 +1247,13 @@ class LaunchAggregator:
 
                 flight_recorder().commit(rec)
         with self._lock:
-            if g in self._live:
+            removed = g in self._live
+            if removed:
                 self._live.remove(g)
+        if removed:
+            from ceph_tpu.ops.dispatch import PIPELINE
+
+            PIPELINE.settle()
 
     def _materialize(self, ticket: AggTicket) -> None:
         g = ticket._group
@@ -1434,15 +1624,20 @@ class MatrixCodecMixin:
         """Byte-identical HOST oracle of encode_array: pure numpy end to
         end, so a wedged device runtime can never hang it.  This is the
         DEGRADED-mode fallback the launch watchdog (ops/guard.py) re-runs
-        aggregated encodes on — same xor fast path gate, same bit-matrix,
-        same GF(2) reduction as the device kernels."""
-        from ceph_tpu.gf.bitslice import xor_matmul_host_batch
-
+        aggregated encodes on — same xor fast path gate, and since
+        ISSUE 11 the SAME reduced plane program the device kernel
+        compiles (ops/packed_gf.packed_code_host), so the oracle is
+        derived from the schedule rather than re-derived from the
+        matrix — the two paths cannot drift, and the fallback runs the
+        reduced XOR count too (plus an 8x smaller working set than the
+        bit-plane expansion)."""
         mat = self.distribution_matrix()
         arr = np.asarray(data, dtype=np.uint8)
         if self.m == 1 and self._xor_row_available():
             return np.bitwise_xor.reduce(arr, axis=-2)[..., None, :]
-        return xor_matmul_host_batch(expand_matrix(mat[self.k :]), arr)
+        from ceph_tpu.ops.packed_gf import packed_code_host
+
+        return packed_code_host(mat[self.k :], arr)
 
     def decode_array_host(self, erasures: list[int], survivors) -> np.ndarray:
         """Byte-identical HOST oracle of decode_array (pure numpy): the

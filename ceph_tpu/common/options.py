@@ -270,6 +270,41 @@ OPTIONS: dict[str, Option] = _opts(
         runtime=True,
     ),
     Option(
+        "ec_tpu_pipeline_depth",
+        int,
+        2,
+        A,
+        "depth of the asynchronous device-launch pipeline (ISSUE 11): "
+        "how many aggregated launches may be in flight (dispatched, not "
+        "yet settled) before a new launch first settles the oldest.  At "
+        "depth >= 2 window N+1's H2D staging overlaps window N's kernel "
+        "— the overlap the flight recorder's idle gaps pointed at.  The "
+        "settle order is oldest-first, and the donation pool's per-slot "
+        "refcounts guarantee an in-flight launch's output buffer is "
+        "never recycled early.  <= 0 disables the ring (in-flight "
+        "launches bounded only by ec_tpu_inflight_max_bytes, the "
+        "pre-ISSUE-11 behavior)",
+        see_also=("ec_tpu_inflight_max_bytes", "ec_tpu_aggregate_window"),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_device_cache_bytes",
+        int,
+        32 << 20,
+        A,
+        "device-resident chunk cache bound (ISSUE 11): recently "
+        "encoded/decoded chunk buffers kept in HBM keyed by (object, "
+        "shard, generation), consulted by the RMW read-modify path and "
+        "degraded reads BEFORE issuing H2D — a repeated degraded read "
+        "of a hot object serves its missing chunks with one D2H copy "
+        "and no launch.  Invalidated on overwrite and cleared on a "
+        "DEGRADED backend transition; hit/miss/evict counters ride the "
+        "ec_dispatch perf dump (ceph_tpu_ec_dispatch_cache_*).  <= 0 "
+        "disables the cache",
+        see_also=("ec_tpu_pipeline_depth",),
+        runtime=True,
+    ),
+    Option(
         "ec_tpu_flight_records",
         int,
         512,

@@ -35,6 +35,10 @@ def _perf_type(counter: str) -> str:
         # trace-sampling exports (ISSUE 10): the live knobs and the
         # provisional-trace depth are levels, not monotone counters
         or name in ("sample_rate", "budget_per_sec", "pending_traces")
+        # pipeline ring + device-cache levels (ISSUE 11): the configured
+        # depth, the current in-flight count, and the cache's resident
+        # footprint all rise AND fall
+        or name in ("depth", "inflight", "resident_bytes", "entries")
     ):
         return "gauge"
     return "counter"

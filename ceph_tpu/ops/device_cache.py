@@ -1,0 +1,306 @@
+"""Device-resident chunk cache — keep hot EC chunks in HBM (ISSUE 11).
+
+The third lever of the per-chip-gap tentpole: a repeated degraded read
+(and the read leg of a degraded RMW cycle — both flow through
+``ECBackend.objects_read_and_reconstruct``) re-reconstructs the same
+missing chunks launch after launch, paying the H2D staging of the whole
+survivor batch every time.  This cache holds recently encoded/decoded
+chunk buffers ON DEVICE, keyed by ``(object, shard, generation, offset)``,
+so the next read of the same (object, generation) serves the missing
+chunks with a single D2H copy — no H2D, no kernel, no launch at all.
+
+Coherence model:
+
+- ``generation`` is the object's version at put/get time (the producer
+  passes it); a write bumps the version, so stale entries simply miss.
+- Overwrites additionally ``invalidate_object`` eagerly at encode
+  dispatch — the moment the bytes actually change — so dead bytes free
+  immediately.  NOT at submit: the write's own RMW read leg runs between
+  the two and reads exactly the committed pre-write bytes, so it may
+  serve them from the cache (``ECBackend`` captures the pre-write
+  generation at submit and threads it through the read).
+- A DEGRADED backend transition (``ops/guard.py mark_degraded``) clears
+  the cache and gates ``put``: a wedged runtime cannot be trusted to
+  serve buffers, and the byte-identical host path needs no cache.
+- Keys are opaque to this module — ``ECBackend`` namespaces them with a
+  never-reused per-backend token, so one process hosting many clusters
+  (the test harnesses) can never cross-serve bytes.
+
+Bounded by ``ec_tpu_device_cache_bytes`` (LRU, runtime-mutable through
+the OSD config-observer pattern); hit/miss/evict counters export through
+``ops/dispatch.perf_dump()`` (asok ``perf dump`` ``ec_dispatch.cache_*``
+→ ``ceph_tpu_ec_dispatch_cache_*`` Prometheus families).  A served hit
+commits a ``cache_hit``-flagged flight record whose only span is the
+D2H copy — the "skips H2D" acceptance criterion is a visible property
+of the timeline, not an inference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+
+class _Entry:
+    __slots__ = ("buf", "nbytes", "generation", "off")
+
+    def __init__(self, buf, nbytes: int, generation, off: int):
+        self.buf = buf
+        self.nbytes = int(nbytes)
+        self.generation = generation
+        self.off = int(off)
+
+
+class DeviceChunkCache:
+    """Bounded per-backend LRU of device-resident chunk buffers."""
+
+    def __init__(self, max_bytes: int | None = None):
+        if max_bytes is None:
+            from ceph_tpu.common.options import OPTIONS
+
+            max_bytes = int(OPTIONS["ec_tpu_device_cache_bytes"].default)
+        self._lock = threading.Lock()
+        # (obj, shard, off) -> _Entry; generation checked on get so a
+        # stale-generation entry is replaced in place by the next put
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        # obj -> {keys} index so the per-write invalidate_object hook is
+        # O(entries-for-that-object), not a scan of the whole cache
+        self._by_obj: dict[object, set[tuple]] = {}
+        self._bytes = 0
+        self.max_bytes = int(max_bytes)
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.served_bytes = 0
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, max_bytes: int | None = None) -> None:
+        """Apply live config (`ec_tpu_device_cache_bytes`); shrinking
+        evicts LRU-first, 0 disables and drops everything."""
+        if max_bytes is None:
+            return
+        with self._lock:
+            self.max_bytes = int(max_bytes)
+            self._evict_to_fit_locked(0)
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    # -- producer side -------------------------------------------------------
+
+    def put(self, obj, shard: int, generation, data, off: int = 0) -> bool:
+        """Commit one chunk's bytes to the device and cache the buffer.
+        ``data`` is host bytes/ndarray (flattened) or an already-committed
+        device array.  No-ops while the backend is DEGRADED (a wedged
+        runtime must not be handed fresh work) or when the item alone
+        exceeds the bound."""
+        if not self.enabled or generation is None:
+            return False
+        from .guard import DeviceTimeout, device_guard
+
+        if device_guard().degraded:
+            return False
+        arr = np.asarray(data, dtype=np.uint8).reshape(-1)
+        nbytes = arr.nbytes
+        if nbytes == 0 or nbytes > self.max_bytes:
+            return False
+        try:
+            import jax
+
+            # deadline-guarded like every other device wait: a wedged
+            # runtime can HANG device_put, and the producer sits on the
+            # decode-materialize path
+            buf = device_guard().call(
+                lambda: jax.device_put(arr), what="cache put"
+            )
+        except DeviceTimeout as e:
+            # the commit wedged: degrade (which clears this cache) so
+            # every path stops trusting the runtime, and fail the put
+            device_guard().mark_degraded(f"cache put: {e}")
+            return False
+        except Exception:
+            return False  # a broken runtime must never fail the producer
+        with self._lock:
+            key = (obj, int(shard), int(off))
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                self._by_obj[obj].discard(key)
+            self._evict_to_fit_locked(nbytes)
+            self._entries[key] = _Entry(buf, nbytes, generation, off)
+            self._by_obj.setdefault(obj, set()).add(key)
+            self._bytes += nbytes
+            self.insertions += 1
+        return True
+
+    def _evict_to_fit_locked(self, incoming: int) -> None:
+        while self._entries and self._bytes + incoming > self.max_bytes:
+            key, entry = self._entries.popitem(last=False)
+            self._bytes -= entry.nbytes
+            keys = self._by_obj.get(key[0])
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_obj[key[0]]
+            self.evictions += 1
+
+    # -- consumer side -------------------------------------------------------
+
+    def get(self, obj, shard: int, generation, off: int = 0,
+            length: int | None = None):
+        """The cached device buffer for (obj, shard, generation, off), or
+        None.  ``length`` (bytes) must fit inside the stored buffer."""
+        with self._lock:
+            key = (obj, int(shard), int(off))
+            entry = self._entries.get(key)
+            if (
+                entry is None
+                or entry.generation != generation
+                or (length is not None and entry.nbytes < length)
+            ):
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry.buf
+
+    def fetch_many(
+        self, obj, shards, generation, off: int = 0,
+        length: int | None = None, kind: str = "decode", stripes: int = 0,
+    ) -> dict[int, np.ndarray] | None:
+        """Serve a whole missing-chunk set from HBM, or None when ANY
+        chunk misses (an all-or-nothing consult: a partial hit still
+        needs the decode launch, so serving half would be pure waste).
+
+        On a full hit the D2H copies are timed and committed as ONE
+        ``cache_hit``-flagged flight record with h2d_s = kernel_s = 0 —
+        the timeline proof that this path skipped the H2D leg entirely.
+        """
+        shards = list(shards)
+        if not shards or not self.enabled:
+            return None
+        with self._lock:
+            entries = []
+            for s in shards:
+                entry = self._entries.get((obj, int(s), int(off)))
+                if (
+                    entry is None
+                    or entry.generation != generation
+                    or (length is not None and entry.nbytes < length)
+                ):
+                    self.misses += len(shards)
+                    return None
+                entries.append(entry)
+            for s in shards:
+                self._entries.move_to_end((obj, int(s), int(off)))
+        from .guard import device_guard
+
+        def _copy_out():
+            res: dict[int, np.ndarray] = {}
+            n = 0
+            for s, entry in zip(shards, entries):
+                host = np.asarray(entry.buf)
+                if length is not None and host.nbytes > length:
+                    host = host[:length]
+                res[int(s)] = host
+                n += host.nbytes
+            return res, n
+
+        t0 = time.monotonic()
+        try:
+            # deadline-guarded like every other device wait: on a wedged
+            # runtime np.asarray blocks forever, and this consult sits on
+            # the degraded-read path the guard exists to protect
+            out, nbytes = device_guard().call(_copy_out, what="cache fetch")
+        except Exception as e:
+            # the D2H hung or failed: degrade (which clears this cache)
+            # and report a MISS so the caller's decode launch takes the
+            # guarded host-fallback path instead of hanging here
+            device_guard().mark_degraded(f"cache fetch: {e}")
+            with self._lock:
+                self.misses += len(shards)
+            return None
+        d2h_s = time.monotonic() - t0
+        with self._lock:
+            self.hits += len(shards)
+            self.served_bytes += nbytes
+        self._record_hit(kind, stripes or len(shards), nbytes, d2h_s)
+        return out
+
+    @staticmethod
+    def _record_hit(kind: str, stripes: int, nbytes: int, d2h_s: float) -> None:
+        """Flight record for a cache-served read: no queue wait, no H2D,
+        no kernel — only the D2H copy of the resident chunks."""
+        from .flight_recorder import flight_recorder, new_record
+
+        rec = new_record(kind, group="#cache", stripes=stripes,
+                         batch=stripes, nbytes=nbytes)
+        now = time.monotonic()
+        rec["dispatch_ts"] = now - d2h_s
+        rec["submit_ts"] = rec["dispatch_ts"]
+        rec["complete_ts"] = rec["dispatch_ts"]
+        rec["d2h_s"] = d2h_s
+        rec["flags"]["cache_hit"] = True
+        flight_recorder().commit(rec)
+
+    # -- invalidation --------------------------------------------------------
+
+    def invalidate_object(self, obj) -> int:
+        """Drop every entry of one object (any shard/offset): the
+        overwrite hook.  Returns how many entries died."""
+        with self._lock:
+            doomed = self._by_obj.pop(obj, None)
+            if not doomed:
+                return 0
+            for key in doomed:
+                self._bytes -= self._entries.pop(key).nbytes
+            self.invalidations += len(doomed)
+            return len(doomed)
+
+    def clear(self) -> None:
+        """Drop everything (the DEGRADED-transition hook): buffers on a
+        wedged runtime are unreachable, and the host path needs none."""
+        with self._lock:
+            self.invalidations += len(self._entries)
+            self._entries.clear()
+            self._by_obj.clear()
+            self._bytes = 0
+
+    # -- introspection -------------------------------------------------------
+
+    def perf_dump(self) -> dict[str, int]:
+        """JSON-safe counters for the `ec_dispatch.cache_*` slice.
+        `resident_bytes`/`entries` are gauges (they fall on eviction and
+        invalidation); the rest are monotonic counters."""
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "served_bytes": self.served_bytes,
+                "resident_bytes": self._bytes,
+                "entries": len(self._entries),
+            }
+
+
+_CACHE: DeviceChunkCache | None = None
+
+
+def device_chunk_cache() -> DeviceChunkCache:
+    """The process-wide (per-backend: one device runtime per process)
+    cache, built lazily from option defaults like the device guard and
+    the default aggregators; daemons with a live Config re-bound it
+    through their runtime observers."""
+    global _CACHE
+    if _CACHE is None:
+        _CACHE = DeviceChunkCache()
+    return _CACHE

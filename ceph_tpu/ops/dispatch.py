@@ -115,6 +115,72 @@ class DeviceOccupancy:
 
 DEVICES_PER_LAUNCH = DeviceOccupancy()
 
+class PipelineGauges:
+    """Process-wide pipeline/donation accounting for the depth-N async
+    launch ring (ISSUE 11, codec/matrix_codec.LaunchAggregator):
+
+    - ``depth``: the configured ``ec_tpu_pipeline_depth`` (gauge),
+    - ``inflight`` / ``inflight_peak``: launches dispatched but not yet
+      settled, now and at peak,
+    - ``drains``: ring-full settles (the submitter paid the oldest
+      launch's wait so the new one could overlap it),
+    - ``donation_reuses``: output buffers recycled from the donation
+      pool into a later launch,
+    - ``donation_recycled_live``: the INVARIANT counter — a pooled
+      buffer handed out while its producing launch was still in flight.
+      Must stay 0; the chaos pipelined-wedge phase asserts it.
+    """
+
+    __slots__ = ("_lock", "depth", "inflight", "inflight_peak", "drains",
+                 "donation_reuses", "donation_recycled_live")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.depth = 0
+        self.inflight = 0
+        self.inflight_peak = 0
+        self.drains = 0
+        self.donation_reuses = 0
+        self.donation_recycled_live = 0
+
+    def set_depth(self, depth: int) -> None:
+        with self._lock:
+            self.depth = int(depth)
+
+    def launch(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.inflight_peak = max(self.inflight_peak, self.inflight)
+
+    def settle(self) -> None:
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+
+    def record_drain(self) -> None:
+        with self._lock:
+            self.drains += 1
+
+    def record_donation(self, reused: bool, live: bool = False) -> None:
+        with self._lock:
+            if reused:
+                self.donation_reuses += 1
+            if live:
+                self.donation_recycled_live += 1
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "depth": self.depth,
+                "inflight": self.inflight,
+                "inflight_peak": self.inflight_peak,
+                "drains": self.drains,
+                "donation_reuses": self.donation_reuses,
+                "donation_recycled_live": self.donation_recycled_live,
+            }
+
+
+PIPELINE = PipelineGauges()
+
 # Launches that completed on the HOST ORACLE instead of the device
 # (ops/guard.py DeviceGuard fallback: launch deadline exceeded, device
 # error, or degraded-mode bypass).  NOT counted in LAUNCHES — these never
@@ -227,4 +293,16 @@ def perf_dump() -> dict[str, object]:
 
     for name, val in launch_scheduler().perf_dump().items():
         out[f"sched.{name}"] = val
+    # pipelined-dispatch ring + donation-pool invariants (ISSUE 11):
+    # configured depth, current/peak in-flight launches, ring-full
+    # drains, and the recycled-live invariant counter (must stay 0)
+    for name, val in PIPELINE.snapshot().items():
+        out[f"pipeline.{name}"] = val
+    # device-resident chunk cache (ISSUE 11): hit/miss/evict counters
+    # plus the resident-bytes/entries gauges, as `cache.<counter>`
+    # scalars -> ceph_tpu_ec_dispatch_cache_* prometheus families
+    from .device_cache import device_chunk_cache
+
+    for name, val in device_chunk_cache().perf_dump().items():
+        out[f"cache.{name}"] = val
     return out

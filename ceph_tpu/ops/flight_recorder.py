@@ -100,6 +100,14 @@ def new_record(
         "submit_ts": now if submit_ts is None else float(submit_ts),
         "dispatch_ts": 0.0,
         "settle_ts": 0.0,
+        # when the device WORK finished (the blocking wait returned) —
+        # the completion-ordered anchor async span attribution needs:
+        # under pipelined dispatch (ISSUE 11) wall-clock around the
+        # now-nonblocking calls no longer brackets the kernel
+        "complete_ts": 0.0,
+        # how many launches were in flight (dispatched, unsettled) the
+        # moment this one dispatched — the pipeline-depth witness
+        "inflight_depth": 0,
         "queue_wait_s": 0.0,
         "h2d_s": 0.0,
         "kernel_s": 0.0,
@@ -111,6 +119,13 @@ def new_record(
             "timeout": False,
             "throttle_stall": False,
             "error": False,
+            # the launch's device work had already completed when its
+            # reaper arrived (zero blocking wait): the overlap the
+            # pipeline exists to create, visible per launch
+            "overlap": False,
+            # served from the device-resident chunk cache: no H2D, no
+            # kernel, only the D2H copy (ops/device_cache.py)
+            "cache_hit": False,
         },
     }
 

@@ -128,7 +128,8 @@ class DeviceGuard:
 
     def mark_degraded(self, reason: str) -> None:
         with self._lock:
-            if not self.degraded:
+            entered = not self.degraded
+            if entered:
                 self.degraded = True
                 self.degraded_since = time.monotonic()
                 self.degraded_total += 1
@@ -137,6 +138,14 @@ class DeviceGuard:
                 self._last_probe = 0.0
                 self._probe_cold = True
             self.reason = reason
+        if entered:
+            # the device-resident chunk cache (ops/device_cache.py) holds
+            # buffers a wedged runtime can no longer serve — drop them on
+            # the transition so the host-fallback path never consults a
+            # cache it cannot materialize (puts are gated while degraded)
+            from .device_cache import device_chunk_cache
+
+            device_chunk_cache().clear()
 
     def mark_healthy(self) -> None:
         with self._lock:

@@ -114,8 +114,14 @@ class LaunchScheduler:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._busy = False  # a launch is executing (the device turn)
+        # bytes_total: input bytes dispatched per lane (ISSUE 11) — with
+        # the pipelined aggregators the device turn covers only the
+        # (async) dispatch, so per-lane BYTES, not launch counts, are
+        # what the QoS knobs actually arbitrate; the gauge pair
+        # (dequeued, bytes_total) makes a lane's launch-size mix visible
         self._counters: dict[str, dict[str, float]] = {
-            lane: {"enqueued": 0, "dequeued": 0, "wait_ms_total": 0.0}
+            lane: {"enqueued": 0, "dequeued": 0, "wait_ms_total": 0.0,
+                   "bytes_total": 0}
             for lane in LANES
         }
 
@@ -186,6 +192,7 @@ class LaunchScheduler:
             pend: _PendingLaunch = item.run  # the payload, not a callable
             lane = self._counters[lane_name(pend.klass)]
             lane["dequeued"] += 1
+            lane["bytes_total"] += pend.cost
             lane["wait_ms_total"] += (
                 time.monotonic() - pend.enqueue_ts
             ) * 1e3
@@ -230,6 +237,7 @@ class LaunchScheduler:
                 c = self._counters[lane]
                 out[f"{lane}.enqueued"] = int(c["enqueued"])
                 out[f"{lane}.dequeued"] = int(c["dequeued"])
+                out[f"{lane}.bytes_total"] = int(c["bytes_total"])
                 out[f"{lane}.wait_ms_total"] = round(c["wait_ms_total"], 3)
                 out[f"{lane}.queue_depth"] = depths[lane]
         return out
@@ -238,7 +246,8 @@ class LaunchScheduler:
         with self._lock:
             for lane in LANES:
                 self._counters[lane] = {
-                    "enqueued": 0, "dequeued": 0, "wait_ms_total": 0.0
+                    "enqueued": 0, "dequeued": 0, "wait_ms_total": 0.0,
+                    "bytes_total": 0,
                 }
 
 

@@ -28,6 +28,7 @@ asyncio messenger or an in-process test harness.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import time
 from dataclasses import dataclass, field
@@ -87,6 +88,13 @@ class Op:
     pending_commits: set[int] = field(default_factory=set)  # shard ids
     pin: object | None = None
     encoded: bool = False
+    # pre-write device-cache generation (ISSUE 11), captured at submit
+    # BEFORE this op projects: the RMW read leg reads exactly the
+    # committed pre-write bytes (later same-object writes are tid-ordered
+    # behind us), so it may serve them from the device cache at this
+    # generation.  None when an earlier in-flight write makes the
+    # on-disk bytes ambiguous.
+    cache_read_gen: object = None
     # LAUNCHED device encode awaiting dispatch (EncodeStage); the encode
     # pipeline reaps these FIFO so sub-writes fan out in tid order
     encode_stage: object | None = None
@@ -124,7 +132,16 @@ class ReadOp:
     # decoded extents; set by recover_object
     on_complete_raw: Callable[["ReadOp", set[int]], None] | None = None
     trace: object = field(default_factory=lambda: null_span())  # ec:read span
+    # per-oid device-cache generation overrides (ISSUE 11): the RMW read
+    # leg captures the committed pre-write generation at submit, before
+    # its own projection would make `_cache_generation` return None
+    cache_generations: dict = field(default_factory=dict)
 
+
+# never-reused namespace tokens for the device chunk cache: one per
+# ECBackend instance, so entries from a torn-down cluster / failed-over
+# primary in the same process can never serve another backend's reads
+_CACHE_NS = itertools.count(1)
 
 RECOVERY_IDLE = "IDLE"
 RECOVERY_READING = "READING"
@@ -206,6 +223,10 @@ class ECBackend(PGBackend):
             else default_verify_aggregator()
         )
         self.extent_cache = ExtentCache()
+        # device-resident chunk cache namespace (ISSUE 11): reads of
+        # this PG consult/fill the process-wide HBM cache under a
+        # never-reused token, keyed further by (oid, shard, generation)
+        self._cache_ns = (next(_CACHE_NS), str(listener.pgid))
         self._tid = 0
         self.in_flight: dict[int, Op] = {}  # write tid -> Op
         self.waiting_reads: list[Op] = []
@@ -288,6 +309,32 @@ class ECBackend(PGBackend):
         oi = self.get_object_info(oid)
         return oi.size if oi else 0
 
+    # -- device-resident chunk cache (ISSUE 11) ------------------------------
+
+    def _chunk_cache(self):
+        """The process-wide HBM chunk cache when enabled, else None."""
+        from ..ops.device_cache import device_chunk_cache
+
+        cache = device_chunk_cache()
+        return cache if cache.enabled else None
+
+    def _cache_obj(self, oid: str):
+        return (*self._cache_ns, oid)
+
+    def _cache_generation(self, oid: str):
+        """Cache generation for an object's chunks: the committed object
+        version.  None while writes are in flight (projected state) —
+        mid-RMW bytes must never be cached — or when the primary has no
+        local object info to version against.  The RMW read leg is the
+        one exception: `submit_transaction` captures this BEFORE its own
+        projection and threads it through `ReadOp.cache_generations`, so
+        the leg that reads exactly the committed pre-write bytes can
+        still consult the cache."""
+        if oid in self._projected:
+            return None
+        oi = self.get_object_info(oid)
+        return oi.version if oi is not None else None
+
     def _available_shards(self, oid: str) -> set[int]:
         """Shards that are up and not missing the object."""
         acting = self.listener.acting()
@@ -357,6 +404,14 @@ class ECBackend(PGBackend):
         op.trace.keyval("oid", pgt.oid)
         op.trace.keyval("tid", tid)
         op.trace.event("start ec write")
+        # device-cache generation for the RMW read leg (ISSUE 11),
+        # captured BEFORE this op projects: with no earlier in-flight
+        # write the read leg reads exactly the committed pre-write
+        # bytes, so it may serve them from the cache at this generation.
+        # Invalidation happens at encode dispatch (the moment the bytes
+        # actually change), not here — invalidating now would destroy
+        # the very entries the read leg consults.
+        op.cache_read_gen = self._cache_generation(pgt.oid)
         if proj is None:
             proj = self._projected[pgt.oid] = {
                 "size": obj_size,
@@ -455,7 +510,12 @@ class ECBackend(PGBackend):
                 op.read_results[off] = data
             self._encode_and_dispatch(op)
 
-        self.objects_read_and_reconstruct(need, _on_read, parent_span=op.trace)
+        self.objects_read_and_reconstruct(
+            need,
+            _on_read,
+            parent_span=op.trace,
+            cache_generations={op.pgt.oid: op.cache_read_gen},
+        )
 
     def _encode_and_dispatch(self, op: Op) -> None:
         """try_reads_to_commit (ECBackend.cc:1982): LAUNCH the device
@@ -464,6 +524,14 @@ class ECBackend(PGBackend):
         out when the pipeline reaps the op (FIFO), so the next op's RMW
         reads overlap this op's device encode — the overlap the reference
         gets from queued AIO in front of ec_encode_data."""
+        # overwrite invalidation (ISSUE 11): from here on the object's
+        # bytes are changing — this op's RMW read leg (which could still
+        # serve the committed pre-write bytes) is complete, so drop the
+        # now-stale device-resident chunks (the generation bump would
+        # make them miss anyway; this frees HBM eagerly)
+        cache = self._chunk_cache()
+        if cache is not None:
+            cache.invalidate_object(self._cache_obj(op.pgt.oid))
         op.encode_t0 = time.monotonic()
         # scope the launch under ec:write so codec h2d/kernel_launch
         # sub-spans (codec/tracing.py) and the PendingEncode's reap span
@@ -749,6 +817,7 @@ class ECBackend(PGBackend):
         on_complete_raw: Callable[[ReadOp, set[int]], None] | None = None,
         want_shards: set[int] | None = None,
         parent_span=None,
+        cache_generations: Mapping | None = None,
     ) -> None:
         """Client/RMW/recovery reads with reconstruction
         (ECBackend.cc:2389).  on_complete receives
@@ -797,6 +866,7 @@ class ECBackend(PGBackend):
             on_complete=on_complete,
             on_complete_raw=on_complete_raw,
             trace=trace,
+            cache_generations=dict(cache_generations or {}),
         )
         self.read_ops[tid] = rop
         self._send_reads(rop, sources)
@@ -1057,7 +1127,24 @@ class ECBackend(PGBackend):
     ) -> list[tuple[int, int, int, "stripe_mod.PendingDecode"]]:
         """SUBMIT one object's extent decodes (tickets via the shared
         DecodeAggregator) without materializing — phase one of the
-        reconstruct, so concurrent objects coalesce into one launch."""
+        reconstruct, so concurrent objects coalesce into one launch.
+
+        Device-cache consult (ISSUE 11): the decode launcher checks the
+        HBM chunk cache for the missing chunks FIRST — a repeated
+        degraded read (or the read leg of a degraded RMW cycle, which
+        flows through the same path) of an unchanged object serves from
+        the device with one D2H copy, skipping the survivor H2D and the
+        kernel entirely; a miss caches its reconstruction for next time.
+        """
+        cache = self._chunk_cache()
+        if cache is None:
+            gen = None
+        elif oid in rop.cache_generations:
+            # RMW read leg: the submit-time pre-write generation (our own
+            # projection would make _cache_generation return None)
+            gen = rop.cache_generations[oid]
+        else:
+            gen = self._cache_generation(oid)
         out = []
         for off, ln in req.to_read:
             s_off, s_len = self.sinfo.offset_len_to_stripe_bounds(off, ln)
@@ -1081,7 +1168,10 @@ class ECBackend(PGBackend):
                         pass
                 raise EcError(EIO, f"cannot reconstruct {oid}")
             pend = stripe_mod.decode_concat_launch(
-                self.sinfo, self.ec, shards, aggregator=self.decode_aggregator
+                self.sinfo, self.ec, shards, aggregator=self.decode_aggregator,
+                chunk_cache=cache,
+                cache_key=(self._cache_obj(oid), gen),
+                cache_off=c_off,
             )
             out.append((off, ln, s_off, pend))
         return out
@@ -1225,10 +1315,17 @@ class ECBackend(PGBackend):
             if fragmented:
                 rebuilt = self._decode_fragmented(rec, have, want)
             else:
+                cache = self._chunk_cache()
+                gen = (
+                    self._cache_generation(rec.oid)
+                    if cache is not None else None
+                )
                 with tracer_mod.span_scope(rec.trace):
                     rec.pending_decode = stripe_mod.decode_shards_launch(
                         self.sinfo, self.ec, have, want,
                         aggregator=self.decode_aggregator,
+                        chunk_cache=cache,
+                        cache_key=(self._cache_obj(rec.oid), gen),
                     )
                 rec.decode_t0 = t0
                 rec.state = RECOVERY_DECODING

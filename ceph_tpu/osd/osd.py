@@ -303,6 +303,30 @@ class OSD(Dispatcher):
         self.conf.add_observer(
             ["ec_tpu_inflight_max_bytes"], lambda _n, v: _apply_inflight(v)
         )
+        # depth-N async launch pipeline (ISSUE 11): every aggregator
+        # shares the in-flight ring bound, runtime-mutable like the
+        # aggregation knobs
+        def _apply_pipeline_depth(v: int) -> None:
+            self.encode_aggregator.configure(pipeline_depth=int(v))
+            self.decode_aggregator.configure(pipeline_depth=int(v))
+            self.verify_aggregator.configure(pipeline_depth=int(v))
+
+        _apply_pipeline_depth(self.conf.get("ec_tpu_pipeline_depth"))
+        self.conf.add_observer(
+            ["ec_tpu_pipeline_depth"],
+            lambda _n, v: _apply_pipeline_depth(v),
+        )
+        # device-resident chunk cache bound (ISSUE 11): the process-wide
+        # HBM cache degraded reads / RMW read legs consult before H2D
+        from ..ops.device_cache import device_chunk_cache
+
+        device_chunk_cache().configure(
+            max_bytes=self.conf.get("ec_tpu_device_cache_bytes")
+        )
+        self.conf.add_observer(
+            ["ec_tpu_device_cache_bytes"],
+            lambda _n, v: device_chunk_cache().configure(max_bytes=int(v)),
+        )
         # flight recorder ring capacity (ISSUE 8): runtime-mutable like
         # the aggregation knobs; resizing keeps the newest records
         from ..ops.flight_recorder import flight_recorder

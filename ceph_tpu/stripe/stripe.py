@@ -235,12 +235,22 @@ def decode_concat_launch(
     ec: ErasureCodeInterface,
     shards: Mapping[int, np.ndarray],
     aggregator=None,
+    chunk_cache=None,
+    cache_key: tuple | None = None,
+    cache_off: int = 0,
 ) -> PendingDecode:
     """Launch a batched client-read decode WITHOUT materializing the
     reconstruction; resolves to the logical bytes.  With an `aggregator`
     (codec.matrix_codec.DecodeAggregator) the survivor batch is SUBMITTED
     instead of launched, so concurrent same-erasure-pattern degraded
-    reads coalesce into one padded device dispatch."""
+    reads coalesce into one padded device dispatch.
+
+    With a `chunk_cache` (ops/device_cache.DeviceChunkCache) and a
+    `cache_key` = (object token, generation), the missing data chunks
+    are consulted in HBM FIRST — a full hit serves the reconstruction
+    with one D2H copy and NO launch, NO H2D (the repeated-degraded-read
+    fast path, ISSUE 11) — and a miss's reconstructed rows are cached
+    at materialize time for the next read of the same generation."""
     lengths = {len(v) for v in shards.values()}
     if len(lengths) != 1:
         raise EcError(EINVAL, "shards must have equal length")
@@ -264,6 +274,24 @@ def decode_concat_launch(
             data[:, i, :] = have[r]
     if not missing_raw:
         return PendingDecode(None, None, result=data.reshape(-1))
+    use_cache = (
+        chunk_cache is not None
+        and chunk_cache.enabled
+        and cache_key is not None
+        and cache_key[1] is not None
+    )
+    if use_cache:
+        cached = chunk_cache.fetch_many(
+            cache_key[0], missing_raw, cache_key[1], off=cache_off,
+            length=shard_len, kind="decode", stripes=stripes,
+        )
+        if cached is not None:
+            for i, r in enumerate(data_raw):
+                if r not in have:
+                    data[:, i, :] = cached[r][:shard_len].reshape(
+                        stripes, sinfo.chunk_size
+                    )
+            return PendingDecode(None, None, result=data.reshape(-1))
     # The decode plan needs the full erasure set (every shard we don't
     # have), not just the wanted data shards.
     erasures = [i for i in range(n) if i not in have]
@@ -278,6 +306,15 @@ def decode_concat_launch(
             handle = ec.decode_array(erasures, survivors)
 
         def _assemble(rec: np.ndarray) -> np.ndarray:
+            if use_cache:
+                # cache every reconstructed row (data AND parity) so the
+                # next same-generation degraded read / recovery decode
+                # of this object skips its H2D leg entirely
+                for p, e in enumerate(erasures):
+                    chunk_cache.put(
+                        cache_key[0], e, cache_key[1],
+                        rec[:, p, :], off=cache_off,
+                    )
             for p, e in enumerate(erasures):
                 if e < k:
                     data[:, e, :] = rec[:, p, :]
@@ -310,6 +347,8 @@ def decode_shards_launch(
     shards: Mapping[int, np.ndarray],
     need: set[int],
     aggregator=None,
+    chunk_cache=None,
+    cache_key: tuple | None = None,
 ) -> PendingDecode:
     """Launch a recovery decode WITHOUT materializing the rebuilt shards;
     resolves to {shard: stream} for `need`.  With an `aggregator`, the
@@ -330,6 +369,23 @@ def decode_shards_launch(
     out = {i: have[i].reshape(-1) for i in need if i in have}
     if not missing:
         return PendingDecode(None, None, result=out)
+    use_cache = (
+        chunk_cache is not None
+        and chunk_cache.enabled
+        and cache_key is not None
+        and cache_key[1] is not None
+    )
+    if use_cache:
+        # whole-shard consult (off 0): a recovery decode right after a
+        # full-extent degraded read of the same generation rides HBM
+        cached = chunk_cache.fetch_many(
+            cache_key[0], missing, cache_key[1], off=0, length=shard_len,
+            kind="decode", stripes=stripes,
+        )
+        if cached is not None:
+            for e in missing:
+                out[e] = cached[e][:shard_len]
+            return PendingDecode(None, None, result=out)
     if _matrix_fast_path(ec):
         erasures = [i for i in range(ec.get_chunk_count()) if i not in have]
         idx = ec.decode_index(erasures)
@@ -342,6 +398,12 @@ def decode_shards_launch(
             handle = ec.decode_array(erasures, survivors)
 
         def _assemble(rec: np.ndarray) -> dict[int, np.ndarray]:
+            if use_cache:
+                for p, e in enumerate(erasures):
+                    chunk_cache.put(
+                        cache_key[0], e, cache_key[1],
+                        rec[:, p, :], off=0,
+                    )
             for p, e in enumerate(erasures):
                 if e in need:
                     out[e] = np.ascontiguousarray(rec[:, p, :]).reshape(-1)

@@ -425,6 +425,68 @@ async def _run(cfg: dict) -> dict:
         )
         report["events"].append("deep scrub under load detected + repaired")
 
+        # ---- phase 3.7: pipelined wedge (ISSUE 11) ----------------------
+        # Launch faults armed while depth>1 launches are IN FLIGHT: a
+        # wedge at pipeline depth must host-fallback every affected
+        # ticket byte-identically WITHOUT losing the other in-flight
+        # groups' tickets, and the donation pool's per-slot refcounts
+        # must never recycle a live buffer (the invariant gauge stays
+        # 0).  The live OSDs' aggregators get the depth through the
+        # runtime config observer — the knob path itself is under test.
+        import numpy as np
+
+        from ceph_tpu.codec.matrix_codec import EncodeAggregator
+        from ceph_tpu.codec.registry import instance as codec_registry
+
+        for o in osds:
+            if o._running:
+                o.conf.set("ec_tpu_pipeline_depth", 3)
+        pipe0 = ec_dispatch.PIPELINE.snapshot()
+        ec42 = codec_registry().factory("tpu", {"k": "4", "m": "2"})
+        pagg = EncodeAggregator(window=2, pipeline_depth=2)
+        nrng = np.random.default_rng(cfg["seed"] ^ 0x11)
+        batches = [
+            nrng.integers(0, 256, (2, 4, 4096), dtype=np.uint8)
+            for _ in range(8)
+        ]
+        inj.inject("codec.launch", 5, hits=2)
+        tickets = [pagg.submit(ec42, b) for b in batches]
+        inj.clear("codec.launch")
+        pagg.flush()
+        wedge_identical = all(
+            np.array_equal(
+                np.asarray(t), np.asarray(ec42.encode_array_host(b))
+            )
+            for t, b in zip(tickets, batches)
+        )
+        assert wedge_identical, (
+            "chaos: pipelined-wedge tickets diverged from the host oracle"
+        )
+        pipe1 = ec_dispatch.PIPELINE.snapshot()
+        max_depth = max(
+            (
+                r.get("inflight_depth", 0)
+                for r in flight_recorder().records()
+                if r["kind"] == "encode"
+            ),
+            default=0,
+        )
+        assert max_depth >= 2, (
+            f"chaos: pipelined wedge never reached depth>1 ({max_depth})"
+        )
+        recycled = (
+            pipe1["donation_recycled_live"]
+            - pipe0["donation_recycled_live"]
+        )
+        assert recycled == 0, (
+            f"chaos: donation pool recycled {recycled} LIVE buffer(s)"
+        )
+        report["pipeline_wedge_tickets"] = len(tickets)
+        report["pipeline_max_inflight_depth"] = max_depth
+        report["pipeline_drains"] = pipe1["drains"] - pipe0["drains"]
+        report["donation_recycled_live"] = recycled
+        report["events"].append("pipelined wedge recovered byte-identical")
+
         # ---- phase 4: OSD flap + recovery -------------------------------
         victim_id = rng.randrange(cfg["osds"])
         victim = osds[victim_id]
@@ -550,6 +612,21 @@ async def _run(cfg: dict) -> dict:
     finally:
         inj.clear()
         device_guard().mark_healthy()
+        # the pipelined-wedge phase raised the process-wide default
+        # aggregators' depth through the OSD observers — restore the
+        # option default so an embedded run (the tier-1 smoke inside a
+        # shared pytest process) leaves no config behind
+        from ceph_tpu.codec.matrix_codec import (
+            default_decode_aggregator,
+            default_encode_aggregator,
+            default_verify_aggregator,
+        )
+        from ceph_tpu.common.options import OPTIONS
+
+        depth_default = int(OPTIONS["ec_tpu_pipeline_depth"].default)
+        for agg in (default_encode_aggregator(), default_decode_aggregator(),
+                    default_verify_aggregator()):
+            agg.configure(pipeline_depth=depth_default)
         await client.shutdown()
         await mgr.stop()
         for o in osds:

@@ -6,10 +6,16 @@ Turns the launch flight recorder's ring (ops/flight_recorder.py; asok
 of infer:
 
 - one process row ("devices") with a lane (tid) per device width the
-  launches spanned, carrying ``h2d`` / ``kernel`` / ``d2h`` slices per
-  launch plus explicit ``idle`` slices for the gaps between consecutive
-  launches on the lane — the idle slices ARE the optimization target of
-  ROADMAP item 2 (overlap H2D with the previous kernel);
+  launches spanned (plus "host fallback" and "device cache" lanes),
+  carrying ``h2d`` / ``kernel`` / ``d2h`` slices per launch plus
+  explicit ``idle`` slices for the gaps between consecutive launches on
+  the lane — the idle slices ARE the optimization target of ROADMAP
+  item 2 (overlap H2D with the previous kernel).  Since ISSUE 11 the
+  slices anchor on completion-ordered timestamps (``complete_ts``):
+  under pipelined dispatch the kernel-wait slice ends where the work
+  actually finished, and the lane distance between a launch's ``h2d``
+  and its wait IS the overlap won (``overlap`` flag +
+  ``inflight_depth`` ride the slice args);
 - one process row ("aggregator") with a lane per aggregator group,
   carrying a ``queue_wait`` slice (submit→dispatch: time the window
   held the work) followed by the launch slice, flags in ``args``;
@@ -77,10 +83,27 @@ def _flags_args(rec: dict) -> dict:
         "devices": rec["devices"],
         "reason": rec.get("reason", ""),
     }
+    # pipeline witness (ISSUE 11): how deep the in-flight ring was when
+    # this launch dispatched (absent on pre-pipeline records)
+    if rec.get("inflight_depth"):
+        args["inflight_depth"] = rec["inflight_depth"]
     flags = [k for k, v in rec.get("flags", {}).items() if v]
     if flags:
         args["flags"] = ",".join(sorted(flags))
     return args
+
+
+def _completion_ts(rec: dict) -> float:
+    """Completion-ordered sort/anchor timestamp (ISSUE 11): under
+    pipelined dispatch the wall clock around the now-nonblocking calls
+    no longer brackets the kernel, so device-lane slices order and
+    anchor on when the WORK finished — ``complete_ts`` when the settle
+    recorded one, else the legacy dispatch anchor."""
+    return (
+        rec.get("complete_ts")
+        or rec.get("dispatch_ts")
+        or rec.get("submit_ts", 0.0)
+    )
 
 
 def export_chrome_trace(records: list[dict]) -> dict:
@@ -94,12 +117,13 @@ def export_chrome_trace(records: list[dict]) -> dict:
     # occupy different hardware, interleaving them on one lane would
     # fabricate overlap conflicts.
     by_lane: dict[str, list[dict]] = {}
-    for rec in sorted(records, key=lambda r: r.get("dispatch_ts", 0.0)):
-        lane = (
-            f"device x{rec['devices']}"
-            if not rec["flags"].get("fallback")
-            else "host fallback"
-        )
+    for rec in sorted(records, key=_completion_ts):
+        if rec["flags"].get("cache_hit"):
+            lane = "device cache"
+        elif rec["flags"].get("fallback"):
+            lane = "host fallback"
+        else:
+            lane = f"device x{rec['devices']}"
         by_lane.setdefault(lane, []).append(rec)
     for lane, recs in sorted(by_lane.items()):
         prev_end_us: int | None = None
@@ -115,12 +139,30 @@ def export_chrome_trace(records: list[dict]) -> dict:
                         {"gap_us": gap},
                     ))
             cursor = start_us
+            # completion-ordered anchors (ISSUE 11): h2d sits at the
+            # dispatch, the kernel-wait slice ENDS at complete_ts, d2h
+            # follows it — the gap between h2d and the wait is time the
+            # device worked under LATER launches' dispatches (overlap),
+            # rendered as lane distance instead of a fabricated
+            # contiguous busy block.  Records without complete_ts (old
+            # dumps, raw records) keep the legacy contiguous layout.
+            complete_us = _us(rec.get("complete_ts") or 0.0)
             spans = [
-                ("h2d", rec.get("h2d_s", 0.0)),
-                ("kernel", rec.get("kernel_s", 0.0)),
-                ("d2h", rec.get("d2h_s", 0.0)),
+                ("h2d", rec.get("h2d_s", 0.0), None),
+                (
+                    "kernel",
+                    rec.get("kernel_s", 0.0),
+                    (complete_us - _us(rec.get("kernel_s", 0.0)))
+                    if complete_us > 0
+                    else None,
+                ),
+                (
+                    "d2h",
+                    rec.get("d2h_s", 0.0),
+                    complete_us if complete_us > 0 else None,
+                ),
             ]
-            if not any(d > 0 for _n, d in spans):
+            if not any(d > 0 for _n, d, _a in spans):
                 # span-less raw record: one marker slice
                 events.append(_complete(
                     f"{rec['kind']} launch", "devices", lane, cursor,
@@ -128,10 +170,12 @@ def export_chrome_trace(records: list[dict]) -> dict:
                 ))
                 cursor += _MIN_DUR_US
             else:
-                for name, dur in spans:
+                for name, dur, anchor in spans:
                     dur_us = _us(dur)
                     if dur_us <= 0:
                         continue
+                    if anchor is not None:
+                        cursor = max(cursor, anchor)
                     events.append(_complete(
                         f"{rec['kind']}:{name}", "devices", lane, cursor,
                         dur_us, _flags_args(rec),

@@ -19,7 +19,7 @@ class TestChaosSmoke:
         assert report["converged"], report
         assert report["lost_writes"] == 0, report
         # every chaos phase actually ran
-        assert len(report["events"]) == 7, report["events"]
+        assert len(report["events"]) == 8, report["events"]
         # ISSUE 10: the mixed-load phase attributed the load per pool
         # (windowed p99 keys ride the report for the bench fold), held
         # the SLO burn rate under bound, and kept trace retention
@@ -36,6 +36,17 @@ class TestChaosSmoke:
         # the launch-fault phase really drove the host fallback
         assert report["degraded_entered"], report
         assert report["fallback_launches"] >= 1, report
+        # ISSUE 11: the pipelined-wedge phase armed launch faults while
+        # depth>1 launches were in flight — every ticket recovered
+        # byte-identically (asserted inside the phase), the ring
+        # provably ran deeper than one launch, and the donation pool
+        # never recycled a live buffer
+        assert report["pipeline_wedge_tickets"] >= 4, report
+        assert report["pipeline_max_inflight_depth"] >= 2, report
+        assert report["donation_recycled_live"] == 0, report
+        # 4 launches through a depth-2 ring MUST overflow it: a zero here
+        # means _drain_pipeline silently stopped bounding the ring
+        assert report["pipeline_drains"] >= 1, report
         # ISSUE 9: the deep-scrub-under-load phase detected the planted
         # corruption through aggregated device verify launches (fewer
         # launches than objects = one launch covered many), and client

@@ -229,6 +229,17 @@ class TestClusterScrapeLint:
                     "verify_bytes"} <= dispatch_keys
             sched_keys = set(launch_scheduler().perf_dump())
             assert {f"sched.{k}" for k in sched_keys} <= dispatch_keys
+            # ISSUE 11 cross-lint: the pipeline-ring slice and the
+            # device-resident chunk-cache counters ride the dispatch
+            # namespace too
+            from ceph_tpu.ops.device_cache import device_chunk_cache
+
+            assert {
+                f"pipeline.{k}" for k in ec_dispatch.PIPELINE.snapshot()
+            } <= dispatch_keys
+            assert {
+                f"cache.{k}" for k in device_chunk_cache().perf_dump()
+            } <= dispatch_keys
 
             def all_reported():
                 text = prom.scrape()
@@ -309,6 +320,27 @@ class TestClusterScrapeLint:
             assert (
                 families["ceph_tpu_ec_sched_client_queue_depth"]["type"]
                 == "gauge"
+            )
+            # ISSUE 11: the pipeline/cache families have EXPLICIT index
+            # rows (the broad `ceph_tpu_ec_dispatch_*` prose token must
+            # not be what documents them), and their level exports are
+            # gauges while the hit/miss traffic stays counter-typed
+            assert "ceph_tpu_ec_dispatch_pipeline_*" in docs, (
+                "pipeline family needs its own docs index row"
+            )
+            assert "ceph_tpu_ec_dispatch_cache_*" in docs, (
+                "device-cache family needs its own docs index row"
+            )
+            for fam in (
+                "ceph_tpu_ec_dispatch_pipeline_depth",
+                "ceph_tpu_ec_dispatch_pipeline_inflight",
+                "ceph_tpu_ec_dispatch_cache_resident_bytes",
+                "ceph_tpu_ec_dispatch_cache_entries",
+            ):
+                assert families[fam]["type"] == "gauge", fam
+            assert (
+                families["ceph_tpu_ec_dispatch_cache_hits"]["type"]
+                == "counter"
             )
             # verify-aggregator families round-trip like the encode/
             # decode aggregators'
