@@ -1,0 +1,89 @@
+"""exception-swallowing: an ``except Exception:`` handler that leaves no
+trace is an invisible failure.
+
+A broad handler is fine when it re-raises, references the caught
+exception (reply/store/format — the failure reaches someone), calls a
+reporting function (``dout``/``clog``/logger methods/
+``mark_degraded``), bumps a counter (``.inc(...)`` or an augmented
+assignment), or is itself inside a loud context.  Anything else
+swallows the failure byte-for-byte: the op completes wrong, the beacon
+silently stops, and nothing anywhere records that it happened.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding, SourceTree
+
+# call names/attrs that count as "the failure left a trace"
+REPORT_CALLS = {
+    "dout", "_dout", "log", "clog", "clog_error", "log_error",
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "mark_degraded", "record_error", "print", "fail",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """except Exception / except BaseException / bare except — including
+    tuple forms containing one of them."""
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _leaves_trace(handler: ast.ExceptHandler) -> bool:
+    alias = handler.name
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if alias and isinstance(node, ast.Name) and node.id == alias \
+                and isinstance(node.ctx, ast.Load):
+            return True  # the exception reaches a reply/store/format
+        if isinstance(node, ast.AugAssign):
+            return True  # counter bump
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if name in REPORT_CALLS or name == "inc":
+                return True
+    return False
+
+
+class ExceptionSwallowPass:
+    PASS_ID = "exception-swallowing"
+    DESCRIBE = (
+        "except Exception: handlers that neither re-raise, log, count, "
+        "reference the exception, nor mark DEGRADED"
+    )
+
+    def __call__(self, tree: SourceTree) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in tree.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node) or _leaves_trace(node):
+                    continue
+                scope = sf.scope_of(node)
+                findings.append(Finding(
+                    pass_id=self.PASS_ID,
+                    file=sf.rel,
+                    line=node.lineno,
+                    key=f"{sf.rel}::{scope}",
+                    message=(
+                        "broad except handler swallows the failure "
+                        "invisibly — re-raise, log (dout/clog), count a "
+                        "perf counter, or allowlist with the reason the "
+                        "silence is safe"
+                    ),
+                ))
+        return findings

@@ -27,6 +27,8 @@ try:  # jax.core.Tracer is being removed from the public surface (jax >= 0.6)
 except (ImportError, AttributeError):
     from jax._src.core import Tracer as _JaxTracer
 
+from ceph_tpu.common.lockdep import make_lock as _lockdep_make_lock
+from ceph_tpu.common.lockdep import make_rlock as _lockdep_make_rlock
 from ceph_tpu.gf import expand_matrix, isa_decode_matrix
 from ceph_tpu.ops.dispatch import record_launch
 from ceph_tpu.ops.packed_gf import (
@@ -49,7 +51,7 @@ DECODE_LRU_CAPACITY = 2516
 # jnp-backed PLAN_CACHE — degraded mode must never touch the runtime.
 _HOST_DECODE_CAPACITY = 256
 _HOST_DECODE_PLANS: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
-_HOST_DECODE_LOCK = threading.Lock()
+_HOST_DECODE_LOCK = _lockdep_make_lock("host_decode")
 
 
 def _trace_local(x) -> bool:
@@ -658,8 +660,9 @@ class LaunchAggregator:
 
         PIPELINE.set_depth(self.pipeline_depth)
         # RLock: a reap (`_materialize`) forces its group's launch from
-        # inside the lock; lockdep's DebugLock is not reentrant
-        self._lock = threading.RLock()
+        # inside the lock (make_rlock: per-instance reentrant, ordering
+        # still validated on the outermost acquire)
+        self._lock = _lockdep_make_rlock(self.PERF_NAME)
         self._groups: "OrderedDict[tuple, _AggGroup]" = OrderedDict()
         # per-shape retention follows the ring depth: more dead buffers
         # than launches that can be in flight would only pin HBM

@@ -20,6 +20,8 @@ import importlib
 import threading
 from typing import Callable
 
+from ceph_tpu.common.lockdep import make_lock, make_rlock
+
 from .interface import EcError, ErasureCodeInterface, Profile
 
 # The ABI version plugins must declare (reference: CEPH_GIT_NICE_VER check).
@@ -48,10 +50,10 @@ class ErasureCodePluginRegistry:
     """Singleton get-or-load registry (ErasureCodePlugin.h:45)."""
 
     _instance: "ErasureCodePluginRegistry | None" = None
-    _instance_lock = threading.Lock()
+    _instance_lock = make_lock("codec_registry_instance")
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("codec_registry")
         self._plugins: dict[str, ErasureCodePlugin] = {}
         self.disable_dlclose = False  # kept for harness parity (bench sets it)
 

@@ -106,7 +106,9 @@ class ErasureCodeShec(MatrixCodecMixin, ErasureCode):
         self.c = 0
         self.w = 8
         self._decode_search_cache: dict[tuple, tuple] = {}
-        self._lock = threading.Lock()
+        from ceph_tpu.common.lockdep import make_lock
+
+        self._lock = make_lock("shec_decode_cache")
 
     # -- init ---------------------------------------------------------------
 

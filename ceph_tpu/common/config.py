@@ -14,6 +14,7 @@ import os
 import threading
 from typing import Callable, Iterable
 
+from .lockdep import make_rlock
 from .options import OPTIONS, Option
 
 ConfigObserver = Callable[[str, object], None]
@@ -28,7 +29,7 @@ class Config:
         conf_file: str | None = None,
         env: bool = True,
     ):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("config")
         self._values: dict[str, object] = {
             name: opt.default for name, opt in OPTIONS.items()
         }

@@ -14,6 +14,8 @@ from __future__ import annotations
 import random
 import threading
 
+from .lockdep import make_lock
+
 
 class InjectedFailure(Exception):
     def __init__(self, point: str, err: int):
@@ -27,7 +29,7 @@ class FaultInjector:
     optional remaining-hits budget."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("fault_injector")
         self._points: dict[str, tuple[int, int]] = {}  # name -> (errno, hits)
         self._probabilistic: dict[str, float] = {}  # name -> probability
         self._rng = random.Random(0xEC)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 import time
 
+from .lockdep import make_lock
 from .perf_counters import PerfHistogram, PerfHistogramAxis
 
 OP_CLASSES = ("read", "write", "recovery")
@@ -87,7 +88,7 @@ class IOAccountant:
     IDLE_EVICT_SEC = 60.0
 
     def __init__(self, max_clients_per_pool: int = 64):
-        self._lock = threading.Lock()
+        self._lock = make_lock("io_accountant")
         self.max_clients_per_pool = int(max_clients_per_pool)
         # pool id -> op class -> _ClassIO
         self._pools: dict[int, dict[str, _ClassIO]] = {}

@@ -37,6 +37,7 @@ class _Registry:
     def __init__(self) -> None:
         self._graph: dict[str, set[str]] = {}  # edge a -> b: b taken under a
         self._mutex = threading.Lock()
+        self._violations = 0  # LockOrderErrors raised (lifetime)
 
     def clear(self) -> None:
         with self._mutex:
@@ -46,26 +47,42 @@ class _Registry:
         with self._mutex:
             return {k: set(v) for k, v in self._graph.items()}
 
+    def violations(self) -> int:
+        with self._mutex:
+            return self._violations
+
+    def _violation(self, msg: str) -> LockOrderError:
+        # counted so harnesses (tools/chaos.py) can assert ZERO even when
+        # a daemon task swallowed the raise with the rest of its failure
+        self._violations += 1
+        return LockOrderError(msg)
+
     def check_acquire(self, held: list[str], name: str) -> None:
         """Pre-acquire validation: raises on self-deadlock or an ordering
         cycle.  Records NOTHING — edges are committed by record_acquire
         only once the lock is actually taken, so a failed or abandoned
         acquire cannot pollute the graph."""
+        if not held:
+            return
         if name in held:
-            raise LockOrderError(
-                f"lockdep: re-acquiring held lock {name!r} (self-deadlock)"
-            )
+            with self._mutex:
+                raise self._violation(
+                    f"lockdep: re-acquiring held lock {name!r} "
+                    "(self-deadlock)"
+                )
         with self._mutex:
             for h in held:
                 # would edge h -> name close a cycle? (name ~> h exists)
                 if self._reaches(name, h):
-                    raise LockOrderError(
+                    raise self._violation(
                         f"lockdep: acquiring {name!r} while holding {h!r}, "
                         f"but {h!r} has been taken under {name!r} before — "
                         f"lock-order cycle (latent deadlock)"
                     )
 
     def record_acquire(self, held: list[str], name: str) -> None:
+        if not held:
+            return
         with self._mutex:
             for h in held:
                 self._graph.setdefault(h, set()).add(name)
@@ -112,6 +129,20 @@ def clear() -> None:
 def edges() -> dict[str, set[str]]:
     """Observed ordering graph (lockdep's dependency dump)."""
     return _REGISTRY.edges()
+
+
+def violations() -> int:
+    """LockOrderErrors raised so far (process lifetime).  Harnesses
+    snapshot this at run start and assert a zero delta — a violation
+    that a daemon task swallowed with the rest of its failure still
+    counts."""
+    return _REGISTRY.violations()
+
+
+def graph_dump() -> dict[str, list[str]]:
+    """JSON-ready ordering graph: lock name -> sorted locks ever taken
+    under it (the chaos report's `lockdep_graph` payload)."""
+    return {k: sorted(v) for k, v in sorted(_REGISTRY.edges().items())}
 
 
 def _thread_stack() -> list[str]:
@@ -177,6 +208,62 @@ class DebugLock:
         self.release()
 
 
+class DebugRLock:
+    """threading.RLock with ordering validation.  Reentrancy is
+    per-INSTANCE (like RLock itself): a nested acquire of the same
+    object neither re-validates nor re-pushes the held-stack entry, so
+    the reap-inside-reap patterns the aggregators rely on stay legal
+    while cross-lock ordering is still checked on the outermost
+    acquire."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.RLock()
+        self._local = threading.local()  # per-thread depth on THIS object
+
+    def _depth(self) -> int:
+        return getattr(self._local, "depth", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not _enabled:
+            got = self._lock.acquire(blocking, timeout)
+            if got:
+                self._local.depth = self._depth() + 1
+            return got
+        depth = self._depth()
+        if depth:  # reentrant: already validated at the outermost acquire
+            got = self._lock.acquire(blocking, timeout)
+            if got:
+                self._local.depth = depth + 1
+            return got
+        stack = _thread_stack()
+        if blocking:
+            _REGISTRY.check_acquire(stack, self.name)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _REGISTRY.record_acquire(stack, self.name)
+            stack.append(self.name)
+            self._local.depth = 1
+        return got
+
+    def release(self) -> None:
+        depth = self._depth()
+        if depth:
+            self._local.depth = depth - 1
+            if depth == 1:
+                stack = _thread_stack()
+                if self.name in stack:
+                    stack.remove(self.name)
+        self._lock.release()
+
+    def __enter__(self) -> "DebugRLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
 class DebugAsyncLock:
     """asyncio.Lock with ordering validation; held-set is per-task.
     Cross-task release (the asyncio.Lock handoff pattern) is supported:
@@ -223,6 +310,12 @@ def make_lock(name: str) -> DebugLock:
     read when off), so module-level singleton locks created at import
     time still participate when lockdep.enable() runs later."""
     return DebugLock(name)
+
+
+def make_rlock(name: str) -> DebugRLock:
+    """Reentrant variant for subsystems whose hold patterns re-enter
+    (aggregator reap-forced launches, the config proxy)."""
+    return DebugRLock(name)
 
 
 def make_async_lock(name: str) -> DebugAsyncLock:

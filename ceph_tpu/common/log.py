@@ -17,6 +17,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from .lockdep import make_lock
+
 
 @dataclass
 class LogEntry:
@@ -63,7 +65,7 @@ class Log:
         self._path = path
         self._queue: collections.deque[LogEntry] = collections.deque()
         self._recent: collections.deque[LogEntry] = collections.deque(maxlen=max_recent)
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(make_lock("log_sink"))
         self._stop = False
         self._file = None
         if path:
@@ -154,7 +156,7 @@ class LogClient:
 
 # Process-wide default client (the reference's g_ceph_context->_log).
 _default: LogClient | None = None
-_default_lock = threading.Lock()
+_default_lock = make_lock("log_default")
 
 
 def default_client() -> LogClient:

@@ -442,9 +442,9 @@ OPTIONS: dict[str, Option] = _opts(
     # --- monitor ------------------------------------------------------------
     Option("mon_lease", float, 5.0, A, "paxos lease seconds (Paxos.h)"),
     Option("mon_tick_interval", float, 1.0, A, ""),
-    Option("mon_osd_min_down_reporters", int, 1, A,
+    Option("mon_osd_min_down_reporters", int, 2, A,
            "distinct reporters needed to mark an osd down "
-           "(OSDMonitor.cc can_mark_down quorum)"),
+           "(OSDMonitor.cc can_mark_down quorum; reference default 2)"),
     Option("mon_osd_reporter_subtree_level", str, "host", A, ""),
     Option("mon_osd_down_out_interval", float, 30.0, A,
            "seconds down before an osd is marked out"),

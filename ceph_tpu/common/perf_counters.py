@@ -12,6 +12,8 @@ collection too.
 from __future__ import annotations
 
 import threading
+
+from .lockdep import make_lock
 from dataclasses import dataclass, field
 
 
@@ -139,7 +141,7 @@ class PerfCounters:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("perf_counters")
         self._counters: dict[str, _Counter] = {}
 
     # -- updates (perf_counters.h inc/dec/set/tinc) --------------------------
@@ -274,7 +276,7 @@ class PerfCountersCollection:
     PerfCountersCollection; surfaced via the admin socket)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("perf_counters_collection")
         self._loggers: dict[str, PerfCounters] = {}
 
     def add(self, pc: PerfCounters) -> None:

@@ -13,6 +13,8 @@ from __future__ import annotations
 import asyncio
 import threading
 
+from .lockdep import make_async_lock, make_lock
+
 
 class Throttle:
     """Blocking counting throttle (Throttle.h)."""
@@ -21,7 +23,7 @@ class Throttle:
         self.name = name
         self._limit = limit
         self._count = 0
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(make_lock(f"throttle.{name}"))
 
     @property
     def current(self) -> int:
@@ -88,7 +90,13 @@ class AsyncThrottle:
 
     def _condition(self) -> asyncio.Condition:
         if self._cond is None:
-            self._cond = asyncio.Condition()
+            # lockdep-instrumented inner lock (asyncio.Condition duck-
+            # types over acquire/release/locked): the dispatch-throttle
+            # lock sits on the message-delivery path and must
+            # participate in lock-order validation like every other
+            self._cond = asyncio.Condition(
+                make_async_lock(f"async_throttle.{self.name}")
+            )
         return self._cond
 
     @property

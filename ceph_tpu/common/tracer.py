@@ -30,6 +30,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from .lockdep import make_lock
+
 
 # envelope sampling-decision values (msg.trace_sampled): the head
 # decision is made ONCE — at the first daemon with sampling CONFIGURED
@@ -165,7 +167,7 @@ class Tracer:
         # trace: offset each tracer's counter by a random 63-bit base (the
         # reference gets uniqueness from otel's random 64-bit span ids)
         self._id_base = random.getrandbits(63) & ~0xFFFFF
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer")
         # ring buffer: the NEWEST max_spans survive — an operator dumping
         # traces to debug a current problem needs recent spans, not the
         # daemon's boot-time history

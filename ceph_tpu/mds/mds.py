@@ -385,8 +385,11 @@ class MDS(Dispatcher):
             self._dirty.discard(ev["ino"])
             try:
                 await self.meta.remove(f"dir.{ev['ino']}")
-            except Exception:
-                pass
+            except Exception as e:
+                # replayed rmdir of an already-gone object: expected on
+                # re-replay, logged so real pool errors stay visible
+                dout("mds", 4,
+                     f"mds.{self.name}: replay rmdir {ev['ino']}: {e!r}")
         elif op == "inotable":
             self._next_ino = ev["next"]
             self._ino_dirty = True
@@ -476,7 +479,11 @@ class MDS(Dispatcher):
             try:
                 raw = await self.meta.read(f"dir.{ino}")
                 d = json.loads(raw.decode() or "{}")
-            except Exception:
+            except Exception as e:
+                # an unreadable/undecodable dirfrag treated as empty is
+                # potential METADATA LOSS — never swallow it silently
+                dout("mds", 1, f"mds.{self.name}: dirfrag {ino} "
+                               f"unreadable, treating as empty: {e!r}")
                 d = {}
             self._dirs[ino] = d
             for name, entry in d.items():

@@ -26,6 +26,7 @@ class BalancerModule(MgrModule):
         self.threshold = threshold  # max/mean PG ratio triggering a move
         self.max_adjustments = max_adjustments  # per tick (upmap_max_optimizations)
         self.last_plan: list[dict] = []
+        self.map_errors = 0  # unmappable PGs skipped (visible, not silent)
 
     # -- scoring ---------------------------------------------------------------
 
@@ -37,7 +38,14 @@ class BalancerModule(MgrModule):
             for ps in range(pool.pg_num):
                 try:
                     _u, _up, acting, _p = osdmap.pg_to_up_acting_osds(pool.id, ps)
-                except Exception:
+                except Exception as e:
+                    # CRUSH can legitimately fail to map a PG mid-churn,
+                    # but the failure must leave a trace (ISSUE 12):
+                    # balancing on a silently partial count set would
+                    # "even out" load that is actually unmapped
+                    self.map_errors += 1
+                    dout("mgr", 4,
+                         f"balancer: pg {pool.id}.{ps} unmappable: {e!r}")
                     continue
                 for osd in acting:
                     if osd != PG_NONE and osd in counts:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 
+from ..common.log import dout
 from .modules import HttpServedModule, MgrModule
 
 
@@ -21,6 +22,7 @@ class DashboardModule(HttpServedModule, MgrModule):
     def __init__(self, port: int = 0):
         MgrModule.__init__(self)
         HttpServedModule.__init__(self, port)
+        self.map_errors = 0  # unmappable PGs skipped (visible, not silent)
 
     # -- REST payloads (dashboard/controllers/{health,osd,pool,...}.py) ------
 
@@ -81,7 +83,10 @@ class DashboardModule(HttpServedModule, MgrModule):
             for ps in range(p.pg_num):
                 try:
                     up, primary, acting, _ = m.pg_to_up_acting_osds(p.id, ps)
-                except Exception:
+                except Exception as e:
+                    self.map_errors += 1
+                    dout("mgr", 4,
+                         f"dashboard: pg {p.id}.{ps} unmappable: {e!r}")
                     continue
                 out.append(
                     {

@@ -36,6 +36,7 @@ import time
 from collections import deque
 
 from ..common.io_accounting import OP_CLASSES
+from ..common.log import dout
 from ..common.perf_counters import histogram_sample_lines
 from .modules import MgrModule
 
@@ -236,6 +237,7 @@ class IostatModule(MgrModule):
         self._last_tick = 0.0
         # pools currently breaching (hysteresis + clear detection)
         self.breaches: dict[str, dict] = {}
+        self.config_errors = 0  # skipped config reads (visible, not silent)
 
     # -- config ----------------------------------------------------------------
 
@@ -248,8 +250,12 @@ class IostatModule(MgrModule):
                 continue
             try:
                 self._conf[name] = conf.get(name)
-            except Exception:
-                pass  # stripped test configs
+            except Exception as e:
+                # stripped test configs miss keys — but the skip must
+                # leave a trace, or a typo'd option name would silently
+                # pin the default forever (ISSUE 12)
+                self.config_errors += 1
+                dout("mgr", 4, f"iostat: config read {name!r}: {e!r}")
 
     def _pool_names(self) -> dict[str, str]:
         osdmap = getattr(self.mgr, "osdmap", None)

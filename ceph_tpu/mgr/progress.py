@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import time
 
+from ..common.log import dout
 from .modules import MgrModule
 
 # rate smoothing: EMA weight of the newest inter-report sample.  High
@@ -214,6 +215,7 @@ class ProgressModule(MgrModule):
         self.events: dict[tuple[str, str], _Event] = {}
         self.completed = 0  # events that ran to completion (gauge)
         self.expired = 0    # events dropped mid-flight (reporter died)
+        self.config_errors = 0  # skipped config reads (visible, not silent)
 
     # -- aggregation -----------------------------------------------------------
 
@@ -228,8 +230,11 @@ class ProgressModule(MgrModule):
             return
         try:
             self.stall_sec = float(conf.get("mgr_progress_stall_sec"))
-        except Exception:
-            pass  # option table without the key (stripped test configs)
+        except Exception as e:
+            # stripped test configs miss the key — trace the skip so a
+            # typo'd option can't silently pin the default (ISSUE 12)
+            self.config_errors += 1
+            dout("mgr", 4, f"progress: config read failed: {e!r}")
 
     def tick(self) -> None:
         now = time.monotonic()

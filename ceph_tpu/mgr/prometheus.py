@@ -9,6 +9,7 @@ the reference's cherrypy server (module.py StandbyModule/Module).
 
 from __future__ import annotations
 
+from ..common.log import dout
 from ..common.perf_counters import histogram_sample_lines
 from .modules import HttpServedModule, MgrModule
 
@@ -50,6 +51,7 @@ class PrometheusModule(HttpServedModule, MgrModule):
     def __init__(self, port: int = 0):
         MgrModule.__init__(self)
         HttpServedModule.__init__(self, port)
+        self.scrape_errors = 0  # module families lost (visible, not silent)
 
     # -- exposition ------------------------------------------------------------
 
@@ -116,9 +118,15 @@ class PrometheusModule(HttpServedModule, MgrModule):
                 continue
             try:
                 families_out = metrics()
-            except Exception:
+            except Exception as e:
                 # same contract as Mgr._module_loop: one faulty module
-                # loses its own families, never the whole exposition
+                # loses its own families, never the whole exposition —
+                # but the loss is logged + counted, not invisible
+                self.scrape_errors += 1
+                dout("mgr", 1,
+                     f"prometheus: module "
+                     f"{getattr(module, 'NAME', '?')} metrics raised "
+                     f"{e!r}")
                 continue
             for name, ftype, help_, rows in families_out:
                 family(name, ftype, help_).extend(rows)

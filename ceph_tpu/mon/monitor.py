@@ -59,6 +59,7 @@ class Monitor(Dispatcher):
         name: str,
         monmap: MonMap,
         election_timeout: float = 0.5,
+        conf=None,  # common.config.Config; None = option-table defaults
         keyring=None,  # KeyRing enabling cephx on this mon's sessions
         secure: bool = False,
         compress: bool = False,
@@ -70,6 +71,11 @@ class Monitor(Dispatcher):
         self.name = name
         self.monmap = monmap
         self.rank = monmap.rank_of(name)
+        if conf is None:
+            from ..common.config import Config
+
+            conf = Config({"name": name})
+        self.conf = conf
         auth = None
         if keyring is not None:
             from ..auth.cephx import CephxAuth
@@ -91,7 +97,12 @@ class Monitor(Dispatcher):
         self.paxos = Paxos(self.rank, self._send_mon_paxos, self._apply_commit)
         self.quorum: list[int] = []
         self.leader_rank: int | None = None
-        self.osdmon = OSDMonitor(self)
+        self.osdmon = OSDMonitor(
+            self,
+            min_down_reporters=int(
+                self.conf.get("mon_osd_min_down_reporters")
+            ),
+        )
         self.mgrmon = MgrMonitor(self)
         self.mdsmon = MDSMonitor(self)
         self.configmon = ConfigMonitor(self)
@@ -175,7 +186,7 @@ class Monitor(Dispatcher):
         """Monitor::tick: periodic service timers (mgr beacon grace,
         future health checks) on the leader."""
         while True:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(self.conf.get("mon_tick_interval"))
             if self.is_leader():
                 self.mgrmon.tick()
                 self.mdsmon.tick()

@@ -50,6 +50,12 @@ class OSDMonitor:
         self.failure_reports: dict[int, dict[str, float]] = {}
         self.min_down_reporters = min_down_reporters
         self.report_expiry = 20.0  # seconds a failure report stays valid
+        # down-and-in OSDs awaiting auto-out (mon_osd_down_out_interval)
+        self._down_since: dict[int, float] = {}
+        # OSDs the sweep auto-outed: marked back IN on reboot (the
+        # reference's mon_osd_auto_mark_auto_out_in), unlike an
+        # operator's explicit `osd out` which sticks
+        self._auto_outed: set[int] = set()
         # queued mutations: (mutate(map) -> rs, reply or None)
         self._pending: list[tuple[Callable, Callable | None]] = []
         self._proposing = False
@@ -73,6 +79,24 @@ class OSDMonitor:
             def init(m: OSDMap) -> str:
                 m.fsid = "tpu-fsid"
                 m.crush.add_bucket("default", "root")
+                # seed the bootstrap EC profile from
+                # osd_pool_default_erasure_code_profile so
+                # `pool create ... erasure` works out of the box (the
+                # option existed since PR 1 but was never read — the
+                # ISSUE 12 config-coherence pass caught the drift)
+                try:
+                    raw = self.mon.conf.get(
+                        "osd_pool_default_erasure_code_profile"
+                    )
+                    prof = dict(
+                        kv.split("=", 1) for kv in str(raw).split() if "=" in kv
+                    )
+                    m.erasure_code_profiles["default"] = (
+                        self._normalize_profile(prof)
+                    )
+                except Exception as e:
+                    dout("mon", 1,
+                         f"default EC profile unseedable: {e!r}")
                 return "created initial map"
 
             self._queue(init, None)
@@ -166,6 +190,14 @@ class OSDMonitor:
                 m.add_osd(osd, addr=addr, up=True)
             else:
                 m.set_osd_state(osd, True, addr)
+                if osd in self._auto_outed:
+                    # the down-out sweep outed it, not an operator:
+                    # a reboot marks it back in so its capacity returns
+                    from ..crush.crush import WEIGHT_ONE
+
+                    self._auto_outed.discard(osd)
+                    if m.osds[osd].weight == 0:
+                        m.set_osd_weight(osd, WEIGHT_ONE)
             self.failure_reports.pop(osd, None)
             return f"osd.{osd} boot"
 
@@ -295,7 +327,9 @@ class OSDMonitor:
     def _cmd_pool_create(self, cmd, reply) -> None:
         name = cmd["pool"]
         pool_type = cmd.get("pool_type", "replicated")
-        pg_num = int(cmd.get("pg_num", 8))
+        pg_num = int(cmd.get(
+            "pg_num", self.mon.conf.get("osd_pool_default_pg_num")
+        ))
 
         if pool_type == "erasure":
             profile_name = cmd.get("erasure_code_profile", "default")
@@ -310,7 +344,10 @@ class OSDMonitor:
                      if not k.startswith("crush-") and k != "stripe_unit"},
                 )
                 k = ec.get_data_chunk_count()
-                stripe_unit = int(prof.get("stripe_unit", DEFAULT_STRIPE_UNIT))
+                stripe_unit = int(prof.get(
+                    "stripe_unit",
+                    self.mon.conf.get("osd_pool_erasure_code_stripe_unit"),
+                ))
                 # stripe_unit must equal the codec chunk size
                 # (OSDMonitor.cc:7437-7455)
                 chunk = ec.get_chunk_size(k * stripe_unit)
@@ -337,6 +374,11 @@ class OSDMonitor:
                     erasure_code_profile=profile_name,
                     stripe_width=k * stripe_unit,
                     flags=flags,
+                    # osd_fast_read: the pool-level default for issuing
+                    # k+m sub-reads with the first k winning
+                    fast_read=bool(cmd.get(
+                        "fast_read", self.mon.conf.get("osd_fast_read")
+                    )),
                 )
                 return f"pool '{name}' created"
 
@@ -382,11 +424,13 @@ class OSDMonitor:
         self._queue(mutate, reply)
 
     def tick(self) -> None:
-        """Quota enforcement (leader): compare the mgr's PGMap digest
-        against pool quotas and flip FLAG_FULL_QUOTA via paxos
-        (OSDMonitor::tick + the reference's pool-full checks)."""
+        """Leader timers: auto-out of long-down OSDs
+        (mon_osd_down_out_interval, OSDMonitor::tick's down-out sweep)
+        and quota enforcement — compare the mgr's PGMap digest against
+        pool quotas and flip FLAG_FULL_QUOTA via paxos."""
         if not self.mon.is_leader():
             return
+        self._tick_down_out()
         stats = (self.mon.pg_digest or {}).get("pools", {})
         for p in list(self.osdmap.pools.values()):
             if not p.quota_max_bytes and not p.quota_max_objects:
@@ -634,6 +678,33 @@ class OSDMonitor:
                 }
             ).encode(),
         )
+
+    def _tick_down_out(self) -> None:
+        """mon_osd_down_out_interval: an OSD that stays down for the
+        interval is marked OUT so CRUSH remaps its data and recovery
+        starts — without it a dead OSD's PGs stay degraded forever
+        unless an operator runs `osd out` by hand.  <= 0 disables the
+        sweep.  (The option existed since PR 1 but was never read — the
+        ISSUE 12 config-coherence pass caught the drift.)"""
+        interval = float(self.mon.conf.get("mon_osd_down_out_interval"))
+        now = time.monotonic()
+        for oid, info in list(self.osdmap.osds.items()):
+            if info.up or not info.in_:
+                self._down_since.pop(oid, None)
+                continue
+            t0 = self._down_since.setdefault(oid, now)
+            if interval <= 0 or now - t0 < interval:
+                continue
+            self._down_since.pop(oid, None)
+
+            def mutate(m: OSDMap, oid=oid) -> str:
+                m.set_osd_weight(oid, 0)
+                self._auto_outed.add(oid)
+                return f"osd.{oid} marked out after {interval:.0f}s down"
+
+            dout("mon", 1, f"osd.{oid} down {now - t0:.0f}s >= "
+                           f"{interval:.0f}s: marking out")
+            self._queue(mutate, None)
 
     def _cmd_out(self, cmd, reply) -> None:
         osd = int(cmd["id"])

@@ -378,6 +378,7 @@ class Messenger:
         addr: str = "",
         crc_data: bool = True,
         inject_socket_failures: int = 0,
+        inject_internal_delays: float = 0.0,
         dispatch_throttle_bytes: int = 0,
         auth=None,  # CephxAuth (src/auth/cephx); None = auth_none
         secure: bool = False,  # AES-GCM sessions (ms_mode=secure)
@@ -398,6 +399,9 @@ class Messenger:
         self.secure = secure
         self.compress = compress
         self.inject_socket_failures = inject_socket_failures
+        # ms_inject_internal_delays (global.yaml.in:1271): seconds of
+        # injected sleep before local delivery, runtime-mutable
+        self.inject_internal_delays = float(inject_internal_delays)
         self.resends = 0  # lossless transparent resends (fault recovery)
         self._rng = random.Random(hash(name) & 0xFFFF)
         self.dispatchers: list[Dispatcher] = []
@@ -519,7 +523,11 @@ class Messenger:
                         ),
                         timeout=5.0,
                     )
-                except Exception:  # AuthError, timeout, protocol noise
+                except Exception as e:  # AuthError, timeout, noise
+                    # a rejected accept must be visible: silent drops
+                    # look like a network blackhole to the operator
+                    dout("ms", 1,
+                         f"{self.name}: accept auth failed: {e!r}")
                     writer.close()
                     return
             if chosen:
@@ -548,6 +556,8 @@ class Messenger:
     # -- delivery ------------------------------------------------------------
 
     async def _deliver(self, conn: Connection, msg: Message) -> None:
+        if self.inject_internal_delays > 0:
+            await asyncio.sleep(self.inject_internal_delays)
         size = 64  # envelope floor; payload length dominates below
         if self._throttle is not None:
             await self._throttle.get(size)

@@ -43,6 +43,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ceph_tpu.common.lockdep import make_lock
+
 
 class _Entry:
     __slots__ = ("buf", "nbytes", "generation", "off")
@@ -62,7 +64,7 @@ class DeviceChunkCache:
             from ceph_tpu.common.options import OPTIONS
 
             max_bytes = int(OPTIONS["ec_tpu_device_cache_bytes"].default)
-        self._lock = threading.Lock()
+        self._lock = make_lock("device_cache")
         # (obj, shard, off) -> _Entry; generation checked on get so a
         # stale-generation entry is replaced in place by the next put
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
@@ -77,6 +79,7 @@ class DeviceChunkCache:
         self.evictions = 0
         self.invalidations = 0
         self.served_bytes = 0
+        self.put_failures = 0
 
     # -- configuration -------------------------------------------------------
 
@@ -126,7 +129,11 @@ class DeviceChunkCache:
             device_guard().mark_degraded(f"cache put: {e}")
             return False
         except Exception:
-            return False  # a broken runtime must never fail the producer
+            # a broken runtime must never fail the producer — but the
+            # refusal is counted (`cache.put_failures` on the perf dump),
+            # not invisible
+            self.put_failures += 1
+            return False
         with self._lock:
             key = (obj, int(shard), int(off))
             old = self._entries.pop(key, None)
@@ -286,6 +293,7 @@ class DeviceChunkCache:
                 "insertions": self.insertions,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "put_failures": self.put_failures,
                 "served_bytes": self.served_bytes,
                 "resident_bytes": self._bytes,
                 "entries": len(self._entries),

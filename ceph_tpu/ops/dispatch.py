@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import threading
 
+from ceph_tpu.common.lockdep import make_lock
+
 
 class LaunchCounter:
     """Monotonic totals: device dispatches, stripes and bytes they carried."""
@@ -34,7 +36,7 @@ class LaunchCounter:
     __slots__ = ("_lock", "launches", "stripes", "bytes")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("launch_counter")
         self.launches = 0
         self.stripes = 0
         self.bytes = 0
@@ -94,7 +96,7 @@ class DeviceOccupancy:
     __slots__ = ("_lock", "counts", "device_launches")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("device_occupancy")
         self.counts: dict[int, int] = {}
         self.device_launches = 0  # sum(devices) over every dispatch
 
@@ -135,7 +137,7 @@ class PipelineGauges:
                  "donation_reuses", "donation_recycled_live")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("pipeline_gauges")
         self.depth = 0
         self.inflight = 0
         self.inflight_peak = 0

@@ -54,6 +54,8 @@ import threading
 import time
 from collections import deque
 
+from ceph_tpu.common.lockdep import make_lock
+
 DEFAULT_CAPACITY = 512
 
 # the record the CURRENT dispatch runs under (a plain mutable dict):
@@ -134,7 +136,7 @@ class FlightRecorder:
     """Process-wide bounded ring of completed launch records."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
-        self._lock = threading.Lock()
+        self._lock = make_lock("flight_recorder")
         self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))
         self._seq = itertools.count(1)
         # utilization epoch: busy-seconds accumulate from here; reset()

@@ -28,6 +28,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ceph_tpu.common.lockdep import make_lock
+
 
 class DeviceTimeout(RuntimeError):
     """A guarded device call exceeded its per-launch deadline."""
@@ -63,7 +65,7 @@ class DeviceGuard:
                 probe_interval_ms = int(
                     OPTIONS["ec_tpu_probe_interval_ms"].default
                 )
-        self._lock = threading.Lock()
+        self._lock = make_lock("device_guard")
         self.timeout_ms = int(timeout_ms)
         self.probe_interval_ms = int(probe_interval_ms)
         self.degraded = False

@@ -40,6 +40,8 @@ import threading
 import time
 from typing import Callable
 
+from ceph_tpu.common.lockdep import make_lock
+
 from ceph_tpu.osd.scheduler import (
     ClientProfile,
     MClockScheduler,
@@ -111,7 +113,7 @@ class LaunchScheduler:
         if profiles is None:
             profiles = default_profiles()
         self._mclock = MClockScheduler(profiles=profiles, clock=clock)
-        self._lock = threading.Lock()
+        self._lock = make_lock("launch_scheduler")
         self._cv = threading.Condition(self._lock)
         self._busy = False  # a launch is executing (the device turn)
         # bytes_total: input bytes dispatched per lane (ISSUE 11) — with

@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ceph_tpu.common.lockdep import make_lock
 from ceph_tpu.gf.tables import GF_MUL_TABLE
 
 from .dispatch import record_launch
@@ -244,7 +245,7 @@ def program_cost(prog) -> int:
 # the host-fallback oracle re-derives the program per launch without it.
 _PROGRAM_MEMO_CAPACITY = 512
 _PROGRAM_MEMO: "dict[tuple, tuple]" = {}
-_PROGRAM_LOCK = threading.Lock()
+_PROGRAM_LOCK = make_lock("packed_program_cache")
 
 
 def best_program(gf_matrix: np.ndarray) -> tuple:

@@ -26,6 +26,7 @@ Structure mirrored from the reference (§3.3 of SURVEY.md):
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 
 from ..common import tracer as tracer_mod
@@ -117,6 +118,8 @@ class OSD(Dispatcher):
         msgr_kw = dict(
             crc_data=self.conf.get("ms_crc_data"),
             inject_socket_failures=self.conf.get("ms_inject_socket_failures"),
+            inject_internal_delays=self.conf.get("ms_inject_internal_delays"),
+            dispatch_throttle_bytes=self.conf.get("ms_dispatch_throttle_bytes"),
             auth=auth,
             secure=self.conf.get("ms_secure"),
             compress=self.conf.get("ms_compress"),
@@ -129,9 +132,63 @@ class OSD(Dispatcher):
             monmap,
             msgr=Messenger(f"osd.{whoami}", **msgr_kw),
         )
+        # the ms_inject_* fault knobs are runtime-mutable (the chaos
+        # harness arms them mid-run via injectargs/config set): changes
+        # must reach BOTH live messengers, not just the next boot
+        def _apply_ms_inject(name: str, v) -> None:
+            for m in (self.msgr, self.monc.msgr):
+                if name == "ms_inject_socket_failures":
+                    m.inject_socket_failures = int(v)
+                else:
+                    m.inject_internal_delays = float(v)
+
+        self.conf.add_observer(
+            ["ms_inject_socket_failures", "ms_inject_internal_delays"],
+            _apply_ms_inject,
+        )
         self.osdmap = OSDMap()
         self.pgs: dict[tuple[int, int], PG] = {}
-        self.sched = make_scheduler(self.conf.get("osd_op_queue"))
+        # op scheduler: the osd_mclock_* dmClock triples come from the
+        # option table (they were declared runtime-mutable since PR 1 but
+        # never read — the ISSUE 12 config-coherence pass caught the
+        # drift); any knob changing re-derives all three profiles live
+        def _mclock_profiles() -> dict:
+            from .scheduler import ClientProfile
+
+            return {
+                SchedClass.CLIENT: ClientProfile(
+                    reservation=self.conf.get("osd_mclock_client_res"),
+                    weight=self.conf.get("osd_mclock_client_wgt"),
+                    limit=self.conf.get("osd_mclock_client_lim"),
+                ),
+                SchedClass.RECOVERY: ClientProfile(
+                    reservation=self.conf.get("osd_mclock_recovery_res"),
+                    weight=self.conf.get("osd_mclock_recovery_wgt"),
+                    limit=self.conf.get("osd_mclock_recovery_lim"),
+                ),
+            }
+
+        self.sched = make_scheduler(
+            self.conf.get("osd_op_queue"), profiles=_mclock_profiles()
+        )
+
+        def _apply_mclock(_n=None, _v=None) -> None:
+            # update_profile, NOT a raw profiles.update(): the class's
+            # tag chain must restart — a reservation of 0 stores
+            # last.r = inf, and without the reset a later nonzero
+            # reservation would compute max(now, inf + 1/res) forever
+            if hasattr(self.sched, "update_profile"):
+                for klass, prof in _mclock_profiles().items():
+                    self.sched.update_profile(klass, prof)
+
+        self.conf.add_observer(
+            [
+                f"osd_mclock_{lane}_{knob}"
+                for lane in ("client", "recovery")
+                for knob in ("res", "wgt", "lim")
+            ],
+            _apply_mclock,
+        )
         self._sched_kick = asyncio.Event()
         b = PerfCountersBuilder(f"osd.{whoami}")
         for c in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
@@ -371,6 +428,8 @@ class OSD(Dispatcher):
             lambda _n, v: shard_dispatch.configure(devices=int(v)),
         )
         self.admin_socket = None
+        # periodic-scrub schedule: pgid -> last periodic scrub kickoff
+        self._last_periodic_scrub: dict = {}
         # heartbeat state: peer -> last reply rx time
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
@@ -916,7 +975,10 @@ class OSD(Dispatcher):
                 )
 
         self.sched.enqueue(
-            WorkItem(run=run, klass=SchedClass.CLIENT, cost=cost)
+            WorkItem(
+                run=run, klass=SchedClass.CLIENT, cost=cost,
+                priority=int(self.conf.get("osd_client_op_priority")),
+            )
         )
         self._sched_kick.set()
 
@@ -1134,6 +1196,7 @@ class OSD(Dispatcher):
                 continue
             for pg in list(self.pgs.values()):
                 pg.tick()
+            self._maybe_periodic_scrub()
             self._send_mgr_report()
             if self.conf.get("heartbeat_inject_failure") > 0:
                 continue  # pretend our pings are lost (global.yaml.in:865)
@@ -1150,6 +1213,31 @@ class OSD(Dispatcher):
                     ),
                 )
             self._heartbeat_check(now)
+
+    def _maybe_periodic_scrub(self) -> None:
+        """osd_scrub_interval: kick a shallow scrub on primaried PGs
+        whose last periodic scrub is older than the interval (the
+        reference's OSD::sched_scrub timer, scaled to the toy tick).
+        0 (the default) disables the timer — scrubs then only run on
+        operator command, the pre-ISSUE-12 behavior."""
+        interval = self.conf.get("osd_scrub_interval")
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        for pg in list(self.pgs.values()):
+            if not pg.peering.is_primary():
+                continue
+            # first-seen PGs get a random phase inside the interval so
+            # the whole cluster never scrubs (and re-scrubs, since each
+            # PG records the same kick time) in one tick — the
+            # reference jitters scrub scheduling for the same reason
+            last = self._last_periodic_scrub.setdefault(
+                pg.pgid, now - random.uniform(0.0, interval)
+            )
+            if now - last < interval:
+                continue
+            if pg.scrub(deep=False):
+                self._last_periodic_scrub[pg.pgid] = now
 
     def _heartbeat_check(self, now: float) -> None:
         """heartbeat_check (OSD.cc:5834): report peers past the grace."""

@@ -31,12 +31,14 @@ from __future__ import annotations
 
 import threading
 
+from ceph_tpu.common.lockdep import make_lock
+
 # Defaults mirror common/options.py (the option table is the source of
 # truth for daemons; library users get the same numbers without a Config).
 DEFAULT_MIN_BATCH = 32
 DEFAULT_DEVICES = 0  # 0 = all visible
 
-_lock = threading.Lock()
+_lock = make_lock("shard_dispatch_policy")
 _min_batch = DEFAULT_MIN_BATCH
 _devices = DEFAULT_DEVICES
 _mesh_cache: dict[int, object] = {}  # resolved width -> Mesh
@@ -72,7 +74,11 @@ def _visible_devices() -> int:
             import jax
 
             _visible = len(jax.devices())
-        except Exception:
+        except Exception as e:
+            from ceph_tpu.common.log import dout
+
+            dout("ec", 1, f"sharded dispatch: device query failed "
+                          f"(single-device coding this launch): {e!r}")
             return 1
     return _visible
 

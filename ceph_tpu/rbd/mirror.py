@@ -32,6 +32,7 @@ import json
 import struct
 
 from ..common.errs import EINVAL, ENOENT
+from ..common.log import dout
 from .rbd import RBD, Image, RbdError
 
 _REC = struct.Struct("<QBQI")  # seq, type, off, payload len
@@ -242,6 +243,7 @@ class MirrorDaemon:
         self.src_rbd = RBD(src_ioctx)
         self.dst_rbd = RBD(dst_ioctx)
         self._running = False
+        self.sync_errors = 0  # failed sync passes (visible, not silent)
 
     async def _position(self, image_id: str) -> int:
         try:
@@ -362,8 +364,11 @@ class MirrorDaemon:
         while self._running:
             try:
                 await self.sync_once()
-            except Exception:
-                pass  # source hiccup: retry next tick
+            except Exception as e:
+                # source hiccup: retry next tick — logged + counted so a
+                # permanently-failing daemon loop is not invisible
+                self.sync_errors += 1
+                dout("rbd", 1, f"rbd-mirror: sync pass failed: {e!r}")
             await asyncio.sleep(interval)
 
     def stop(self) -> None:

@@ -19,6 +19,7 @@ import hmac
 from urllib.parse import parse_qs, unquote, urlparse
 from xml.sax.saxutils import escape as _x
 
+from ..common.log import dout
 from .rgw import ObjectGateway, RgwError
 
 
@@ -60,6 +61,7 @@ class S3Server:
         self._server: asyncio.AbstractServer | None = None
         self._lc_task: asyncio.Task | None = None
         self.addr = ""
+        self.lc_errors = 0  # failed lifecycle passes (visible, not silent)
 
     async def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self._server = await asyncio.start_server(self._handle, host, port)
@@ -76,8 +78,12 @@ class S3Server:
             await asyncio.sleep(self.lc_interval)
             try:
                 await self.gw.process_lifecycle()
-            except Exception:
-                pass  # a pool hiccup must not kill the worker
+            except Exception as e:
+                # a pool hiccup must not kill the worker — but a
+                # lifecycle pass that silently fails every tick would
+                # never expire anything and never say so
+                self.lc_errors += 1
+                dout("rgw", 1, f"lifecycle pass failed: {e!r}")
 
     async def shutdown(self) -> None:
         if self._lc_task is not None:

@@ -113,6 +113,15 @@ async def _run(cfg: dict) -> dict:
         "lost_writes": -1,
         "events": [],
     }
+    # dynamic lock-order validation rides every chaos run (ISSUE 12):
+    # the concurrent aggregator/scheduler/pipeline/cache stack under
+    # faults is exactly where a latent ordering cycle would surface.
+    # Violations are counted process-wide, so baseline for the embedded
+    # tier-1 smoke (tests/test_lockdep.py raises some on purpose).
+    from ceph_tpu.common import lockdep
+
+    lockdep.enable()
+    lockdep_violations0 = lockdep.violations()
     fallback0 = ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"]
     # run-start baselines: the dispatch counters and flight recorder are
     # process-lifetime, and an embedded run (tests/test_chaos_smoke.py in
@@ -609,6 +618,18 @@ async def _run(cfg: dict) -> dict:
         await _wait_until(health_clear, 10.0,
                           "health to settle for the final snapshot")
         report["health_checks"] = mons[0].health_checks()[0]
+        # lock-order verdict (ISSUE 12 tracked keys): zero violations is
+        # part of convergence, and the observed ordering graph rides the
+        # JSON so a run's lock hierarchy is inspectable after the fact
+        report["lockdep_violations"] = (
+            lockdep.violations() - lockdep_violations0
+        )
+        report["lockdep_graph"] = lockdep.graph_dump()
+        assert report["lockdep_violations"] == 0, (
+            f"lock-order violations during the chaos run: "
+            f"{report['lockdep_violations']} (graph: "
+            f"{report['lockdep_graph']})"
+        )
     finally:
         inj.clear()
         device_guard().mark_healthy()

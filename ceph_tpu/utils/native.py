@@ -12,10 +12,12 @@ import pathlib
 import subprocess
 import threading
 
+from ceph_tpu.common.lockdep import make_lock
+
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libceph_tpu_native.so"
 
-_lock = threading.Lock()
+_lock = make_lock("native_bindings")
 _lib: ctypes.CDLL | None = None
 _load_failed = False
 

@@ -24,3 +24,16 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Dynamic lock-order validation ON for the whole tier-1 suite (ISSUE 12):
+# every make_lock/make_rlock/make_async_lock acquisition across the
+# aggregator/scheduler/pipeline/cache stack validates against the
+# observed ordering graph, so a latent deadlock introduced anywhere
+# fails the suite even if the losing interleaving never runs — the
+# reference's -DCEPH_DEBUG_MUTEX lockdep tier (PAPER.md layer 1).
+# Set CEPH_TPU_LOCKDEP=0 explicitly to debug with validation off.
+if os.environ.get("CEPH_TPU_LOCKDEP", "") != "0":
+    os.environ["CEPH_TPU_LOCKDEP"] = "1"
+    from ceph_tpu.common import lockdep  # noqa: E402
+
+    lockdep.enable()

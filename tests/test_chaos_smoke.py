@@ -57,6 +57,12 @@ class TestChaosSmoke:
         assert report["verify_launches"] >= 1, report
         assert report["verify_launches"] < report["scrub_objects"], report
         assert report["scrub_p99_ms"] >= 0.0, report
+        # ISSUE 12: the whole run executed under dynamic lockdep — zero
+        # lock-order violations across the concurrent aggregator/
+        # scheduler/pipeline/cache stack, and the observed ordering
+        # graph rides the report (non-empty: instrumented locks engaged)
+        assert report["lockdep_violations"] == 0, report
+        assert report["lockdep_graph"], report
         # health settled: no stuck SLOW_OPS, no lingering degraded check
         assert "SLOW_OPS" not in report["health_checks"], report
         assert "TPU_BACKEND_DEGRADED" not in report["health_checks"], report

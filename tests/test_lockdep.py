@@ -23,11 +23,19 @@ from ceph_tpu.common.lockdep import (
 
 @pytest.fixture(autouse=True)
 def _fresh_lockdep():
+    # Isolate: swap in a private registry instead of clear()ing the
+    # process-wide one.  Tier-1 runs the WHOLE suite with lockdep on
+    # (conftest.py CEPH_TPU_LOCKDEP=1), and this file's deterministic
+    # unit fixtures must neither erase the ordering edges the rest of
+    # the suite has accumulated nor switch validation off afterward.
+    was_enabled = lockdep.enabled()
+    saved = lockdep._REGISTRY
+    lockdep._REGISTRY = lockdep._Registry()
     lockdep.enable()
-    lockdep.clear()
     yield
-    lockdep.clear()
-    lockdep.disable()
+    lockdep._REGISTRY = saved
+    if not was_enabled:
+        lockdep.disable()
 
 
 class TestThreadLockdep:
