@@ -603,6 +603,16 @@ def run_child(platform: str, mc_only: bool = False) -> None:
             for _ in range(4)
         ]
 
+        # HBM ledger (ISSUE 13): the staged inputs and in-flight parity
+        # are tracked in the `scratch` pool, and the per-depth PEAK is
+        # folded into the JSON — bench rounds correlate throughput
+        # against the memory headroom each depth costs, the number that
+        # decides how far ec_tpu_pipeline_depth can be pushed
+        from ceph_tpu.common.mempool import ledger as hbm_ledger
+        from ceph_tpu.common.mempool import track_buffer
+
+        hbm = hbm_ledger()
+
         def run_pipeline(depth: int, n: int) -> float:
             inflight = []
             # warm: one launch per slot buffer (compile already paid)
@@ -612,8 +622,8 @@ def run_child(platform: str, mc_only: bool = False) -> None:
             for i in range(n):
                 h = hosts[i % depth]
                 h[0, 0, :8] ^= np.uint8(i + 1)  # per-slot serial chain
-                par = encode_fn(jax.device_put(h))
-                inflight.append(par)
+                par = encode_fn(track_buffer(jax.device_put(h), "scratch"))
+                inflight.append(track_buffer(par, "scratch"))
                 if len(inflight) >= depth:
                     inflight.pop(0).block_until_ready()
             while inflight:
@@ -625,10 +635,16 @@ def run_child(platform: str, mc_only: bool = False) -> None:
         run_pipeline(1, 2)  # warm the eager-dispatch path end to end
         watchdog.disarm()
         depths = {}
+        hbm_peaks = {}
         for depth in (1, 2, 4):
             watchdog.stage(f"pipeline_depth_{depth}", PROBE_TIMEOUT_S)
+            hbm.reset_peaks()
             depths[depth] = run_pipeline(depth, p_iters)
-            clog(f"pipeline depth={depth}: {depths[depth]:.3f} GB/s")
+            hbm_peaks[str(depth)] = hbm.peak_total_bytes()
+            clog(
+                f"pipeline depth={depth}: {depths[depth]:.3f} GB/s "
+                f"(hbm peak {hbm_peaks[str(depth)]} B)"
+            )
             watchdog.disarm()
         best_depth = max(depths, key=depths.get)
         overlap = max(0.0, 1.0 - depths[1] / depths[best_depth])
@@ -638,6 +654,7 @@ def run_child(platform: str, mc_only: bool = False) -> None:
             "gbps": depths[best_depth],
             "overlap_fraction": round(overlap, 4),
             "batch": batch,
+            "hbm_peak_bytes": hbm_peaks,
         }
         clog(
             f"pipeline best: depth={best_depth} "
@@ -1073,6 +1090,10 @@ def main() -> None:
             "overlap_fraction": p["overlap_fraction"],
             "vs_serial": round(p["gbps"] / gbps, 4) if gbps else 0,
         }
+        if "hbm_peak_bytes" in p:
+            # per-depth HBM high-water mark (ISSUE 13): throughput vs
+            # memory headroom in one place, per bench round
+            out["pipelined"]["hbm_peak_bytes"] = p["hbm_peak_bytes"]
         if "device_cache" in p:
             out["pipelined"]["device_cache"] = p["device_cache"]
     elif "pipeline_error" in result:

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from .donation import DonationLifetimePass
 from .exceptions import ExceptionSwallowPass
+from .ledger import LedgerDisciplinePass
 from .locks import LockDisciplinePass
 from .options_coherence import OptionsCoherencePass
 from .purity import JitPurityPass
@@ -17,6 +18,7 @@ ALL_PASSES = [
     ExceptionSwallowPass(),
     LockDisciplinePass(),
     OptionsCoherencePass(),
+    LedgerDisciplinePass(),
 ]
 
 PASS_BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

@@ -1,0 +1,137 @@
+"""ledger-discipline: device-buffer residency outside the HBM mempool
+ledger is invisible residency.
+
+ISSUE 13 built ``common/mempool.py`` so every byte resident on the
+device is attributable to a named pool.  That property only holds if
+new code keeps the discipline: a ``jax.device_put`` in the data-path
+packages (``ops/``, ``codec/``, ``parallel/``) commits host bytes to
+HBM, and unless the result is threaded through a mempool-tracked
+helper — ``track_buffer(...)`` wrapping the call, or an explicit
+``ledger().alloc(...)`` handle in the same function — the bytes exist
+but no ledger pool knows, ``dump_mempools`` under-reports, and the
+pressure layer trims against a lie.
+
+The pass flags every ``device_put`` call in those packages that is
+neither (a) an argument of a ``track_buffer``/``tracked_device_put``
+call nor (b) inside a function that also takes an explicit ``.alloc``
+handle.  Intentional untracked sites get an allowlist entry with a
+reason (``analysis/allowlists/ledger-discipline.allow``), like every
+other pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding, SourceTree
+
+# packages whose device_put calls must be ledger-tracked: the EC data
+# path's HBM holders.  Matched as path components so the fixture trees
+# in tests (pkg/ops/x.py) scope the same way the live tree does.
+_SCOPED_DIRS = {"ops", "codec", "parallel"}
+
+_TRACKED_WRAPPERS = {"track_buffer", "tracked_device_put", "_hbm_track"}
+
+# names a ledger factory goes by at call sites: `<factory>().alloc(...)`
+# is the explicit-handle spelling the pass accepts.  A bare `.alloc` on
+# an arbitrary receiver (slots.alloc(), arena.alloc(n)) must NOT count
+# — it would silence the only gate enforcing the ledger invariant.
+_LEDGER_FACTORIES = {"ledger", "_hbm_ledger", "hbm_ledger", "_hbm"}
+
+
+def _callable_name(fn: ast.AST) -> str:
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _in_scope(rel: str) -> bool:
+    parts = rel.split("/")
+    return any(p in _SCOPED_DIRS for p in parts[:-1])
+
+
+class LedgerDisciplinePass:
+    PASS_ID = "ledger-discipline"
+    DESCRIBE = (
+        "jax.device_put / device-buffer retention in ops//codec//"
+        "parallel/ outside a mempool-tracked helper (track_buffer or an "
+        "explicit ledger alloc handle)"
+    )
+
+    def __call__(self, tree: SourceTree) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in tree.files:
+            if not _in_scope(sf.rel):
+                continue
+            wrapped = self._wrapped_calls(sf)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _callable_name(node.func) != "device_put":
+                    continue
+                if id(node) in wrapped:
+                    continue
+                if self._function_allocs(sf, node):
+                    continue
+                findings.append(Finding(
+                    pass_id=self.PASS_ID,
+                    file=sf.rel,
+                    line=node.lineno,
+                    key=f"{sf.rel}::{sf.scope_of(node)}::device_put",
+                    message=(
+                        "device_put commits bytes to HBM outside the "
+                        "mempool ledger — wrap it in track_buffer(...) "
+                        "or account it with ledger().alloc(...) so "
+                        "dump_mempools and the pressure layer see the "
+                        "residency"
+                    ),
+                ))
+        return findings
+
+    @staticmethod
+    def _wrapped_calls(sf) -> set[int]:
+        """ids of device_put Call nodes that appear inside an argument
+        of a track_buffer/tracked_device_put call."""
+        out: set[int] = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callable_name(node.func) not in _TRACKED_WRAPPERS:
+                continue
+            # positional AND keyword arguments: track_buffer(buf=...)
+            # is tracked too
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Call) and \
+                            _callable_name(sub.func) == "device_put":
+                        out.add(id(sub))
+        return out
+
+    @staticmethod
+    def _function_allocs(sf, call: ast.Call) -> bool:
+        """True when the enclosing (non-lambda) function also takes an
+        explicit LEDGER handle — an ``.alloc(...)`` whose receiver is a
+        ledger factory call (``ledger().alloc(...)`` /
+        ``_hbm_ledger().alloc(...)``, the device_cache.put shape: the
+        device_put result is accounted a few lines later under the
+        cache lock).  An ``.alloc`` on any other receiver
+        (slots.alloc(), arena.alloc(n)) does NOT count, and a
+        track_buffer call elsewhere in the function does NOT excuse a
+        bare device_put next to it — per-call wrapping is checked by
+        _wrapped_calls, so counting it here would let one tracked
+        placement silence every untracked sibling."""
+        func = sf.enclosing_function(call)
+        if func is None:
+            return False
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "alloc":
+                    recv = node.func.value
+                    if isinstance(recv, ast.Call) and \
+                            _callable_name(recv.func) in _LEDGER_FACTORIES:
+                        return True
+        return False

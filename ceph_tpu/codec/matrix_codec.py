@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Mapping
 
@@ -29,6 +30,8 @@ except (ImportError, AttributeError):
 
 from ceph_tpu.common.lockdep import make_lock as _lockdep_make_lock
 from ceph_tpu.common.lockdep import make_rlock as _lockdep_make_rlock
+from ceph_tpu.common.mempool import ledger as _hbm_ledger
+from ceph_tpu.common.mempool import track_buffer as _hbm_track
 from ceph_tpu.gf import expand_matrix, isa_decode_matrix
 from ceph_tpu.ops.dispatch import record_launch
 from ceph_tpu.ops.packed_gf import (
@@ -220,6 +223,7 @@ class _GlobalPlanCache:
         bm = jnp.asarray(expand_matrix(coding_rows), dtype=jnp.uint8)
         if _trace_local(bm):
             return bm
+        _hbm_track(bm, "scratch")
         with self._lock:
             self._encode.setdefault(key, bm)
             return self._encode[key]
@@ -290,6 +294,7 @@ class _GlobalPlanCache:
         bm = jnp.asarray(expand_matrix(matrix), dtype=jnp.uint8)
         if _trace_local(bm):
             return bm
+        _hbm_track(bm, "scratch")
         with self._lock:
             self._decode[key] = (bm, [])
             self._decode.move_to_end(key)
@@ -362,6 +367,7 @@ class _GlobalPlanCache:
         bitmat = jnp.asarray(expand_matrix(c), dtype=jnp.uint8)
         if _trace_local(bitmat):
             return bitmat, decode_index, c
+        _hbm_track(bitmat, "scratch")
         with self._lock:
             self._decode[key] = (bitmat, decode_index, c)
             self._decode.move_to_end(key)
@@ -504,12 +510,17 @@ class DonationPool:
     # (each pooled RS(8,3) output of a large launch is tens of MiB).
     SLOT_CAP = 4
 
-    __slots__ = ("_free", "_live", "cap")
+    __slots__ = ("_free", "_live", "cap", "_mem")
 
     def __init__(self, cap: int | None = None) -> None:
         self._free: dict[tuple, list] = {}
         self._live: dict[int, int] = {}  # id(buf) -> refcount
         self.cap = self.SLOT_CAP if cap is None else max(1, int(cap))
+        # HBM ledger handles per pooled FREE buffer (ISSUE 13): pooled
+        # dead buffers are resident device memory nothing else accounts
+        # for.  Handles are buffer-finalized too, so a pool dropped with
+        # buffers still slotted cannot leak ledger bytes.
+        self._mem: dict[int, object] = {}
 
     def hold(self, buf) -> None:
         self._live[id(buf)] = self._live.get(id(buf), 0) + 1
@@ -522,6 +533,15 @@ class DonationPool:
         else:
             self._live[key] = refs
 
+    def _mem_release(self, buf) -> int:
+        """Close a pooled buffer's ledger handle; returns its bytes."""
+        h = self._mem.pop(id(buf), None)
+        if h is None:
+            return 0
+        nbytes = h.nbytes
+        h.free()
+        return nbytes
+
     def take(self, shape):
         from ceph_tpu.ops.dispatch import PIPELINE
 
@@ -529,6 +549,7 @@ class DonationPool:
         if not slot:
             return None
         buf = slot.pop()
+        self._mem_release(buf)  # leaving the free list either way
         if id(buf) in self._live:
             PIPELINE.record_donation(reused=False, live=True)
             return None  # never hand out a live buffer
@@ -544,12 +565,31 @@ class DonationPool:
             # needs — refuse and count the invariant violation
             PIPELINE.record_donation(reused=False, live=True)
             return
+        led = _hbm_ledger()
+        if led.donation_capped:
+            # HBM pressure stage 2: retention capped — dead buffers go
+            # back to the allocator instead of pinning device memory
+            return
         slot = self._free.setdefault(tuple(shape), [])
         slot.append(buf)
+        self._mem[id(buf)] = led.alloc(
+            "ec_donation", int(getattr(buf, "nbytes", 0) or 0), buf=buf
+        )
         while len(slot) > self.cap:
             # oldest out — also trims promptly after a runtime cap
             # shrink (a pipeline-depth config drop)
-            slot.pop(0)
+            self._mem_release(slot.pop(0))
+
+    def drop_free(self) -> int:
+        """Drop every FREE pooled buffer (HBM pressure stage 2);
+        returns the bytes released.  Live refcounts are untouched —
+        in-flight launches still settle normally."""
+        freed = 0
+        for slot in self._free.values():
+            for buf in slot:
+                freed += self._mem_release(buf)
+        self._free.clear()
+        return freed
 
     # mapping-ish view (tests and introspection): the shapes with at
     # least one FREE buffer pooled
@@ -568,6 +608,7 @@ class _AggGroup:
         "key", "ec", "ctx", "arrays", "tickets", "stripes", "nbytes",
         "parity", "host", "pad", "error", "donatable", "lock",
         "input", "credit", "flight", "submit_ts", "stalled", "held",
+        "mem",
     )
 
     def __init__(self, key, ec, ctx=None):
@@ -586,6 +627,10 @@ class _AggGroup:
         # the in-flight launch's device output, refcounted in the
         # donation pool from dispatch until settle (pipeline depth > 1)
         self.held = None
+        # HBM ledger handle for that in-flight output (ISSUE 13):
+        # alloc'd at dispatch, freed at settle on every outcome —
+        # host-fallback and sticky-error settles included
+        self.mem = None
         # concatenated padded launch input, retained from launch until
         # settle so a device that wedges AFTER dispatch can still be
         # recomputed on the host oracle
@@ -635,6 +680,10 @@ class LaunchAggregator:
     # launch scheduler): client encodes preempt queued background work;
     # the decode/verify subclasses override with their own lane.
     SCHED_CLASS = "client"
+    # HBM ledger pool this aggregator's in-flight launch outputs charge
+    # (ISSUE 13); the verify subclass charges its own pool so the leak
+    # gate can drain-check the EC data path and scrub independently.
+    MEM_POOL = "ec_pipeline_inflight"
 
     def __init__(self, window: int = 0, max_bytes: int = 64 << 20,
                  pad_pow2: bool = True, inflight_max_bytes: int | None = None,
@@ -697,6 +746,9 @@ class LaunchAggregator:
                         "input bytes per device launch",
                         lowest=4096, buckets=18)
         self.perf = b.create_perf_counters()
+        # live-aggregator registry (ISSUE 13): HBM pressure's stage-2
+        # trim and the leak-gate drain reach every instance through it
+        _AGGREGATORS.add(self)
 
     def configure(self, window: int | None = None, max_bytes: int | None = None,
                   inflight_max_bytes: int | None = None,
@@ -743,6 +795,11 @@ class LaunchAggregator:
         trips.  Admission is throttled: past ec_tpu_inflight_max_bytes of
         unsettled work, this call settles older launches first."""
         stripes = shaped.shape[0]
+        # HBM pressure hook (ISSUE 13): time-throttled, no locks held —
+        # under a target, sustained submission pressure trims the cache
+        # / caps donation retention / clamps depth without waiting for
+        # the next status beacon
+        _hbm_ledger().maybe_check_pressure()
         stalled = self._admit(shaped.nbytes)
         reason = None
         with self._lock:
@@ -788,6 +845,10 @@ class LaunchAggregator:
         depth = self.pipeline_depth
         if depth <= 0:
             return
+        if _hbm_ledger().depth_clamped:
+            # HBM pressure stage 3: one launch's output in flight at a
+            # time — overlap traded for bounded residency until relief
+            depth = 1
         from ceph_tpu.ops.dispatch import PIPELINE
 
         while True:
@@ -848,6 +909,20 @@ class LaunchAggregator:
         """Submissions queued but not yet launched."""
         with self._lock:
             return sum(len(g.tickets) for g in self._groups.values())
+
+    def drain(self) -> None:
+        """Settle EVERYTHING: flush the windowed groups, then settle
+        every launched group oldest-first.  The HBM leak gate's
+        teardown hook — after a drain the in-flight ledger pool must
+        read zero (sticky errors settle too; they just stay sticky for
+        their tickets' reaps)."""
+        self.flush()
+        while True:
+            with self._lock:
+                g = self._live[0] if self._live else None
+            if g is None:
+                return
+            self._settle(g)
 
     def flush(self) -> None:
         """Launch every windowed group, FIFO (the commit barrier)."""
@@ -1013,6 +1088,17 @@ class LaunchAggregator:
             g.arrays = []
             g.pad = pad
             g.parity = parity
+            # HBM ledger (ISSUE 13): the in-flight device output is
+            # resident from this dispatch until settle.  The handle is
+            # buffer-finalized too, so even an abandoned group cannot
+            # leak ledger bytes past the output's death.
+            if not isinstance(parity, np.ndarray):
+                out_nbytes = int(getattr(parity, "nbytes", 0) or 0)
+                if out_nbytes:
+                    g.mem = _hbm_ledger().alloc(
+                        self.MEM_POOL, out_nbytes, buf=parity
+                    )
+            rec["hbm_bytes"] = _hbm_ledger().total_device_bytes()
             # donation-pool refcount (ISSUE 11): the device output is
             # LIVE until this launch settles — at pipeline depth > 1 a
             # same-shape co-launch settling first must not recycle it
@@ -1229,13 +1315,27 @@ class LaunchAggregator:
                     else:
                         g.host = host[: g.stripes] if g.pad else host
                         if g.donatable and device_side:
+                            # release the in-flight ledger hold BEFORE
+                            # the donation pool re-accounts the same
+                            # buffer under ec_donation — the two charges
+                            # overlapping would double-count the bytes
+                            # and permanently inflate the peak gauges
+                            if g.mem is not None:
+                                g.mem.free()
+                                g.mem = None
                             with self._lock:
                                 self._donate_pool.put(
                                     tuple(parity.shape), parity
                                 )
                     g.parity = None
             # settled (host bytes or sticky error): release the
-            # backpressure credit and the retained launch input
+            # backpressure credit, the retained launch input, and the
+            # HBM ledger hold — the release is unconditional, so the
+            # host-fallback and sticky-error paths (the historical leak
+            # shape) cannot keep the in-flight pool charged
+            if g.mem is not None:
+                g.mem.free()
+                g.mem = None
             if g.credit:
                 self.inflight.put(g.credit)
                 g.credit = 0
@@ -1364,6 +1464,7 @@ class VerifyAggregator(LaunchAggregator):
     PERF_NAME = "ec_verify_aggregator"
     WHAT = "verify"
     SCHED_CLASS = "background"
+    MEM_POOL = "verify"
 
     def submit(self, ec: "MatrixCodecMixin", codewords: np.ndarray) -> AggTicket:
         """Queue one (stripes, k+m, L) uint8 codeword batch; the ticket
@@ -1385,6 +1486,29 @@ class VerifyAggregator(LaunchAggregator):
 
     def _donate_ok(self, g: _AggGroup, data_shape) -> bool:
         return False  # the bitmap output is tiny; pooling buys nothing
+
+
+# every live aggregator, weakly held (ISSUE 13): the HBM pressure
+# layer's stage-2 trim and the tier-1 leak gate's teardown drain reach
+# all instances — the process-wide defaults AND test-local ones
+_AGGREGATORS: "weakref.WeakSet[LaunchAggregator]" = weakref.WeakSet()
+
+
+def drop_donation_retention() -> int:
+    """Drop every live aggregator's FREE pooled buffers (HBM pressure
+    stage 2); returns the bytes released."""
+    freed = 0
+    for agg in list(_AGGREGATORS):
+        with agg._lock:
+            freed += agg._donate_pool.drop_free()
+    return freed
+
+
+def drain_all_aggregators() -> None:
+    """Flush + settle every live aggregator (the tier-1 leak gate and
+    the chaos harness's end-of-run drain)."""
+    for agg in list(_AGGREGATORS):
+        agg.drain()
 
 
 _DEFAULT_AGGREGATOR: EncodeAggregator | None = None

@@ -58,6 +58,44 @@ def tpu_degraded_detail(degraded: dict[str, dict]) -> list[str]:
     ]
 
 
+def hbm_pressure_summary(pressured: dict[str, dict]) -> str | None:
+    """The TPU_HBM_PRESSURE check summary for a per-daemon pressure
+    slice ({daemon: {ratio, target_bytes, total_bytes, stage_name,
+    pools}}), or None when no daemon is under HBM pressure.  Shared by
+    the mon health check and the mgr's healthcheck gauge so the two
+    surfaces agree."""
+    if not pressured:
+        return None
+    worst = max(v.get("ratio", 0.0) for v in pressured.values())
+    return (
+        f"{len(pressured)} daemon(s) under device HBM memory pressure "
+        f"(worst at {worst:.2f}x of target): "
+        f"[{','.join(sorted(pressured))}]"
+    )
+
+
+def hbm_pressure_detail(pressured: dict[str, dict]) -> list[str]:
+    """Per-daemon breakdown lines (`health detail`): residency vs
+    target, the trim stage reached, and the top pools holding bytes."""
+    lines = []
+    for d, v in sorted(pressured.items()):
+        pools = v.get("pools") or {}
+        top = ", ".join(
+            f"{name}={nbytes}"
+            for name, nbytes in sorted(
+                pools.items(), key=lambda kv: -kv[1]
+            )[:3]
+        )
+        lines.append(
+            f"{d}: {v.get('total_bytes', 0)} bytes resident vs "
+            f"{v.get('target_bytes', 0)} target "
+            f"(ratio {v.get('ratio', 0.0):.2f}, "
+            f"stage {v.get('stage_name', 'none')})"
+            + (f"; top pools: {top}" if top else "")
+        )
+    return lines
+
+
 def recovery_stalled_summary(stalled: dict[str, dict]) -> str | None:
     """The PG_RECOVERY_STALLED check summary for a stalled-event slice
     ({"<pgid>:<kind>": {pgid, kind, stalled_for_sec, objects_done,

@@ -305,6 +305,37 @@ OPTIONS: dict[str, Option] = _opts(
         runtime=True,
     ),
     Option(
+        "ec_tpu_mempool_debug",
+        bool,
+        False,
+        A,
+        "shard HBM mempool ledger counts by allocation call-site "
+        "(common/mempool.py, ISSUE 13), like the reference's mempool "
+        "debug mode: asok dump_mempools then breaks each pool down by "
+        "the file:line that allocated the bytes.  Costs one stack walk "
+        "per tracked allocation; off by default",
+        see_also=("ec_tpu_hbm_target_bytes",),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_hbm_target_bytes",
+        int,
+        0,
+        A,
+        "HBM residency target for the mempool pressure layer (ISSUE 13; "
+        "the osd_memory_target analog for device memory).  When total "
+        "ledger-tracked bytes exceed 85% of the target the staged "
+        "response engages — trim the device-resident chunk cache, then "
+        "cap donation-pool retention, then clamp the effective pipeline "
+        "depth to 1 — and TPU_HBM_PRESSURE raises through the OSD "
+        "status -> mgr digest -> mon health pipeline, clearing (and "
+        "releasing the caps) once residency falls back under 70%.  "
+        "0 disables pressure evaluation entirely",
+        see_also=("ec_tpu_mempool_debug", "ec_tpu_device_cache_bytes",
+                  "ec_tpu_pipeline_depth"),
+        runtime=True,
+    ),
+    Option(
         "ec_tpu_flight_records",
         int,
         512,

@@ -216,6 +216,10 @@ class Mgr(Dispatcher):
             # the host fallback); the mon-side TPU_BACKEND_DEGRADED
             # check reads this slice
             "tpu_degraded": self.tpu_degraded_by_daemon(),
+            # daemons over their HBM residency target (the mempool
+            # ledger's pressure verdict, ISSUE 13); the mon-side
+            # TPU_HBM_PRESSURE check reads this slice
+            "hbm_pressure": self.hbm_pressure_by_daemon(),
             # per-PG scrub inconsistencies from the primaries' status
             # blobs; the mon-side OSD_SCRUB_ERRORS / PG_DAMAGED
             # HEALTH_ERR checks read this slice
@@ -265,6 +269,28 @@ class Mgr(Dispatcher):
                 "degraded_for_sec": float(backend.get("degraded_for_sec", 0.0)),
                 "reason": str(backend.get("reason", "")),
                 "fallback_launches": int(backend.get("fallback_launches", 0)),
+            }
+        return out
+
+    def hbm_pressure_by_daemon(self) -> dict[str, dict]:
+        """Daemons reporting HBM mempool pressure (the OSD status'
+        hbm_pressure blob, common/mempool.py verdict).  A down daemon's
+        stale report drops like the degraded slice — its process, and
+        with it the resident device memory, is gone."""
+        out: dict[str, dict] = {}
+        for daemon, st in self.daemons.items():
+            pressure = (st.status or {}).get("hbm_pressure") or {}
+            if not pressure.get("pressure"):
+                continue
+            if not self._daemon_report_live(daemon):
+                continue
+            out[daemon] = {
+                "ratio": float(pressure.get("ratio", 0.0)),
+                "target_bytes": int(pressure.get("target_bytes", 0)),
+                "total_bytes": int(pressure.get("total_bytes", 0)),
+                "stage": int(pressure.get("stage", 0)),
+                "stage_name": str(pressure.get("stage_name", "")),
+                "pools": dict(pressure.get("pools") or {}),
             }
         return out
 
@@ -337,6 +363,12 @@ class Mgr(Dispatcher):
             checks["TPU_BACKEND_DEGRADED"] = {
                 "severity": "HEALTH_WARN",
                 "summary": degraded,
+            }
+        pressure = health.hbm_pressure_summary(self.hbm_pressure_by_daemon())
+        if pressure:
+            checks["TPU_HBM_PRESSURE"] = {
+                "severity": "HEALTH_WARN",
+                "summary": pressure,
             }
         scrub = self.scrub_errors_by_pg()
         summary = health.osd_scrub_errors_summary(scrub)

@@ -108,6 +108,57 @@ class PrometheusModule(HttpServedModule, MgrModule):
                 rows.append(
                     f'ceph_tpu_{metric}{{pool="{pool}"}} {st[field_]}'
                 )
+        # HBM mempool ledger families (ISSUE 13): per-daemon, per-pool
+        # residency gauges from the OSD status blobs' hbm_mempools
+        # slice, plus the pressure verdict.  Labeled families (pool as
+        # a label) rather than one family per pool, so PromQL can
+        # sum/topk across pools — the promised ceph_tpu_mempool_* /
+        # pressure-ratio scrape surface.
+        mem_bytes = family(
+            "ceph_tpu_mempool_bytes", "gauge",
+            "HBM mempool ledger: bytes resident per pool",
+        )
+        mem_buffers = family(
+            "ceph_tpu_mempool_buffers", "gauge",
+            "HBM mempool ledger: buffers resident per pool",
+        )
+        mem_peak = family(
+            "ceph_tpu_mempool_peak_bytes", "gauge",
+            "HBM mempool ledger: peak bytes per pool since reset",
+        )
+        hbm_ratio = family(
+            "ceph_tpu_hbm_pressure_ratio", "gauge",
+            "HBM residency over target (0 when no target set)",
+        )
+        hbm_target = family(
+            "ceph_tpu_hbm_target_bytes", "gauge",
+            "configured ec_tpu_hbm_target_bytes (0 = pressure off)",
+        )
+        for daemon in mgr.list_daemons():
+            status = mgr.get_daemon_status(daemon)
+            for pool, st in sorted((status.get("hbm_mempools") or {}).items()):
+                labels = f'daemon="{daemon}",pool="{pool}"'
+                mem_bytes.append(
+                    f'ceph_tpu_mempool_bytes{{{labels}}} {st.get("bytes", 0)}'
+                )
+                mem_buffers.append(
+                    f'ceph_tpu_mempool_buffers{{{labels}}} '
+                    f'{st.get("buffers", 0)}'
+                )
+                mem_peak.append(
+                    f'ceph_tpu_mempool_peak_bytes{{{labels}}} '
+                    f'{st.get("peak_bytes", 0)}'
+                )
+            pressure = status.get("hbm_pressure") or {}
+            if pressure:
+                hbm_ratio.append(
+                    f'ceph_tpu_hbm_pressure_ratio{{daemon="{daemon}"}} '
+                    f'{pressure.get("ratio", 0.0)}'
+                )
+                hbm_target.append(
+                    f'ceph_tpu_hbm_target_bytes{{daemon="{daemon}"}} '
+                    f'{pressure.get("target_bytes", 0)}'
+                )
         # module-exported families (the reference's MgrModule
         # add_metric analog): any registered module exposing
         # `prometheus_metrics() -> [(family, type, help, samples)]`

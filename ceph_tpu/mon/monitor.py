@@ -486,6 +486,18 @@ class Monitor(Dispatcher):
             details["TPU_BACKEND_DEGRADED"] = health.tpu_degraded_detail(
                 degraded
             )
+        # daemons over their HBM residency target (the mempool ledger's
+        # pressure verdict via the mgr digest, ISSUE 13).  Clears when
+        # the staged trims — cache, donation retention, pipeline depth
+        # — bring residency back under the relief threshold, or the
+        # holder frees its buffers.
+        pressured = self.pg_digest.get("hbm_pressure") or {}
+        summary = health.hbm_pressure_summary(pressured)
+        if summary:
+            checks["TPU_HBM_PRESSURE"] = summary
+            details["TPU_HBM_PRESSURE"] = health.hbm_pressure_detail(
+                pressured
+            )
         # recovery/backfill events that stopped advancing (mgr progress
         # module digest slice, ISSUE 8); clears when progress resumes or
         # the event completes

@@ -44,16 +44,21 @@ from collections import OrderedDict
 import numpy as np
 
 from ceph_tpu.common.lockdep import make_lock
+from ceph_tpu.common.mempool import ledger as _hbm_ledger
 
 
 class _Entry:
-    __slots__ = ("buf", "nbytes", "generation", "off")
+    __slots__ = ("buf", "nbytes", "generation", "off", "mem")
 
-    def __init__(self, buf, nbytes: int, generation, off: int):
+    def __init__(self, buf, nbytes: int, generation, off: int, mem=None):
         self.buf = buf
         self.nbytes = int(nbytes)
         self.generation = generation
         self.off = int(off)
+        # HBM ledger handle (ISSUE 13): one per resident entry,
+        # buffer-finalized so a dropped cache instance cannot leak
+        # ledger bytes past its buffers' death
+        self.mem = mem
 
 
 class DeviceChunkCache:
@@ -85,11 +90,20 @@ class DeviceChunkCache:
 
     def configure(self, max_bytes: int | None = None) -> None:
         """Apply live config (`ec_tpu_device_cache_bytes`); shrinking
-        evicts LRU-first, 0 disables and drops everything."""
+        evicts LRU-first, 0 disables and drops everything.
+
+        `resident_bytes` is RECOMPUTED from the entry index before the
+        eviction loop, not trusted from the decremented counter: the
+        cap-shrink observer is exactly where accumulated counter drift
+        would evict too little (a stale-high counter over-evicts, which
+        merely wastes cache; a stale-LOW counter leaves the cache over
+        the new cap forever) — and the HBM ledger reconciliation exists
+        to expose precisely that drift class."""
         if max_bytes is None:
             return
         with self._lock:
             self.max_bytes = int(max_bytes)
+            self._bytes = sum(e.nbytes for e in self._entries.values())
             self._evict_to_fit_locked(0)
 
     @property
@@ -140,23 +154,48 @@ class DeviceChunkCache:
             if old is not None:
                 self._bytes -= old.nbytes
                 self._by_obj[obj].discard(key)
+                if old.mem is not None:
+                    old.mem.free()
             self._evict_to_fit_locked(nbytes)
-            self._entries[key] = _Entry(buf, nbytes, generation, off)
+            self._entries[key] = _Entry(
+                buf, nbytes, generation, off,
+                mem=_hbm_ledger().alloc("device_cache", nbytes, buf=buf),
+            )
             self._by_obj.setdefault(obj, set()).add(key)
             self._bytes += nbytes
             self.insertions += 1
         return True
 
+    def _evict_lru_one_locked(self) -> int:
+        """Evict the single LRU entry (counter + ledger + index
+        bookkeeping in ONE place); returns its bytes."""
+        key, entry = self._entries.popitem(last=False)
+        self._bytes -= entry.nbytes
+        if entry.mem is not None:
+            entry.mem.free()
+        keys = self._by_obj.get(key[0])
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._by_obj[key[0]]
+        self.evictions += 1
+        return entry.nbytes
+
     def _evict_to_fit_locked(self, incoming: int) -> None:
         while self._entries and self._bytes + incoming > self.max_bytes:
-            key, entry = self._entries.popitem(last=False)
-            self._bytes -= entry.nbytes
-            keys = self._by_obj.get(key[0])
-            if keys is not None:
-                keys.discard(key)
-                if not keys:
-                    del self._by_obj[key[0]]
-            self.evictions += 1
+            self._evict_lru_one_locked()
+
+    def trim_for_pressure(self, nbytes: int) -> int:
+        """Evict LRU-first until at least `nbytes` were released (or
+        the cache is empty); returns the bytes freed.  The HBM pressure
+        layer's stage-1 action (common/mempool.py): cached chunks are
+        rebuildable pure optimization — the cheapest resident bytes to
+        give back."""
+        freed = 0
+        with self._lock:
+            while self._entries and freed < nbytes:
+                freed += self._evict_lru_one_locked()
+        return freed
 
     # -- consumer side -------------------------------------------------------
 
@@ -267,7 +306,10 @@ class DeviceChunkCache:
             if not doomed:
                 return 0
             for key in doomed:
-                self._bytes -= self._entries.pop(key).nbytes
+                entry = self._entries.pop(key)
+                self._bytes -= entry.nbytes
+                if entry.mem is not None:
+                    entry.mem.free()
             self.invalidations += len(doomed)
             return len(doomed)
 
@@ -276,6 +318,9 @@ class DeviceChunkCache:
         wedged runtime are unreachable, and the host path needs none."""
         with self._lock:
             self.invalidations += len(self._entries)
+            for entry in self._entries.values():
+                if entry.mem is not None:
+                    entry.mem.free()
             self._entries.clear()
             self._by_obj.clear()
             self._bytes = 0

@@ -88,6 +88,12 @@ def new_record(
     recovery / background, ISSUE 9) — empty for dispatches that never
     passed through the scheduler (raw bench/bulk paths)."""
     now = time.monotonic()
+    try:
+        from ceph_tpu.common.mempool import ledger as _hbm_ledger
+
+        hbm_bytes = _hbm_ledger().total_device_bytes()
+    except ImportError:  # early-boot partial import: no ledger yet
+        hbm_bytes = 0
     return {
         "seq": 0,  # assigned at commit
         "kind": kind,
@@ -110,6 +116,11 @@ def new_record(
         # how many launches were in flight (dispatched, unsettled) the
         # moment this one dispatched — the pipeline-depth witness
         "inflight_depth": 0,
+        # ledger-tracked HBM bytes resident when this launch dispatched
+        # (ISSUE 13): the memory level rides the same timeline as the
+        # launches, rendered as a Perfetto counter track by
+        # tools/trace_export.py
+        "hbm_bytes": hbm_bytes,
         "queue_wait_s": 0.0,
         "h2d_s": 0.0,
         "kernel_s": 0.0,

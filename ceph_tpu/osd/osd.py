@@ -384,6 +384,23 @@ class OSD(Dispatcher):
             ["ec_tpu_device_cache_bytes"],
             lambda _n, v: device_chunk_cache().configure(max_bytes=int(v)),
         )
+        # HBM mempool ledger (ISSUE 13): call-site debug sharding and
+        # the residency target the pressure layer trims against, both
+        # runtime-mutable through the same observer plumbing
+        from ..common.mempool import ledger as hbm_ledger
+
+        hbm_ledger().configure(
+            debug=self.conf.get("ec_tpu_mempool_debug"),
+            target_bytes=self.conf.get("ec_tpu_hbm_target_bytes"),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_mempool_debug"],
+            lambda _n, v: hbm_ledger().configure(debug=bool(v)),
+        )
+        self.conf.add_observer(
+            ["ec_tpu_hbm_target_bytes"],
+            lambda _n, v: hbm_ledger().configure(target_bytes=int(v)),
+        )
         # flight recorder ring capacity (ISSUE 8): runtime-mutable like
         # the aggregation knobs; resizing keeps the newest records
         from ..ops.flight_recorder import flight_recorder
@@ -645,6 +662,26 @@ class OSD(Dispatcher):
             "per-launch flight records: queue-wait + h2d/kernel/d2h "
             "sub-spans, device width, fallback/degraded/throttle flags "
             "(args: reset; export with tools/trace_export.py)",
+        )
+        def _dump_mempools(cmd: dict) -> dict:
+            # the HBM mempool ledger (common/mempool.py, ISSUE 13):
+            # per-pool current/peak bytes+buffers, per-device breakdown,
+            # pressure state, and (in ec_tpu_mempool_debug mode) the
+            # per-call-site shards.  `reset_peaks: true` rebases the
+            # peak gauges, like the reference's mempool peak reset.
+            from ..common.mempool import ledger as _hbm
+
+            if cmd.get("reset_peaks"):
+                _hbm().reset_peaks()
+                return {"reset_peaks": True}
+            return _hbm().dump()
+
+        sock.register(
+            "dump_mempools",
+            _dump_mempools,
+            "HBM mempool ledger: per-pool current/peak bytes+buffers, "
+            "per-device breakdown, pressure state, call-site shards in "
+            "debug mode (args: reset_peaks)",
         )
         sock.register(
             "dump_historic_ops",
@@ -1396,6 +1433,7 @@ def _osd_status(osd: "OSD") -> dict:
                 pg.logical_object_size(o) for o in heads
             )
             pool_heads[pid] = pool_heads.get(pid, 0) + len(heads)
+    hbm_pools, hbm_pressure = _hbm_status()
     return {
         "num_pgs": len(osd.pgs),
         "up": osd.up,
@@ -1431,11 +1469,29 @@ def _osd_status(osd: "OSD") -> dict:
         # into the digest slice the TPU_BACKEND_DEGRADED health check
         # (mon HEALTH_WARN + mgr prometheus healthcheck gauge) reads
         "tpu_backend": _tpu_backend_status(),
+        # HBM mempool ledger + pressure verdict (ISSUE 13): per-pool
+        # residency for the ceph_tpu_mempool_* scrape families, and the
+        # pressure evaluation (which also APPLIES the staged trims) the
+        # TPU_HBM_PRESSURE health check reads — the status beacon is
+        # the periodic driver of the pressure loop
+        "hbm_mempools": hbm_pools,
+        "hbm_pressure": hbm_pressure,
         # per-PG scrub inconsistencies from this OSD's primaries —
         # aggregated by the mgr into the digest slice the mon's
         # OSD_SCRUB_ERRORS / PG_DAMAGED HEALTH_ERR checks read
         "scrub_errors": scrub_errors,
     }
+
+
+def _hbm_status() -> tuple[dict, dict]:
+    """(per-pool ledger snapshot, pressure verdict) for the status blob.
+    The pressure call is the EVALUATING one — each beacon re-checks the
+    ratio and applies/releases the staged trims, so a runtime target
+    change takes effect within one report interval."""
+    from ..common.mempool import ledger as hbm_ledger
+
+    led = hbm_ledger()
+    return led.snapshot(), led.check_pressure()
 
 
 def _tpu_backend_status() -> dict:

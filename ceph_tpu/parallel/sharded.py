@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 import numpy as np
 
+from ceph_tpu.common.mempool import track_buffer
 from ceph_tpu.ops.dispatch import record_launch
 from ceph_tpu.ops.packed_gf import PackedPlan, _packed_code_impl
 from ceph_tpu.ops.pallas_gf import CodingPlan
@@ -96,7 +97,11 @@ def shard_batch(data: jax.Array, mesh: Mesh) -> jax.Array:
     pad_l = -L % mesh.shape[LANE_AXIS]
     if pad_s or pad_l:
         data = jnp.pad(data, ((0, pad_s), (0, 0), (0, pad_l)))
-    return jax.device_put(data, _stripe_sharding(mesh))
+    # HBM ledger (ISSUE 13): the placement is resident until the launch
+    # retires and the caller drops it — GC-tracked, not hand-released
+    return track_buffer(
+        jax.device_put(data, _stripe_sharding(mesh)), "sharded_placement"
+    )
 
 
 @functools.cache
@@ -338,7 +343,12 @@ def sharded_coder_code(coder, data, mesh: Mesh, out=None) -> jax.Array:
             )
         else:
             data = jnp.pad(data, ((0, pad), (0, 0), (0, 0)))
-    placed = jax.device_put(data, _stripe_sharding(mesh))
+    # HBM ledger (ISSUE 13): the sharded H2D placement is device-resident
+    # for the life of the launch — tracked so dump_mempools shows bulk
+    # launches' staging alongside the cache/donation/in-flight pools
+    placed = track_buffer(
+        jax.device_put(data, _stripe_sharding(mesh)), "sharded_placement"
+    )
     if coder.plan is not None and L % 128 == 0:
         # trace-time caveat: the CodingPlan wrapper records its own
         # (single) launch while the shard_map body is first traced; the

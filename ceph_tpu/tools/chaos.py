@@ -131,6 +131,13 @@ async def _run(cfg: dict) -> dict:
     from ceph_tpu.ops.flight_recorder import flight_recorder
 
     flight_recorder().reset()
+    # HBM mempool ledger (ISSUE 13): rebase the peaks so the reported
+    # high-water mark is a property of THIS run, and so the end-of-run
+    # leak assertion measures this run's drains
+    from ceph_tpu.common.mempool import ledger as hbm_ledger
+
+    hbm = hbm_ledger()
+    hbm.reset_peaks()
 
     monmap = MonMap(addrs=_free_port_addrs(1))
     mons = [Monitor(n, monmap, election_timeout=0.3) for n in monmap.addrs]
@@ -606,6 +613,24 @@ async def _run(cfg: dict) -> dict:
         report["flight"] = flight_recorder().summary()
         report["fallback_launches"] = (
             ec_dispatch.FALLBACK_LAUNCHES.snapshot()["launches"] - fallback0
+        )
+        # HBM ledger verdict (ISSUE 13): peak residency during the run
+        # (the headroom number bench rounds correlate against) and ZERO
+        # leaked bytes once the EC pipelines drain — host-fallback and
+        # sticky-error launches released their holds too, or this
+        # assertion names the bytes they kept
+        from ceph_tpu.codec.matrix_codec import drain_all_aggregators
+
+        drain_all_aggregators()
+        report["hbm_peak_bytes"] = hbm.peak_total_bytes()
+        hbm_leaked = (
+            hbm.current_bytes("ec_pipeline_inflight")
+            + hbm.current_bytes("verify")
+        )
+        report["hbm_leaked_bytes"] = hbm_leaked
+        assert hbm_leaked == 0, (
+            f"chaos: {hbm_leaked} HBM bytes leaked after drain "
+            f"(reconcile: {hbm.reconcile()})"
         )
         report["msgr_resends"] = sum(
             o.msgr.resends + o.monc.msgr.resends for o in live

@@ -23,7 +23,10 @@ of infer:
   recovery / background — the ISSUE 9 launch scheduler's lanes), same
   queue_wait + launch slices: a priority inversion is a background
   launch slice sitting in front of a client lane's queue_wait, visible
-  at a glance.
+  at a glance;
+- one counter track ("hbm" row, ISSUE 13): the mempool ledger's
+  resident-bytes level at each launch's dispatch, so memory pressure
+  renders on the same timeline as the launches that caused it.
 
 Usage::
 
@@ -225,6 +228,24 @@ def export_chrome_trace(records: list[dict]) -> dict:
     # records that never passed through the launch scheduler (raw bench
     # loops, bulk eager calls) have no class and stay off this row
     _sequential_lanes("sched class", lambda rec: rec.get("sched_class") or None)
+    # HBM counter track (ISSUE 13): the mempool ledger's resident-bytes
+    # level at each launch's dispatch, as Chrome counter events ("C") —
+    # memory pressure renders on the SAME timeline as the launches, so
+    # a residency ramp lines up visually with the launches that caused
+    # it.  Records from pre-ledger dumps (no hbm_bytes key) emit
+    # nothing; an explicit 0 still plots (the drain back to baseline is
+    # part of the signal).
+    for rec in sorted(records, key=_completion_ts):
+        if "hbm_bytes" not in rec:
+            continue
+        events.append({
+            "name": "hbm_resident_bytes",
+            "ph": "C",
+            "pid": "hbm",
+            "tid": "hbm",
+            "ts": _us(rec.get("dispatch_ts") or rec.get("submit_ts", 0.0)),
+            "args": {"bytes": int(rec["hbm_bytes"])},
+        })
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -237,17 +258,30 @@ def export_chrome_trace(records: list[dict]) -> dict:
 
 def validate_chrome_trace(trace: dict) -> None:
     """The contract tests pin (and Perfetto needs): every event is a
-    complete event with name/ph/pid/tid/ts/dur, ts+dur integers ≥ 0,
-    and no two slices on one (pid, tid) lane overlap."""
+    complete event ("X") with name/ph/pid/tid/ts/dur and no two slices
+    on one (pid, tid) lane overlapping, or a counter event ("C", the
+    ISSUE 13 HBM track) with a numeric-valued args series — counters
+    are levels, not slices, so they carry no dur and may share
+    timestamps."""
     events = trace["traceEvents"]
     lanes: dict[tuple, int] = {}
+    slices = []
     for ev in events:
+        if ev.get("ph") == "C":
+            for key in ("name", "pid", "ts", "args"):
+                assert key in ev, f"counter event missing {key}: {ev}"
+            assert isinstance(ev["ts"], int) and ev["ts"] >= 0, ev
+            assert ev["args"] and all(
+                isinstance(v, (int, float)) for v in ev["args"].values()
+            ), f"counter event with non-numeric series: {ev}"
+            continue
         for key in ("name", "ph", "pid", "tid", "ts", "dur"):
             assert key in ev, f"event missing {key}: {ev}"
         assert ev["ph"] == "X", f"non-complete event {ev}"
         assert isinstance(ev["ts"], int) and ev["ts"] >= 0, ev
         assert isinstance(ev["dur"], int) and ev["dur"] >= 1, ev
-    for ev in sorted(events, key=lambda e: (e["pid"], e["tid"], e["ts"])):
+        slices.append(ev)
+    for ev in sorted(slices, key=lambda e: (e["pid"], e["tid"], e["ts"])):
         lane = (ev["pid"], ev["tid"])
         last_end = lanes.get(lane, -1)
         assert ev["ts"] >= last_end, (

@@ -37,3 +37,41 @@ if os.environ.get("CEPH_TPU_LOCKDEP", "") != "0":
     from ceph_tpu.common import lockdep  # noqa: E402
 
     lockdep.enable()
+
+# HBM leak gate ON for the whole tier-1 suite (ISSUE 13, like lockdep):
+# every test must leave the EC launch pipelines drained — the
+# `ec_pipeline_inflight` and `verify` mempool pools read zero at
+# teardown, or the test leaked a device hold (the host-fallback /
+# sticky-error shapes the ledger exists to expose).  The drain step
+# first settles anything legitimately still in flight (a depth-N ring
+# the test simply didn't reap), so only holds that survive a full
+# settle count as leaks.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _hbm_leak_gate():
+    yield
+    from ceph_tpu.common.mempool import ledger
+
+    led = ledger()
+
+    def _held() -> int:
+        return (
+            led.current_bytes("ec_pipeline_inflight")
+            + led.current_bytes("verify")
+        )
+
+    leaked = _held()
+    if leaked:
+        from ceph_tpu.codec.matrix_codec import drain_all_aggregators
+
+        try:
+            drain_all_aggregators()
+        except Exception:
+            pass  # sticky launch errors still settle; re-measure below
+        leaked = _held()
+    assert leaked == 0, (
+        f"HBM ledger leak: {leaked} bytes still held in the EC launch "
+        f"pools after drain (reconcile: {led.reconcile()})"
+    )

@@ -57,6 +57,12 @@ class TestChaosSmoke:
         assert report["verify_launches"] >= 1, report
         assert report["verify_launches"] < report["scrub_objects"], report
         assert report["scrub_p99_ms"] >= 0.0, report
+        # ISSUE 13: the HBM mempool ledger metered the run — a nonzero
+        # peak (launches held device memory) and ZERO leaked bytes once
+        # the pipelines drained (also asserted inside the run; these
+        # keys are what bench folds alongside the throughput numbers)
+        assert report["hbm_peak_bytes"] > 0, report
+        assert report["hbm_leaked_bytes"] == 0, report
         # ISSUE 12: the whole run executed under dynamic lockdep — zero
         # lock-order violations across the concurrent aggregator/
         # scheduler/pipeline/cache stack, and the observed ordering

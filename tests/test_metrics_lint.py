@@ -385,6 +385,48 @@ class TestClusterScrapeLint:
             }, burn
             assert families["ceph_tpu_pool_slo_target_seconds"]["samples"]
 
+            # ISSUE 13 cross-lint: the HBM mempool ledger families are
+            # gauge-typed (residency rises AND falls), pool-labeled
+            # strictly from the ledger's own pool set, documented, and
+            # carry per-daemon samples once the OSDs reported
+            from ceph_tpu.common.mempool import ledger as hbm_ledger
+
+            hbm_pools = set(hbm_ledger().snapshot())
+            for fam in (
+                "ceph_tpu_mempool_bytes",
+                "ceph_tpu_mempool_buffers",
+                "ceph_tpu_mempool_peak_bytes",
+            ):
+                assert fam in families, f"{fam} missing from scrape"
+                assert families[fam]["type"] == "gauge", fam
+                assert documented(fam), f"{fam} not documented"
+                samples = families[fam]["samples"]
+                assert samples, f"{fam} announced but carries no samples"
+                for _n, labels, _v in samples:
+                    assert labels.get("pool") in hbm_pools, (
+                        f"{fam} sample labeled with unknown pool "
+                        f"{labels.get('pool')!r}"
+                    )
+                    assert labels.get("daemon", "").startswith("osd."), (
+                        labels
+                    )
+            for fam in (
+                "ceph_tpu_hbm_pressure_ratio",
+                "ceph_tpu_hbm_target_bytes",
+            ):
+                assert fam in families, f"{fam} missing from scrape"
+                assert families[fam]["type"] == "gauge", fam
+                assert documented(fam), f"{fam} not documented"
+                assert families[fam]["samples"], fam
+            # direction 2: every scraped mempool family maps back to a
+            # ledger export (bytes / buffers / peak_bytes only)
+            for fam in families:
+                if fam.startswith("ceph_tpu_mempool_"):
+                    suffix = fam.removeprefix("ceph_tpu_mempool_")
+                    assert suffix in (
+                        "bytes", "buffers", "peak_bytes",
+                    ), f"scraped {fam} has no mempool ledger source"
+
             # trace-sampling families (ISSUE 10 layer 3): every
             # sampling_stats() key the OSD reports round-trips onto the
             # scrape as ceph_tpu_trace_<key>, and vice versa; knobs and

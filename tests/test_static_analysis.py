@@ -34,6 +34,7 @@ from ceph_tpu.analysis import (
 from ceph_tpu.analysis.passes import ALL_PASSES, PASS_BY_ID
 from ceph_tpu.analysis.passes.donation import DonationLifetimePass
 from ceph_tpu.analysis.passes.exceptions import ExceptionSwallowPass
+from ceph_tpu.analysis.passes.ledger import LedgerDisciplinePass
 from ceph_tpu.analysis.passes.locks import LockDisciplinePass
 from ceph_tpu.analysis.passes.options_coherence import OptionsCoherencePass
 from ceph_tpu.analysis.passes.purity import JitPurityPass
@@ -308,6 +309,96 @@ class TestOptionsPass:
         tree = _tree(tmp_path, self._files())
         keys = _keys(self._pass()(tree))
         assert "undocumented::knob_read" in keys
+
+
+class TestLedgerPass:
+    """ledger-discipline (ISSUE 13): device_put in the data-path
+    packages must be threaded through a mempool-tracked helper."""
+
+    def test_untracked_device_put_trips(self, tmp_path):
+        tree = _tree(tmp_path, {"ops/stage.py": """
+            import jax
+
+            def stage(arr):
+                return jax.device_put(arr)
+        """})
+        findings = LedgerDisciplinePass()(tree)
+        assert any("::stage::device_put" in k for k in _keys(findings)), (
+            findings
+        )
+
+    def test_track_buffer_wrapper_passes(self, tmp_path):
+        tree = _tree(tmp_path, {"parallel/place.py": """
+            import jax
+            from ceph_tpu.common.mempool import track_buffer
+
+            def place(arr, sharding):
+                return track_buffer(
+                    jax.device_put(arr, sharding), "sharded_placement"
+                )
+        """})
+        assert not LedgerDisciplinePass()(tree)
+
+    def test_explicit_alloc_handle_passes(self, tmp_path):
+        tree = _tree(tmp_path, {"ops/cache.py": """
+            import jax
+
+            def put(self, arr):
+                buf = jax.device_put(arr)
+                self.mem = ledger().alloc("device_cache", arr.nbytes, buf=buf)
+                return buf
+        """})
+        assert not LedgerDisciplinePass()(tree)
+
+    def test_unrelated_alloc_does_not_silence(self, tmp_path):
+        """Only a LEDGER alloc counts: an `.alloc` on an arbitrary
+        receiver must not excuse a bare device_put."""
+        tree = _tree(tmp_path, {"ops/arena.py": """
+            import jax
+
+            def stage(self, arr):
+                slot = self.arena.alloc(arr.nbytes)
+                return jax.device_put(arr)
+        """})
+        findings = LedgerDisciplinePass()(tree)
+        assert any("::stage::device_put" in k for k in _keys(findings)), (
+            findings
+        )
+
+    def test_untracked_sibling_of_tracked_put_still_trips(self, tmp_path):
+        """One wrapped placement must not silence a bare one next to
+        it — wrapping is a per-call property."""
+        tree = _tree(tmp_path, {"ops/mixed.py": """
+            import jax
+            from ceph_tpu.common.mempool import track_buffer
+
+            def stage(a, b):
+                placed = track_buffer(jax.device_put(a), "scratch")
+                return placed, jax.device_put(b)
+        """})
+        findings = LedgerDisciplinePass()(tree)
+        assert any("::stage::device_put" in k for k in _keys(findings)), (
+            findings
+        )
+
+    def test_keyword_wrapped_device_put_passes(self, tmp_path):
+        tree = _tree(tmp_path, {"ops/kw.py": """
+            import jax
+            from ceph_tpu.common.mempool import track_buffer
+
+            def place(arr):
+                return track_buffer(buf=jax.device_put(arr))
+        """})
+        assert not LedgerDisciplinePass()(tree)
+
+    def test_out_of_scope_packages_ignored(self, tmp_path):
+        tree = _tree(tmp_path, {"mgr/module.py": """
+            import jax
+
+            def stage(arr):
+                return jax.device_put(arr)
+        """})
+        assert not LedgerDisciplinePass()(tree)
 
 
 class TestAllowlist:
