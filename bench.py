@@ -1162,6 +1162,22 @@ def main() -> None:
             }
         except (OSError, json.JSONDecodeError) as e:
             _log(f"ignoring unreadable BENCH_CHAOS_JSON: {e!r}")
+    # round-over-round trajectory gating (ISSUE 14): judge this round
+    # against the trailing committed BENCH_r*.json rounds (same-platform
+    # best — a next TPU round is automatically held to round 3's
+    # 23.4 GB/s instead of silently resetting the story) and fold the
+    # machine-readable regressions slice computed by
+    # ceph_tpu/tools/perf_compare.py.  Guarded: the headline must
+    # survive a compare fault, but the fault stays machine-visible.
+    try:
+        from ceph_tpu.tools.perf_compare import compare_round
+
+        out["regressions"] = compare_round(
+            out, os.path.dirname(os.path.abspath(__file__))
+        )
+    except Exception as e:
+        _log(f"perf-compare fold failed: {e!r}")
+        out["regressions"] = {"error": repr(e)}
     print(json.dumps(out))
 
 

@@ -154,6 +154,74 @@ def slo_breach_detail(breaches: dict[str, dict]) -> list[str]:
     return lines
 
 
+def throughput_regression_summary(regressions: dict[str, dict]) -> str | None:
+    """The TPU_THROUGHPUT_REGRESSION check summary for a per-kind trend
+    slice ({kind: {current_gbps, baseline_gbps, ratio,
+    launches_per_sec}}), or None when throughput tracks its baseline.
+    Shared by the mgr metrics-history module and the mon health check
+    so the two surfaces agree."""
+    if not regressions:
+        return None
+    worst = min(v.get("ratio", 1.0) for v in regressions.values())
+    kinds = ",".join(sorted(regressions))
+    return (
+        f"EC {kinds} throughput regressed to {worst:.0%} of its "
+        f"trailing baseline while launch volume persists"
+    )
+
+
+def throughput_regression_detail(regressions: dict[str, dict]) -> list[str]:
+    """Per-kind breakdown lines (`health detail`)."""
+    return [
+        f"{kind}: {v.get('current_gbps', 0.0):.3f} GB/s vs "
+        f"{v.get('baseline_gbps', 0.0):.3f} GB/s baseline "
+        f"({v.get('ratio', 0.0):.0%}) at "
+        f"{v.get('launches_per_sec', 0.0):.2f} launches/s"
+        for kind, v in sorted(regressions.items())
+    ]
+
+
+def occupancy_collapse_summary(data: dict) -> str | None:
+    """The TPU_OCCUPANCY_COLLAPSE check summary ({current, baseline,
+    ratio, launches_per_sec}), or None on an empty slice."""
+    if not data:
+        return None
+    return (
+        f"device occupancy collapsed to {data.get('ratio', 0.0):.0%} of "
+        f"its trailing baseline "
+        f"({data.get('current', 0.0):.3f} vs "
+        f"{data.get('baseline', 0.0):.3f}) while launch volume persists"
+    )
+
+
+def occupancy_collapse_detail(data: dict) -> list[str]:
+    return [
+        f"occupancy {data.get('current', 0.0):.4f} vs baseline "
+        f"{data.get('baseline', 0.0):.4f} at "
+        f"{data.get('launches_per_sec', 0.0):.2f} launches/s"
+    ]
+
+
+def queue_wait_inflation_summary(data: dict) -> str | None:
+    """The TPU_QUEUE_WAIT_INFLATION check summary ({current_ms,
+    baseline_ms, factor}), or None on an empty slice."""
+    if not data:
+        return None
+    return (
+        f"launch queue wait inflated {data.get('factor', 0.0):.1f}x over "
+        f"its trailing baseline ({data.get('current_ms', 0.0):.2f} ms vs "
+        f"{data.get('baseline_ms', 0.0):.2f} ms)"
+    )
+
+
+def queue_wait_inflation_detail(data: dict) -> list[str]:
+    return [
+        f"mean queue wait {data.get('current_ms', 0.0):.3f} ms vs "
+        f"baseline {data.get('baseline_ms', 0.0):.3f} ms "
+        f"({data.get('factor', 0.0):.1f}x)"
+    ]
+
+
 def scrub_errors_total(scrub: dict[str, dict]) -> int:
     """Total scrub errors across a per-PG slice ({pgid: {errors,
     inconsistent, ...}})."""

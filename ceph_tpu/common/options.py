@@ -388,6 +388,64 @@ OPTIONS: dict[str, Option] = _opts(
     Option("mgr_slo_slow_window_sec", float, 60.0, A,
            "slow burn-rate window (seconds)",
            see_also=("mgr_slo_fast_window_sec",), runtime=True),
+    # --- metrics history + trend sentinels (ISSUE 14; common/tsdb.py,
+    # --- mgr/metrics_history.py) --------------------------------------------
+    Option("mgr_history_max_series", int, 256, A,
+           "cardinality cap of the mgr-resident time-series store "
+           "(common/tsdb.py): when a new series would exceed it, the "
+           "least-recently-written series is evicted — churned daemons "
+           "and departed clients age out instead of growing the mgr "
+           "without bound.  Evictions are counted on the "
+           "ceph_tpu_history_evictions counter", runtime=True),
+    Option("mgr_history_ring_slots", int, 360, A,
+           "downsample buckets retained per resolution level per "
+           "series: with the default 1s/10s/60s resolutions, 360 slots "
+           "keep ~6 minutes of raw samples, an hour at 10 s, and six "
+           "hours at 1 min — in fixed memory per series",
+           see_also=("mgr_history_resolutions",), runtime=True),
+    Option("mgr_history_resolutions", str, "1,10,60", A,
+           "comma-separated downsample bucket widths in seconds, "
+           "finest first; raw samples land in the finest ring and fold "
+           "into min/max/avg/last buckets at each coarser width.  "
+           "Changing this at runtime restarts the history at the new "
+           "geometry", see_also=("mgr_history_ring_slots",), runtime=True),
+    Option("mgr_trend_window_sec", float, 15.0, A,
+           "recent window the trend sentinels average over; compared "
+           "against the trailing mgr_trend_baseline_sec window that "
+           "precedes it.  Sentinels hold fire until a full "
+           "window + baseline of genuinely observed history exists "
+           "(mgr failover never alarms on imported totals)",
+           see_also=("mgr_trend_baseline_sec",), runtime=True),
+    Option("mgr_trend_baseline_sec", float, 60.0, A,
+           "trailing baseline window the trend sentinels compare the "
+           "recent window against", see_also=("mgr_trend_window_sec",),
+           runtime=True),
+    Option("mgr_trend_regression_ratio", float, 0.5, A,
+           "TPU_THROUGHPUT_REGRESSION threshold: the check raises when "
+           "recent encode/decode GB/s falls below this fraction of the "
+           "trailing baseline while launch volume persists (>= "
+           "mgr_trend_min_launch_rate and >= half the baseline launch "
+           "cadence — a load DROP is not a regression).  <= 0 disables "
+           "the sentinel", see_also=("mgr_trend_min_launch_rate",),
+           runtime=True),
+    Option("mgr_trend_occupancy_ratio", float, 0.5, A,
+           "TPU_OCCUPANCY_COLLAPSE threshold: raises when recent device "
+           "occupancy falls below this fraction of the trailing "
+           "baseline under sustained launch volume.  <= 0 disables the "
+           "sentinel", runtime=True),
+    Option("mgr_trend_queue_wait_factor", float, 3.0, A,
+           "TPU_QUEUE_WAIT_INFLATION threshold: raises when the recent "
+           "mean launch queue-wait exceeds this multiple of the "
+           "trailing baseline (baseline floored at 1 ms, so a "
+           "near-zero-wait baseline requires factor x 1 ms) under "
+           "sustained launch volume.  <= 0 disables the sentinel",
+           runtime=True),
+    Option("mgr_trend_min_launch_rate", float, 0.1, A,
+           "launch-volume floor (launches/sec over BOTH trend windows) "
+           "below which NO trend sentinel evaluates — an idle or "
+           "draining cluster has trends worth graphing, not alarming "
+           "on, and an idle baseline is nothing to regress from",
+           see_also=("mgr_trend_regression_ratio",), runtime=True),
     Option(
         "mgr_progress_stall_sec",
         float,

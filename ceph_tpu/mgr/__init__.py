@@ -3,6 +3,7 @@ src/pybind/mgr)."""
 
 from .dashboard import DashboardModule
 from .iostat import IostatModule
+from .metrics_history import MetricsHistoryModule
 from .mgr import Mgr
 from .modules import MgrModule
 from .orchestrator import OrchBackend, OrchestratorModule, ServiceSpec
@@ -12,6 +13,7 @@ from .telemetry import TelemetryModule
 __all__ = [
     "DashboardModule",
     "IostatModule",
+    "MetricsHistoryModule",
     "Mgr",
     "MgrModule",
     "OrchBackend",

@@ -27,18 +27,27 @@ class DashboardModule(HttpServedModule, MgrModule):
     # -- REST payloads (dashboard/controllers/{health,osd,pool,...}.py) ------
 
     def api_health(self) -> dict:
-        checks = {}
-        for mod in self.mgr.modules:
-            for code, info in mod.health_checks.items():
-                checks[code] = info
-        status = "HEALTH_OK"
-        if any(c.get("severity") == "warning" for c in checks.values()):
-            status = "HEALTH_WARN"
-        if any(c.get("severity") == "error" for c in checks.values()):
-            status = "HEALTH_ERR"
+        """The /api/health payload: the mgr's full check set — module
+        checks AND the digest-derived ones (SLOW_OPS, OSD_DOWN, ...) —
+        each with severity, summary, and the per-entity detail lines
+        mon `health detail` would print.  Overall status derives from
+        common/health.py's single severity rule: the old module-only
+        merge compared against literal "warning"/"error" strings no
+        check ever used, so the dashboard banner read HEALTH_OK while
+        the cluster burned."""
+        from ..common import health
+
+        checks = {
+            code: {
+                "severity": info.get("severity", "HEALTH_WARN"),
+                "summary": info.get("summary", ""),
+                "detail": list(info.get("detail") or []),
+            }
+            for code, info in self.mgr.health_checks().items()
+        }
         m = self.mgr.osdmap
         return {
-            "status": status,
+            "status": health.overall_status(checks),
             "checks": checks,
             "osdmap_epoch": m.epoch,
             "num_osds": len(m.osds),
@@ -104,6 +113,33 @@ class DashboardModule(HttpServedModule, MgrModule):
             for d in self.mgr.list_daemons()
         ]
 
+    def api_perf_history(self) -> dict:
+        """The /api/perf_history payload (ISSUE 14): the metrics-history
+        module's series inventory, store meta-stats, and the raised
+        trend sentinels — the dashboard window onto `perf history ls`.
+        Empty when the module isn't registered (modules are opt-in)."""
+        from .modules import find_module
+
+        mod = find_module(self.mgr, "metrics_history")
+        if mod is None:
+            return {"series": [], "stats": {}, "sentinels": {}}
+        return {
+            **mod.history_ls(),
+            "sentinels": mod.history_digest()["sentinels"],
+        }
+
+    def prometheus_metrics(self) -> list[tuple[str, str, str, list[str]]]:
+        """Module-metrics hook: `map_errors` (PGs skipped as unmappable
+        in api_pgs) was a module-local counter nobody could see — a
+        CRUSH map that silently stopped mapping PGs deserves a scrape
+        family, not a buried attribute."""
+        return [
+            ("ceph_tpu_dashboard_map_errors", "counter",
+             "PGs the dashboard could not map to OSDs (skipped rows in "
+             "/api/pgs)",
+             [f"ceph_tpu_dashboard_map_errors {self.map_errors}"]),
+        ]
+
     def render(self, path: str) -> tuple[int, str, str]:
         """(status, content-type, body) for a request path."""
         routes = {
@@ -112,6 +148,7 @@ class DashboardModule(HttpServedModule, MgrModule):
             "/api/pools": self.api_pools,
             "/api/pgs": self.api_pgs,
             "/api/daemons": self.api_daemons,
+            "/api/perf_history": self.api_perf_history,
         }
         fn = routes.get(path)
         if fn is not None:
@@ -130,7 +167,8 @@ class DashboardModule(HttpServedModule, MgrModule):
                 f"{h['num_osds']} OSDs up — {h['num_pools']} pools</p>"
                 f"<table border=1><tr><th>daemon</th><th>state</th><th>membership"
                 f"</th></tr>{rows}</table>"
-                "<p>API: /api/health /api/osds /api/pools /api/pgs /api/daemons</p>"
+                "<p>API: /api/health /api/osds /api/pools /api/pgs "
+                "/api/daemons /api/perf_history</p>"
                 "</body></html>"
             )
             return 200, "text/html", body

@@ -453,6 +453,7 @@ class IostatModule(MgrModule):
                 "SLO_LATENCY_BREACH",
                 "HEALTH_WARN",
                 health.slo_breach_summary(breaches) or "",
+                health.slo_breach_detail(breaches),
             )
         else:
             self.clear_health_check("SLO_LATENCY_BREACH")

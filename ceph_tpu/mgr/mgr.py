@@ -97,17 +97,18 @@ class Mgr(Dispatcher):
         from ..common.admin_socket import AdminSocket
 
         sock = AdminSocket(path)
+        from .modules import find_module
 
-        def _iostat_module():
-            for module in self.modules:
-                if getattr(module, "NAME", "") == "iostat":
-                    return module
-            raise ValueError("iostat module not registered")
+        def _module(name: str):
+            module = find_module(self, name)
+            if module is None:
+                raise ValueError(f"{name} module not registered")
+            return module
 
         sock.register(
             "iostat top",
             lambda cmd: {
-                "clients": _iostat_module().top_clients(
+                "clients": _module("iostat").top_clients(
                     n=int(cmd["n"]) if "n" in cmd else None,
                     by=cmd.get("by", "ops_rate"),
                 )
@@ -117,8 +118,27 @@ class Mgr(Dispatcher):
         )
         sock.register(
             "iostat",
-            lambda cmd: {"pools": _iostat_module().iostat()},
+            lambda cmd: {"pools": _module("iostat").iostat()},
             "per-pool IO rates, windowed p99, cumulative totals",
+        )
+        # metrics-history query surface (ISSUE 14): the stored series
+        # and their multi-resolution windows, from the operator path
+        sock.register(
+            "perf history ls",
+            lambda cmd: _module("metrics_history").history_ls(),
+            "list stored perf time series + store meta stats",
+        )
+        sock.register(
+            "perf history get",
+            lambda cmd: _module("metrics_history").history_get(
+                cmd.get("series", "encode_gbps"),
+                daemon=cmd.get("daemon") or None,
+                window=float(cmd.get("window", 300.0)),
+                step=float(cmd.get("step", 0.0)),
+                aggregate=cmd.get("aggregate", "avg"),
+            ),
+            "one series re-bucketed over a window (args: series, "
+            "daemon, window, step, aggregate=avg|min|max|last|sum)",
         )
         await sock.start()
         self.admin_socket = sock
@@ -236,6 +256,12 @@ class Mgr(Dispatcher):
             # per-pool SLO burn-rate slice: the mon-side
             # SLO_LATENCY_BREACH check reads `breaches`
             "slo": self._module_digest("slo_digest"),
+            # trend-sentinel slice from the metrics-history module
+            # (ISSUE 14): raised TPU_THROUGHPUT_REGRESSION /
+            # TPU_OCCUPANCY_COLLAPSE / TPU_QUEUE_WAIT_INFLATION checks
+            # with wording built in common/health.py, plus the store's
+            # meta-stats; the mon renders them like PG_RECOVERY_STALLED
+            "history": self._module_digest("history_digest"),
         }
 
     def _module_digest(self, hook: str) -> dict:
@@ -346,29 +372,36 @@ class Mgr(Dispatcher):
         from ..common import health
 
         checks: dict[str, dict] = {}
-        summary = health.slow_ops_summary(self.slow_ops_by_daemon())
+        slow = self.slow_ops_by_daemon()
+        summary = health.slow_ops_summary(slow)
         if summary:
             checks["SLOW_OPS"] = {
                 "severity": "HEALTH_WARN",
                 "summary": summary,
+                "detail": health.slow_ops_detail(slow),
             }
         down = health.down_in_osds(self.osdmap)
         if down:
             checks["OSD_DOWN"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{len(down)} osds down",
+                "detail": [f"osd.{o} is down" for o in down],
             }
-        degraded = health.tpu_degraded_summary(self.tpu_degraded_by_daemon())
-        if degraded:
+        degraded = self.tpu_degraded_by_daemon()
+        summary = health.tpu_degraded_summary(degraded)
+        if summary:
             checks["TPU_BACKEND_DEGRADED"] = {
                 "severity": "HEALTH_WARN",
-                "summary": degraded,
+                "summary": summary,
+                "detail": health.tpu_degraded_detail(degraded),
             }
-        pressure = health.hbm_pressure_summary(self.hbm_pressure_by_daemon())
-        if pressure:
+        pressured = self.hbm_pressure_by_daemon()
+        summary = health.hbm_pressure_summary(pressured)
+        if summary:
             checks["TPU_HBM_PRESSURE"] = {
                 "severity": "HEALTH_WARN",
-                "summary": pressure,
+                "summary": summary,
+                "detail": health.hbm_pressure_detail(pressured),
             }
         scrub = self.scrub_errors_by_pg()
         summary = health.osd_scrub_errors_summary(scrub)
@@ -380,10 +413,12 @@ class Mgr(Dispatcher):
             checks["OSD_SCRUB_ERRORS"] = {
                 "severity": health.check_severity("OSD_SCRUB_ERRORS"),
                 "summary": summary,
+                "detail": health.pg_damaged_detail(scrub),
             }
             checks["PG_DAMAGED"] = {
                 "severity": health.check_severity("PG_DAMAGED"),
                 "summary": health.pg_damaged_summary(scrub),
+                "detail": health.pg_damaged_detail(scrub),
             }
         for module in self.modules:
             checks.update(getattr(module, "health_checks", {}) or {})

@@ -54,6 +54,17 @@ class HttpServedModule:
             self._server = None
 
 
+def find_module(mgr, name: str):
+    """The registered module with this NAME, or None.  The one lookup
+    shared by the mgr asok, the dashboard routes, and the telemetry
+    envelope — modules are opt-in, so every caller decides its own
+    miss behavior, but the scan lives once."""
+    for module in getattr(mgr, "modules", []) or []:
+        if getattr(module, "NAME", "") == name:
+            return module
+    return None
+
+
 class MgrModule:
     """Base class modules subclass (mgr_module.py MgrModule): `tick()`
     is the `serve()` loop body, called on the ACTIVE mgr about once a
@@ -70,8 +81,18 @@ class MgrModule:
     def tick(self) -> None:  # may be async
         pass
 
-    def set_health_check(self, code: str, severity: str, summary: str) -> None:
-        self.health_checks[code] = {"severity": severity, "summary": summary}
+    def set_health_check(
+        self,
+        code: str,
+        severity: str,
+        summary: str,
+        detail: list[str] | None = None,
+    ) -> None:
+        self.health_checks[code] = {
+            "severity": severity,
+            "summary": summary,
+            "detail": list(detail or []),
+        }
 
     def clear_health_check(self, code: str) -> None:
         self.health_checks.pop(code, None)

@@ -282,6 +282,7 @@ class ProgressModule(MgrModule):
                 "PG_RECOVERY_STALLED",
                 "HEALTH_WARN",
                 health.recovery_stalled_summary(slice_) or "",
+                health.recovery_stalled_detail(slice_),
             )
         else:
             self.clear_health_check("PG_RECOVERY_STALLED")

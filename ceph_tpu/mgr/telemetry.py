@@ -99,9 +99,46 @@ class TelemetryModule(MgrModule):
             "health_checks": sorted(
                 {code for mod in self.mgr.modules for code in mod.health_checks}
             ),
+            "perf_envelope": self._perf_envelope(),
         }
         self.last_report = report
         return report
+
+    def _perf_envelope(self) -> dict:
+        """Performance-envelope slice (ISSUE 14): shapes and counts
+        only, honoring the privacy contract — series/eviction COUNTS
+        from the metrics-history store and cluster-aggregate PEAKS
+        (the label-free series: no daemon names, pool names, or client
+        ids can reach the report).  Empty when the module isn't
+        registered."""
+        from .modules import find_module
+
+        mod = find_module(self.mgr, "metrics_history")
+        if mod is None:
+            return {}
+        stats = mod.store.stats()
+        env = {
+            "history_series": stats["series"],
+            "history_points": stats["points"],
+            "history_evictions": stats["evictions"],
+            "sentinels_fired": mod.sentinels_fired,
+        }
+        # peaks over the store's full retention, cluster series only
+        # ({} labels — built exclusively from aggregate sums/means)
+        retention = 10 * 24 * 3600.0  # >= any configured retention
+        for key, family in (
+            ("peak_encode_gbps", "encode_gbps"),
+            ("peak_decode_gbps", "decode_gbps"),
+            ("peak_occupancy", "occupancy"),
+            ("peak_queue_wait_ms", "queue_wait_ms"),
+        ):
+            peak = mod.store.window_value(
+                family, {}, start_ago=retention, end_ago=0.0,
+                aggregate="max",
+            )
+            if peak is not None:
+                env[key] = round(peak, 4)
+        return env
 
     def tick(self) -> None:
         if not self.enabled:

@@ -508,6 +508,22 @@ class Monitor(Dispatcher):
             details["PG_RECOVERY_STALLED"] = health.recovery_stalled_detail(
                 stalled
             )
+        # trend sentinels from the mgr metrics-history module (ISSUE
+        # 14): throughput regression / occupancy collapse / queue-wait
+        # inflation vs their trailing baselines.  The wording was built
+        # mgr-side by common/health.py, so rendering the shipped
+        # summary/detail verbatim keeps the two surfaces in lockstep —
+        # the PG_RECOVERY_STALLED raise/clear shape.  The checks drop
+        # when the trend recovers (the module clears the slice).
+        sentinels = (self.pg_digest.get("history") or {}).get(
+            "sentinels"
+        ) or {}
+        for code, rec in sorted(sentinels.items()):
+            summary = rec.get("summary")
+            if not summary:
+                continue
+            checks[code] = summary
+            details[code] = list(rec.get("detail") or [])
         # pools burning their latency-SLO error budget (mgr iostat
         # module digest slice, ISSUE 10): raise/clear like
         # PG_RECOVERY_STALLED — the check drops when the load stops or
@@ -597,6 +613,11 @@ class Monitor(Dispatcher):
                             # per-pool SLO burn-rate slice (the health
                             # check's evidence, machine-readable)
                             "slo": self.pg_digest.get("slo", {}),
+                            # trend-sentinel slice + history store
+                            # meta-stats (mgr metrics-history module,
+                            # ISSUE 14) — the sentinel evidence,
+                            # machine-readable from `status`
+                            "history": self.pg_digest.get("history", {}),
                         }
                     ).encode(),
                 )

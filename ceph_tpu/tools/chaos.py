@@ -173,6 +173,24 @@ async def _run(cfg: dict) -> dict:
         slo_slow_window_sec=1.5,
     )
     mgr.register_module(iostat_mod)
+    # metrics-history module (ISSUE 14): short pinned trend windows so
+    # the sentinels genuinely EVALUATE inside a smoke-scale run (the
+    # defaults would hold fire for 75 s) — a healthy converged chaos
+    # run must end with history_sentinels_fired == 0.  The regression
+    # ratio is pinned low and the volume floor high because chaos load
+    # is deliberately bursty: the assertion exists to catch spurious
+    # raises on phase transitions, not to benchmark.
+    from ceph_tpu.mgr.metrics_history import MetricsHistoryModule
+
+    history_mod = MetricsHistoryModule(
+        window_sec=2.0,
+        baseline_sec=6.0,
+        regression_ratio=0.2,
+        occupancy_ratio=0.2,
+        queue_wait_factor=50.0,
+        min_launch_rate=0.5,
+    )
+    mgr.register_module(history_mod)
     await mgr.start()
     await mgr.wait_for_active()
     progress_pgs_seen: set[tuple] = set()
@@ -636,6 +654,19 @@ async def _run(cfg: dict) -> dict:
             o.msgr.resends + o.monc.msgr.resends for o in live
         ) + client.objecter.msgr.resends
         report["op_resends"] = int(client.objecter.perf.get("op_resend"))
+        # trend-sentinel verdict (ISSUE 14): a healthy converged run
+        # must not have fired TPU_THROUGHPUT_REGRESSION /
+        # TPU_OCCUPANCY_COLLAPSE / TPU_QUEUE_WAIT_INFLATION — the
+        # module sampled real MMgrReports the whole run with windows
+        # short enough to actually evaluate (pinned above)
+        report["history_sentinels_fired"] = history_mod.sentinels_fired
+        report["history_sentinels_active"] = sorted(history_mod.sentinels)
+        report["history_store"] = history_mod.store.stats()
+        assert report["history_sentinels_fired"] == 0, (
+            f"chaos: trend sentinels fired on a healthy run: "
+            f"{report['history_sentinels_active']} "
+            f"(fired {report['history_sentinels_fired']})"
+        )
         # the final snapshot re-waits health_clear: the metrics section
         # above takes long enough for one stale beacon (e.g. a status
         # blob sampled mid-probe) to transiently re-raise a check the
@@ -655,6 +686,20 @@ async def _run(cfg: dict) -> dict:
             f"{report['lockdep_violations']} (graph: "
             f"{report['lockdep_graph']})"
         )
+        # round-over-round gating (ISSUE 14): fold the perf_compare
+        # regressions slice against the committed BENCH_r*.json corpus
+        # (chaos keys ride the bench rounds' `chaos` sub-object), so
+        # the chaos trajectory is judged like the throughput one.
+        # Guarded: a converged report must survive a compare fault.
+        try:
+            from ceph_tpu.tools.perf_compare import compare_round
+
+            report["regressions"] = compare_round({"chaos": report})
+        except Exception as e:
+            from ceph_tpu.common.log import dout
+
+            dout("chaos", 1, f"perf-compare fold failed: {e!r}")
+            report["regressions"] = {"error": repr(e)}
     finally:
         inj.clear()
         device_guard().mark_healthy()

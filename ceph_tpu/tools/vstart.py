@@ -145,6 +145,7 @@ class DevCluster:
             from ..mgr import (
                 DashboardModule,
                 IostatModule,
+                MetricsHistoryModule,
                 OrchestratorModule,
                 ProgressModule,
                 TelemetryModule,
@@ -165,6 +166,12 @@ class DevCluster:
                 # operator path sees pool rates out of the box (the
                 # same gap PR 6 closed for progress)
                 IostatModule(),
+                # mgr-resident perf history + trend sentinels (ISSUE
+                # 14): `perf history ls/get` on the mgr asok, the
+                # /api/perf_history dashboard route, and the
+                # TPU_THROUGHPUT_REGRESSION family of checks work in
+                # the operator path out of the box
+                MetricsHistoryModule(),
             ):
                 self.mgr.register_module(module)
         if self.with_mds:

@@ -194,6 +194,14 @@ class TestClusterScrapeLint:
             # gauges carry samples within the test's wait budget
             iostat = IostatModule(window_sec=2.0, slo_target_ms=5000.0)
             mgr.register_module(iostat)
+            # metrics-history meta-gauges + dashboard map_errors
+            # (ISSUE 14): both modules export through the same hook
+            from ceph_tpu.mgr import DashboardModule, MetricsHistoryModule
+
+            history = MetricsHistoryModule()
+            mgr.register_module(history)
+            dashboard = DashboardModule()
+            mgr.register_module(dashboard)
 
             client = Rados(monmap)
             await client.connect()
@@ -426,6 +434,65 @@ class TestClusterScrapeLint:
                     assert suffix in (
                         "bytes", "buffers", "peak_bytes",
                     ), f"scraped {fam} has no mempool ledger source"
+
+            # ISSUE 14 cross-lint: every family the metrics-history
+            # module exports reaches the scrape AND the docs index
+            # with its declared typing, and vice versa — every scraped
+            # ceph_tpu_history_* family maps back to a module export.
+            # The meta-gauges are the fixed-memory witness: gauges for
+            # levels (series/points/bytes/sentinel state), counters
+            # for the monotone eviction/append/fired totals.
+            history_fams = {
+                name: ftype
+                for name, ftype, _h, _r in history.prometheus_metrics()
+            }
+            for fam, ftype in history_fams.items():
+                assert fam in families, f"{fam} missing from scrape"
+                assert families[fam]["type"] == ftype, (
+                    f"{fam}: scrape type {families[fam]['type']} != "
+                    f"module type {ftype}"
+                )
+                assert documented(fam), f"{fam} not documented"
+                assert families[fam]["samples"], (
+                    f"{fam} announced but carries no samples"
+                )
+            assert history_fams["ceph_tpu_history_series"] == "gauge"
+            assert history_fams["ceph_tpu_history_bytes"] == "gauge"
+            assert history_fams["ceph_tpu_history_points"] == "gauge"
+            assert (
+                history_fams["ceph_tpu_history_sentinel_active"] == "gauge"
+            )
+            assert history_fams["ceph_tpu_history_evictions"] == "counter"
+            assert (
+                history_fams["ceph_tpu_history_sentinels_fired"]
+                == "counter"
+            )
+            # the sentinel-activity gauge renders one row per known
+            # sentinel code, all quiet on a healthy cluster
+            sentinel_rows = families[
+                "ceph_tpu_history_sentinel_active"]["samples"]
+            assert {
+                l.get("sentinel") for _n, l, _v in sentinel_rows
+            } == {
+                "TPU_THROUGHPUT_REGRESSION",
+                "TPU_OCCUPANCY_COLLAPSE",
+                "TPU_QUEUE_WAIT_INFLATION",
+            }
+            assert all(v == 0 for _n, _l, v in sentinel_rows)
+            for fam in families:
+                if fam.startswith("ceph_tpu_history_"):
+                    assert fam in history_fams, (
+                        f"scraped {fam} has no metrics_history "
+                        "prometheus_metrics() source"
+                    )
+            # dashboard satellite: map_errors is a real scrape family
+            # now, not a module-local counter nobody can see
+            assert (
+                families["ceph_tpu_dashboard_map_errors"]["type"]
+                == "counter"
+            )
+            assert documented("ceph_tpu_dashboard_map_errors")
+            assert families["ceph_tpu_dashboard_map_errors"]["samples"]
 
             # trace-sampling families (ISSUE 10 layer 3): every
             # sampling_stats() key the OSD reports round-trips onto the

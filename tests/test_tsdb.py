@@ -1,0 +1,217 @@
+"""The mgr-resident time-series store (ISSUE 14, common/tsdb.py):
+downsample correctness against a brute-force oracle, LRU cardinality-cap
+eviction under daemon/client churn, bounded memory over a long synthetic
+run, resolution selection, and runtime reconfiguration."""
+
+import random
+
+import pytest
+
+from ceph_tpu.common.tsdb import (
+    BYTES_PER_BUCKET,
+    BYTES_PER_SERIES,
+    TimeSeriesStore,
+)
+
+
+def _oracle_buckets(samples, width, agg):
+    """Brute-force downsample: {bucket_start: aggregate} from raw
+    (t, v) samples."""
+    buckets = {}
+    for t, v in samples:
+        start = (t // width) * width
+        buckets.setdefault(start, []).append(v)
+    out = {}
+    for start, vals in buckets.items():
+        if agg == "min":
+            out[start] = min(vals)
+        elif agg == "max":
+            out[start] = max(vals)
+        elif agg == "last":
+            out[start] = vals[-1]
+        elif agg == "sum":
+            out[start] = sum(vals)
+        else:
+            out[start] = sum(vals) / len(vals)
+    return out
+
+
+class TestDownsampleOracle:
+    @pytest.mark.parametrize("agg", ["avg", "min", "max", "last", "sum"])
+    def test_every_level_matches_brute_force(self, agg):
+        """Raw samples folded into 1 s / 5 s / 25 s buckets must agree
+        with the oracle at every level and aggregate."""
+        rng = random.Random(0x14)
+        widths = (1.0, 5.0, 25.0)
+        slots = 4096  # roomy: no wraparound in this test
+        store = TimeSeriesStore(max_series=4, slots=slots,
+                                resolutions=widths)
+        t0 = 1000.0
+        samples = []
+        t = t0
+        for _ in range(500):
+            t += rng.random() * 0.7
+            v = rng.uniform(-5, 50)
+            samples.append((t, v))
+            store.append("f", {"daemon": "osd.0"}, t, v)
+        span = t - t0 + 30
+        for width in widths:
+            oracle = _oracle_buckets(samples, width, agg)
+            # query pinned to this level: window covers everything and
+            # step == width returns the level's buckets re-folded 1:1
+            q = store.query(
+                "f", {"daemon": "osd.0"}, window=span, step=width,
+                aggregate=agg, now=t,
+            )
+            got = {s: v for s, v in q["points"]}
+            assert q["resolution"] <= width
+            assert set(got) == set(oracle)
+            for start in oracle:
+                assert got[start] == pytest.approx(oracle[start]), (
+                    width, start,
+                )
+
+    def test_bucket_dump_carries_all_aggregates(self):
+        store = TimeSeriesStore(slots=8, resolutions=(10.0,))
+        for i, v in enumerate([3.0, 1.0, 7.0, 5.0]):
+            store.append("f", {}, 100.0 + i, v)
+        for agg, want in (
+            ("min", 1.0), ("max", 7.0), ("last", 5.0),
+            ("sum", 16.0), ("avg", 4.0),
+        ):
+            assert store.window_value("f", {}, 50, 0, aggregate=agg,
+                                      now=110.0) == pytest.approx(want)
+
+    def test_out_of_order_sample_folds_instead_of_corrupting(self):
+        store = TimeSeriesStore(slots=8, resolutions=(1.0,))
+        store.append("f", {}, 105.0, 1.0)
+        store.append("f", {}, 103.0, 9.0)  # clock-skewed report
+        q = store.query("f", {}, window=100, now=106.0)
+        starts = [s for s, _ in q["points"]]
+        assert starts == sorted(starts)
+        # ...and must not REWIND the newest-sample anchor: a
+        # default-anchored query (now=None) still sees the t=105 data
+        q = store.query("f", {}, window=2.0)
+        assert any(s == 105.0 for s, _ in q["points"]), q
+
+
+class TestCardinalityCap:
+    def test_lru_eviction_under_daemon_churn(self):
+        """Churned daemons (each restart a new label) must age out the
+        way iostat expires idle clients: the store holds max_series,
+        counts evictions, and keeps the most recently WRITTEN."""
+        store = TimeSeriesStore(max_series=8, slots=16)
+        for i in range(100):
+            store.append("op_rate", {"daemon": f"osd.{i}"}, 1000.0 + i, 1.0)
+        stats = store.stats()
+        assert stats["series"] == 8
+        assert stats["evictions"] == 92
+        survivors = {s["labels"]["daemon"] for s in store.series_ls()}
+        assert survivors == {f"osd.{i}" for i in range(92, 100)}
+
+    def test_hot_series_survives_churn(self):
+        """A continuously-written series must never be the LRU victim,
+        whatever churn happens around it."""
+        store = TimeSeriesStore(max_series=4, slots=16)
+        for i in range(50):
+            store.append("f", {"daemon": "osd.hot"}, 1000.0 + i, 1.0)
+            store.append("f", {"daemon": f"client.{i}"}, 1000.0 + i, 1.0)
+        names = {s["labels"]["daemon"] for s in store.series_ls()}
+        assert "osd.hot" in names
+        assert len(names) == 4
+
+    def test_configure_shrink_evicts_immediately(self):
+        store = TimeSeriesStore(max_series=16, slots=16)
+        for i in range(10):
+            store.append("f", {"daemon": f"osd.{i}"}, 1000.0 + i, 1.0)
+        store.configure(max_series=3)
+        assert store.stats()["series"] == 3
+        assert store.stats()["evictions"] == 7
+
+
+class TestBoundedMemory:
+    def test_long_run_stays_inside_the_structural_bound(self):
+        """100k appends into one series: retained buckets (and with
+        them the byte estimate) must stay at the ring-geometry bound —
+        levels x slots — however long the run."""
+        slots = 32
+        widths = (1.0, 10.0, 60.0)
+        store = TimeSeriesStore(max_series=4, slots=slots,
+                                resolutions=widths)
+        t = 0.0
+        for i in range(100_000):
+            t += 0.25
+            store.append("f", {"daemon": "osd.0"}, t, float(i % 97))
+        stats = store.stats()
+        bound = len(widths) * slots
+        assert stats["points"] <= bound
+        assert stats["bytes"] <= (
+            stats["series"] * BYTES_PER_SERIES + bound * BYTES_PER_BUCKET
+        )
+        assert stats["appends"] == 100_000
+        # the coarsest ring retains the longest history
+        q = store.query("f", {"daemon": "osd.0"}, window=60.0 * slots,
+                        now=t)
+        assert q["resolution"] == 60.0
+        assert len(q["points"]) <= slots
+        # the inventory reports retention from the COARSEST ring: the
+        # wrapped fine ring reaches back ~slots seconds, the 60 s ring
+        # much further — `perf history ls` must not understate it
+        row = next(s for s in store.series_ls()
+                   if s["labels"] == {"daemon": "osd.0"})
+        assert t - row["oldest_t"] > slots * 1.0
+
+    def test_many_series_bound_scales_linearly(self):
+        store = TimeSeriesStore(max_series=64, slots=8,
+                                resolutions=(1.0, 10.0))
+        for d in range(64):
+            for i in range(1000):
+                store.append("f", {"daemon": f"osd.{d}"},
+                             1000.0 + i, 1.0)
+        stats = store.stats()
+        assert stats["points"] <= 64 * 2 * 8
+
+
+class TestQuerySurface:
+    def test_step_rebucketing(self):
+        store = TimeSeriesStore(slots=64, resolutions=(1.0,))
+        for i in range(20):
+            store.append("f", {}, 100.0 + i, float(i))
+        q = store.query("f", {}, window=20, step=5.0, aggregate="max",
+                        now=119.0)
+        # 1 s buckets folded into 5 s output points: max of each span
+        got = {s: v for s, v in q["points"]}
+        assert got[100.0] == 4.0
+        assert got[115.0] == 19.0
+
+    def test_unknown_series_returns_empty(self):
+        store = TimeSeriesStore()
+        q = store.query("nope", {"daemon": "osd.9"})
+        assert q["points"] == []
+        assert q["resolution"] is None
+        assert store.window_value("nope", {}, 10, 0) is None
+
+    def test_bad_aggregate_rejected(self):
+        store = TimeSeriesStore()
+        with pytest.raises(ValueError):
+            store.query("f", {}, aggregate="p99")
+
+    def test_young_series_prefers_finest_resolution(self):
+        """A series younger than the window must answer at the finest
+        resolution (every level holds the same since-birth span), not
+        fall back to an artificially coarse view."""
+        store = TimeSeriesStore(slots=16, resolutions=(1.0, 60.0))
+        for i in range(4):
+            store.append("f", {}, 100.0 + i, float(i))
+        q = store.query("f", {}, window=3600.0, now=104.0)
+        assert q["resolution"] == 1.0
+        assert len(q["points"]) == 4
+
+    def test_geometry_change_restarts_history(self):
+        store = TimeSeriesStore(slots=8, resolutions=(1.0,))
+        store.append("f", {}, 100.0, 1.0)
+        store.configure(resolutions="2,20")
+        assert store.stats()["series"] == 0
+        assert store.resolutions == (2.0, 20.0)
+        store.append("f", {}, 100.0, 1.0)
+        assert store.query("f", {}, window=10, now=101.0)["resolution"] == 2.0
