@@ -116,6 +116,21 @@ FAULT_POINTS: dict[str, str] = {
         "identical host oracle (gf/bitslice.py), marking the backend "
         "DEGRADED"
     ),
+    "ec.recover_push": (
+        "EC recovery push receive in ECBackend.handle_recovery_push: "
+        "the target drops the PushOp on the floor, exactly as a dying "
+        "target would — the primary's stalled-push retry "
+        "(retry_stalled_pushes, osd_recovery_push_retry_sec) re-sends "
+        "the pending shards so a wedged push cannot stall a "
+        "recovery-storm wave forever"
+    ),
+    "peering.msg": (
+        "peering message receive in PG.handle_peering_message: the "
+        "query/notify/log message is dropped before the state machine "
+        "sees it, wedging peering mid-storm; the tick-driven re-kick "
+        "(PeeringState.tick restarts a primary stuck in GetInfo/GetLog) "
+        "re-queries and self-heals"
+    ),
 }
 
 

@@ -488,6 +488,61 @@ OPTIONS: dict[str, Option] = _opts(
            "max recovery push size; rounded to stripe (ECBackend.h:206)"),
     Option("osd_recovery_max_active", int, 3, A,
            "max concurrent recovery ops per OSD"),
+    Option("osd_recovery_push_retry_sec", float, 5.0, A,
+           "re-send pending recovery PushOps whose target has not "
+           "acked for this many seconds (ECBackend.retry_stalled_pushes, "
+           "tick-driven): a push a dying target dropped cannot park its "
+           "RecoveryOp in WRITING forever.  Re-applying a landed push is "
+           "idempotent.  <= 0 disables the retry (the pre-ISSUE-15 "
+           "behavior)", runtime=True),
+    # --- recovery-storm controller (ISSUE 15; osd/recovery_controller.py) ---
+    Option("osd_recovery_storm_min_objects", int, 8, A,
+           "outstanding missing objects across this OSD's primaried PGs "
+           "before the recovery-storm controller engages: below it the "
+           "per-PG osd_recovery_max_active trickle is the right tool; at "
+           "or above it the controller batches cross-PG reconstruction "
+           "into mesh-wide decode waves",
+           see_also=("osd_recovery_storm_wave_objects",), runtime=True),
+    Option("osd_recovery_storm_wave_objects", int, 16, A,
+           "max objects admitted per recovery-storm wave (the adaptive "
+           "wave size's ceiling): one wave's decodes coalesce through "
+           "the DecodeAggregator into few padded launches on the "
+           "recovery QoS lane.  Admission adapts between "
+           "osd_recovery_storm_min_wave_objects and this ceiling on the "
+           "live client burn rate", runtime=True,
+           see_also=("osd_recovery_storm_min_wave_objects",
+                     "osd_recovery_storm_burn_threshold")),
+    Option("osd_recovery_storm_min_wave_objects", int, 2, A,
+           "adaptive wave-size floor under SLO shedding: even a pool "
+           "burning its latency budget keeps rebuilding at this trickle "
+           "(availability beats a perfectly idle rebuild)",
+           see_also=("osd_recovery_storm_wave_objects",), runtime=True),
+    Option("osd_recovery_storm_max_inflight", int, 32, A,
+           "bounded wave depth: objects mid-recovery across ALL "
+           "primaried PGs before the controller stops admitting new "
+           "waves (the cross-PG analog of osd_recovery_max_active)",
+           runtime=True),
+    Option("osd_recovery_storm_slo_target_ms", float, 0.0, A,
+           "client-op latency target (ms) the storm admission loop "
+           "evaluates the LOCAL burn rate against, from this OSD's own "
+           "io-accounting histograms (the iostat/SLO layer's per-OSD "
+           "input): ops slower than this eat the error budget.  0 "
+           "disables admission feedback — waves always ramp to the "
+           "ceiling", see_also=("osd_recovery_storm_burn_threshold",
+                                "mgr_slo_latency_target_ms"),
+           runtime=True),
+    Option("osd_recovery_storm_slo_objective", float, 0.99, A,
+           "fraction of client ops that must land under the storm SLO "
+           "target; the error budget is 1 - objective and burn rate = "
+           "observed bad fraction / error budget (the "
+           "mgr_slo_objective shape, evaluated OSD-locally per tick)",
+           see_also=("osd_recovery_storm_slo_target_ms",), runtime=True),
+    Option("osd_recovery_storm_burn_threshold", float, 1.0, A,
+           "local burn rate above which the storm SHEDS (halves the "
+           "wave toward the floor) and at/below which it RAMPS (doubles "
+           "toward the ceiling) — the SLO_LATENCY_BREACH-risk feedback "
+           "that keeps a whole-OSD rebuild from eating client p99",
+           see_also=("osd_recovery_storm_slo_target_ms",), runtime=True),
     Option("osd_max_backfills", int, 1, A, "max concurrent backfills",
            runtime=True),
     Option("osd_min_pg_log_entries", int, 250, A,
@@ -537,6 +592,27 @@ OPTIONS: dict[str, Option] = _opts(
     Option("mon_osd_reporter_subtree_level", str, "host", A, ""),
     Option("mon_osd_down_out_interval", float, 30.0, A,
            "seconds down before an osd is marked out"),
+    # --- mon flap dampening (ISSUE 15; mon/osd_monitor.py) ------------------
+    Option("mon_osd_flap_window", float, 300.0, A,
+           "seconds a markdown stays in an OSD's recent-flap history: "
+           "the down->out grace for an OSD with N markdowns inside the "
+           "window is mon_osd_down_out_interval * "
+           "mon_osd_flap_backoff^(N-1), so a flapping OSD earns an "
+           "exponentially longer grace instead of re-triggering "
+           "peering storms on every bounce.  <= 0 disables dampening "
+           "(every markdown uses the base interval)",
+           see_also=("mon_osd_flap_backoff",
+                     "mon_osd_down_out_interval"), runtime=True),
+    Option("mon_osd_flap_backoff", float, 2.0, A,
+           "grace multiplier per recent markdown beyond the first "
+           "(exponent capped at 8); 1.0 disables the growth",
+           see_also=("mon_osd_flap_window",), runtime=True),
+    Option("mon_osd_flap_max_auto_out_per_tick", int, 4, A,
+           "auto-out churn cap: at most this many OSDs are marked out "
+           "per down-out sweep tick — a rack-wide blip cannot remap "
+           "the whole map in one epoch; the remainder keep their "
+           "down-clock and go out on later ticks.  <= 0 removes the "
+           "cap", see_also=("mon_osd_down_out_interval",), runtime=True),
     # --- messenger (global.yaml.in:1240-1271 fault injection) ---------------
     Option("ms_type", str, "async+posix", A,
            "messenger stack: async+posix (TCP) or async+inproc "

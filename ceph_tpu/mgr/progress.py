@@ -213,6 +213,11 @@ class ProgressModule(MgrModule):
             stall_sec = float(OPTIONS["mgr_progress_stall_sec"].default)
         self.stall_sec = float(stall_sec)
         self.events: dict[tuple[str, str], _Event] = {}
+        # whole-OSD rebuild bars (ISSUE 15): one _Event per victim set,
+        # aggregated each tick from the daemons' recovery_storm status
+        # slices (every surviving primary contributes its share of the
+        # failed OSD's rebuild; the sum is the whole-OSD bar)
+        self.storms: dict[str, _Event] = {}
         self.completed = 0  # events that ran to completion (gauge)
         self.expired = 0    # events dropped mid-flight (reporter died)
         self.config_errors = 0  # skipped config reads (visible, not silent)
@@ -245,6 +250,9 @@ class ProgressModule(MgrModule):
         # clear) — the same liveness rule the slow-ops/tpu-degraded
         # digest slices apply (Mgr._daemon_report_live)
         live = getattr(self.mgr, "_daemon_report_live", None)
+        # per-victim whole-OSD rebuild accumulators (ISSUE 15): summed
+        # across daemons this tick, then observed as one event each
+        storm_sums: dict[str, dict] = {}
         for daemon in self.mgr.list_daemons():
             if live is not None and not live(daemon):
                 continue
@@ -258,6 +266,36 @@ class ProgressModule(MgrModule):
                     if tracked is None:
                         tracked = self.events[key] = _Event(pgid, kind, now)
                     tracked.observe(ev, now)
+            storm = status.get("recovery_storm") or {}
+            if storm.get("objects_total"):
+                victims = storm.get("victims") or []
+                skey = "+".join(victims) if victims else "cluster"
+                agg = storm_sums.setdefault(
+                    skey, {"objects_done": 0, "objects_total": 0}
+                )
+                agg["objects_done"] += int(storm.get("objects_done", 0))
+                agg["objects_total"] += int(storm.get("objects_total", 0))
+        storm_seen: set[str] = set()
+        for skey, agg in storm_sums.items():
+            storm_seen.add(skey)
+            tracked = self.storms.get(skey)
+            if tracked is None:
+                tracked = self.storms[skey] = _Event(skey, "storm", now)
+            tracked.observe(agg, now)
+        for skey, ev in list(self.storms.items()):
+            if (
+                skey not in storm_seen
+                and now - ev.last_seen > self.EVENT_EXPIRE_SEC
+            ):
+                del self.storms[skey]
+                # same completion rule as recovery events below: the
+                # controller re-emits a final done==total bar, so a
+                # storm that vanished below total lost its reporter
+                # mid-rebuild — that is `expired`, not success
+                if ev.total and ev.done >= ev.total:
+                    self.completed += 1
+                else:
+                    self.expired += 1
         for key, ev in list(self.events.items()):
             if key not in seen and now - ev.last_seen > self.EVENT_EXPIRE_SEC:
                 del self.events[key]
@@ -328,6 +366,13 @@ class ProgressModule(MgrModule):
                 "fraction": round(done / total, 4) if total else 1.0,
             },
             "stalled": self.stalled_slice(now),
+            # whole-OSD rebuild bars (ISSUE 15): kept out of the
+            # cluster aggregate above — the same objects already count
+            # through their per-PG recovery events
+            "storms": [
+                ev.render(now, self.stall_sec)
+                for ev in sorted(self.storms.values(), key=_Event.key)
+            ],
         }
 
     def prometheus_metrics(self) -> list[tuple[str, str, str, list[str]]]:
@@ -337,10 +382,14 @@ class ProgressModule(MgrModule):
         frac: list[str] = []
         rate: list[str] = []
         eta: list[str] = []
-        for ev in sorted(self.events.values(), key=_Event.key):
+        for ev in sorted(
+            list(self.events.values()) + list(self.storms.values()),
+            key=_Event.key,
+        ):
             # built from render()'s already-gated fields so the scrape
             # can never desynchronize from the `status` bars (stalled
-            # events show rate 0 / no ETA on BOTH surfaces)
+            # events show rate 0 / no ETA on BOTH surfaces); the storm
+            # bars ride the same families labeled kind="storm"
             r = ev.render(now, self.stall_sec)
             labels = f'pgid="{ev.pgid}",kind="{ev.kind}"'
             frac.append(

@@ -40,6 +40,10 @@ def _perf_type(counter: str) -> str:
         # depth, the current in-flight count, and the cache's resident
         # footprint all rise AND fall
         or name in ("depth", "inflight", "resident_bytes", "entries")
+        # recovery-storm levels (ISSUE 15): the adaptive wave size, the
+        # engagement flag and the local burn rate are levels; the
+        # wave/shed/ramp/storm totals stay counters
+        or name in ("wave_objects", "engaged", "burn_rate")
     ):
         return "gauge"
     return "counter"

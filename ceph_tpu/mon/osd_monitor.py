@@ -52,6 +52,13 @@ class OSDMonitor:
         self.report_expiry = 20.0  # seconds a failure report stays valid
         # down-and-in OSDs awaiting auto-out (mon_osd_down_out_interval)
         self._down_since: dict[int, float] = {}
+        # flap dampening (ISSUE 15): per-OSD recent markdown stamps
+        # (pruned to mon_osd_flap_window); the down->out grace grows
+        # mon_osd_flap_backoff^(markdowns-1) so a flapping OSD stops
+        # re-triggering full peering storms on every bounce
+        self._recent_markdowns: dict[int, list[float]] = {}
+        self.auto_outs_total = 0  # lifetime auto-out count (the sweep's)
+        self.dampened_holds = 0   # sweep passes where dampening held fire
         # OSDs the sweep auto-outed: marked back IN on reboot (the
         # reference's mon_osd_auto_mark_auto_out_in), unlike an
         # operator's explicit `osd out` which sticks
@@ -224,12 +231,73 @@ class OSDMonitor:
             )
             return
         self.failure_reports.pop(target, None)
+        self._note_markdown(target, now)
 
         def mutate(m: OSDMap) -> str:
             m.set_osd_state(target, False)
             return f"osd.{target} marked down"
 
         self._queue(mutate, None)
+
+    # -- flap dampening (ISSUE 15) --------------------------------------------
+
+    def _note_markdown(self, osd: int, now: float) -> None:
+        """Record one markdown event in the OSD's recent-flap history
+        (pruned to the window on read)."""
+        self._recent_markdowns.setdefault(osd, []).append(now)
+
+    def _recent_markdown_count(self, osd: int, now: float) -> int:
+        window = float(self.mon.conf.get("mon_osd_flap_window"))
+        stamps = self._recent_markdowns.get(osd)
+        if not stamps:
+            return 0
+        if window <= 0:
+            # dampening off: report 0 but KEEP the (bounded) history so
+            # a runtime re-enable resumes from live data instead of
+            # forgiving an active flapper
+            if len(stamps) > 16:
+                self._recent_markdowns[osd] = stamps[-16:]
+            return 0
+        live = [t for t in stamps if now - t <= window]
+        if live:
+            self._recent_markdowns[osd] = live
+        else:
+            self._recent_markdowns.pop(osd, None)
+        return len(live)
+
+    def _down_out_grace(self, osd: int, now: float) -> float:
+        """Effective down->out grace for `osd`: the base interval scaled
+        by backoff^(recent markdowns - 1), exponent capped at 8.  A
+        first-time failure uses the base interval unchanged; every
+        additional markdown inside the flap window doubles (by default)
+        the time the mon waits before remapping the OSD's data."""
+        base = float(self.mon.conf.get("mon_osd_down_out_interval"))
+        if base <= 0:
+            return base
+        n = self._recent_markdown_count(osd, now)
+        if n <= 1:
+            return base
+        backoff = max(1.0, float(self.mon.conf.get("mon_osd_flap_backoff")))
+        return base * backoff ** min(n - 1, 8)
+
+    def flap_stats(self) -> dict:
+        """Dampening introspection (chaos/tests and the asok surface):
+        lifetime auto-out count plus each tracked OSD's recent markdown
+        count and current effective grace."""
+        now = time.monotonic()
+        per_osd = {}
+        for osd in sorted(self._recent_markdowns):
+            n = self._recent_markdown_count(osd, now)
+            if n:
+                per_osd[osd] = {
+                    "markdowns": n,
+                    "grace_sec": round(self._down_out_grace(osd, now), 3),
+                }
+        return {
+            "auto_outs_total": self.auto_outs_total,
+            "dampened_holds": self.dampened_holds,
+            "osds": per_osd,
+        }
 
     # -- commands --------------------------------------------------------------
 
@@ -684,26 +752,48 @@ class OSDMonitor:
         interval is marked OUT so CRUSH remaps its data and recovery
         starts — without it a dead OSD's PGs stay degraded forever
         unless an operator runs `osd out` by hand.  <= 0 disables the
-        sweep.  (The option existed since PR 1 but was never read — the
-        ISSUE 12 config-coherence pass caught the drift.)"""
+        sweep.
+
+        ISSUE 15 hardening: the per-OSD grace is flap-dampened (a
+        repeatedly-bouncing OSD earns backoff^(markdowns-1) times the
+        base interval before its data is remapped — a genuinely dead
+        OSD, with one markdown, still goes out at the base interval),
+        and at most mon_osd_flap_max_auto_out_per_tick OSDs are outed
+        per sweep — a rack-wide blip cannot rewrite the whole map in
+        one epoch.  OSDs over budget keep their down-clock and go out
+        on later ticks."""
         interval = float(self.mon.conf.get("mon_osd_down_out_interval"))
+        budget = int(self.mon.conf.get("mon_osd_flap_max_auto_out_per_tick"))
+        outed = 0
         now = time.monotonic()
         for oid, info in list(self.osdmap.osds.items()):
             if info.up or not info.in_:
                 self._down_since.pop(oid, None)
                 continue
             t0 = self._down_since.setdefault(oid, now)
-            if interval <= 0 or now - t0 < interval:
+            if interval <= 0:
                 continue
+            grace = self._down_out_grace(oid, now)
+            if now - t0 < grace:
+                if now - t0 >= interval:
+                    # past the base interval but inside the dampened
+                    # grace: the hold is the dampening WORKING, counted
+                    # so chaos/tests can witness it
+                    self.dampened_holds += 1
+                continue
+            if budget > 0 and outed >= budget:
+                continue  # churn cap: keep the clock, out it next tick
             self._down_since.pop(oid, None)
+            outed += 1
+            self.auto_outs_total += 1
 
-            def mutate(m: OSDMap, oid=oid) -> str:
+            def mutate(m: OSDMap, oid=oid, grace=grace) -> str:
                 m.set_osd_weight(oid, 0)
                 self._auto_outed.add(oid)
-                return f"osd.{oid} marked out after {interval:.0f}s down"
+                return f"osd.{oid} marked out after {grace:.0f}s down"
 
             dout("mon", 1, f"osd.{oid} down {now - t0:.0f}s >= "
-                           f"{interval:.0f}s: marking out")
+                           f"{grace:.0f}s (dampened grace): marking out")
             self._queue(mutate, None)
 
     def _cmd_out(self, cmd, reply) -> None:

@@ -169,6 +169,11 @@ class RecoveryOp:
     pending_decode: object | None = None
     decode_polls: int = 0
     decode_t0: float = 0.0  # launch time; reap samples ec_decode_latency
+    # when the WRITING-stage pushes last fanned out: the stalled-push
+    # retry (ISSUE 15) re-sends pending shards past the grace, so a
+    # dropped/wedged PushOp cannot park the op in WRITING forever
+    push_ts: float = 0.0
+    push_retries: int = 0
     trace: object = field(default_factory=lambda: null_span())  # ec:recover
 
 
@@ -249,6 +254,9 @@ class ECBackend(PGBackend):
         # decodes share an aggregated launch.
         self._decode_pipe: list[RecoveryOp] = []
         self.decode_depth = 8
+        # lifetime stalled-push retries (ISSUE 15): the witness chaos
+        # reads after wedging pushes with the ec.recover_push seam
+        self.push_retries = 0
 
     # -- helpers -------------------------------------------------------------
 
@@ -336,14 +344,46 @@ class ECBackend(PGBackend):
         return oi.version if oi is not None else None
 
     def _available_shards(self, oid: str) -> set[int]:
-        """Shards that are up and not missing the object."""
+        """Shards with a live data source for `oid`: the acting member
+        when up and not missing it, else a stray holder the listener's
+        `shard_data_source` redirection names (ISSUE 15) — a CRUSH
+        reshuffle moves a survivor's chunks to the wrong slot, but its
+        old coll still serves reconstruction reads."""
+        src = getattr(self.listener, "shard_data_source", None)
         acting = self.listener.acting()
         missing = self.listener.get_shard_missing(oid)
-        return {
-            s
-            for s, osd in enumerate(acting)
-            if s < self.n and osd != PG_NONE and s not in missing
-        }
+        out: set[int] = set()
+        for s in range(min(self.n, len(acting))):
+            if acting[s] != PG_NONE and s not in missing:
+                out.add(s)
+            elif src is not None and src(s, oid) != PG_NONE:
+                out.add(s)
+        return out
+
+    def _shard_source(self, s: int, oids) -> int:
+        """The osd a shard-`s` sub-read goes to: the listener's
+        stray-aware redirection when available, else the acting member
+        (the pre-ISSUE-15 rule).  One ReadOp sends ONE sub-read per
+        shard, so a mixed multi-object request whose oids resolve to
+        DIFFERENT sources falls back to the acting member — the
+        per-object failure then rides the normal redundant-read
+        escalation.  (In practice every caller batches one object per
+        ReadOp, so the sources agree.)"""
+        acting = self.listener.acting()
+        osd = acting[s] if s < len(acting) else PG_NONE
+        src = getattr(self.listener, "shard_data_source", None)
+        if src is None:
+            return osd
+        chosen = PG_NONE
+        for oid in oids:
+            alt = src(s, oid)
+            if alt == PG_NONE:
+                continue
+            if chosen == PG_NONE:
+                chosen = alt
+            elif alt != chosen:
+                return osd  # sources disagree: keep the acting member
+        return chosen if chosen != PG_NONE else osd
 
     def _logical_range_to_chunk_extent(self, off: int, length: int) -> tuple[int, int]:
         """Stripe-aligned logical (off, len) -> per-shard chunk (off, len)."""
@@ -872,14 +912,14 @@ class ECBackend(PGBackend):
         self._send_reads(rop, sources)
 
     def _send_reads(self, rop: ReadOp, shards: set[int]) -> None:
-        acting = self.listener.acting()
         sub_count = self.ec.get_sub_chunk_count()
         # Register every source before sending: the self-send replies
         # synchronously and must see the complete source set, or the
         # completion check runs against a partial plan.
         sends: list[tuple[int, MOSDECSubOpRead]] = []
+        oids = list(rop.requests)
         for s in shards:
-            osd = acting[s]
+            osd = self._shard_source(s, oids)
             rop.sources[s] = osd
             rop.tried.add(s)
             to_read: dict[str, list[list[int]]] = {}
@@ -1488,8 +1528,62 @@ class ECBackend(PGBackend):
         if not sends:
             self._finish_recovery(rec)
             return
+        rec.push_ts = time.monotonic()
         for osd, msg in sends:
             self.listener.send_shard(osd, msg)
+
+    def retry_stalled_pushes(self, grace: float) -> int:
+        """Re-send pending PushOps older than `grace` seconds (ISSUE 15
+        recovery-path hardening; tick-driven from the PG).  A push the
+        target dropped — a dying daemon, the `ec.recover_push` chaos
+        seam — would otherwise park its RecoveryOp in WRITING forever.
+        Re-applying a push the target DID land is idempotent (same
+        rebuilt bytes, same attrs), and a late first reply just empties
+        pending_pushes before the duplicate's reply is ignored.
+        Returns the number of ops retried."""
+        if grace <= 0:
+            return 0
+        now = time.monotonic()
+        retried = 0
+        acting = self.listener.acting()
+        for rec in list(self.recovery_ops.values()):
+            if (
+                rec.state != RECOVERY_WRITING
+                or not rec.pending_pushes
+                or not rec.push_ts
+                or now - rec.push_ts < grace
+            ):
+                continue
+            version = 0
+            if OI_ATTR in rec.attrs:
+                version = ObjectInfo.decode(rec.attrs[OI_ATTR]).version
+            rec.push_ts = now
+            rec.push_retries += 1
+            self.push_retries += 1
+            retried += 1
+            rec.trace.event(
+                lambda rec=rec: "retrying stalled pushes to shards "
+                f"{sorted(rec.pending_pushes)}"
+            )
+            for s in sorted(rec.pending_pushes):
+                osd = acting[s] if s < len(acting) else PG_NONE
+                if osd == PG_NONE:
+                    continue
+                self.listener.send_shard(
+                    osd,
+                    MOSDPGPush(
+                        pgid=self.listener.pgid.with_shard(s),
+                        pushes=[PushOp(
+                            oid=rec.oid,
+                            data=rec.shard_data[s],
+                            attrs=dict(rec.attrs),
+                            version=version,
+                        )],
+                        epoch=self.listener.epoch(),
+                        from_osd=self.listener.whoami(),
+                    ),
+                )
+        return retried
 
     def _full_shard_len(self, rec: RecoveryOp) -> int:
         """True (unfragmented) shard length for CLAY repair decode."""
@@ -1501,6 +1595,21 @@ class ECBackend(PGBackend):
 
     def handle_recovery_push(self, msg: MOSDPGPush) -> None:
         """Target shard writes the pushed chunk (§3.2 WRITING)."""
+        # recovery-push wedge seam (ec.recover_push): the push is
+        # dropped on the floor — no apply, no reply — exactly as a
+        # target dying mid-delivery would drop it.  The primary's
+        # stalled-push retry (retry_stalled_pushes) re-sends past the
+        # osd_recovery_push_retry_sec grace, so chaos can wedge pushes
+        # mid-storm and watch recovery self-heal.
+        from ..common.fault_injector import InjectedFailure, faultpoint
+        from ..common.log import dout
+
+        try:
+            faultpoint("ec.recover_push")
+        except InjectedFailure as e:
+            dout("ec", 1, f"{self.listener.pgid}: dropping injected-fault "
+                          f"recovery push for {msg.pgid} ({e})")
+            return
         coll = shard_coll(self.listener.pgid, msg.pgid.shard)
         oids = self._apply_pushes(coll, msg.pushes)
         reply = MOSDPGPushReply(

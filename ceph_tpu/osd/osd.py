@@ -444,6 +444,16 @@ class OSD(Dispatcher):
             ["ec_tpu_shard_devices"],
             lambda _n, v: shard_dispatch.configure(devices=int(v)),
         )
+        # recovery-storm controller (ISSUE 15): the cross-PG wave
+        # orchestrator — engages when a whole-OSD failure floods the
+        # missing sets, batches reconstruction decodes into mesh-wide
+        # waves, and adapts admission to the local client burn rate.
+        # Constructed after the reservers/aggregators/accountant it
+        # coordinates; its knobs are re-read per tick (plus a ceiling
+        # observer), so runtime config sets land immediately.
+        from .recovery_controller import RecoveryStormController
+
+        self.recovery_storm = RecoveryStormController(self)
         self.admin_socket = None
         # periodic-scrub schedule: pgid -> last periodic scrub kickoff
         self._last_periodic_scrub: dict = {}
@@ -451,6 +461,7 @@ class OSD(Dispatcher):
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
         self._reported_failed: set[int] = set()
+        self._last_failure_report: dict[int, float] = {}
         # ordered cluster sends: addr -> queue + drain task
         self._out_q: dict[str, asyncio.Queue] = {}
         self._out_tasks: dict[str, asyncio.Task] = {}
@@ -684,6 +695,16 @@ class OSD(Dispatcher):
             "debug mode (args: reset_peaks)",
         )
         sock.register(
+            "dump_recovery_storm",
+            lambda cmd: {
+                "status": self.recovery_storm.status(),
+                "perf": self.recovery_storm.perf_dump(),
+            },
+            "recovery-storm controller state: whole-OSD rebuild bar, "
+            "wave/shed/ramp counters, live wave size + burn rate "
+            "(ISSUE 15)",
+        )
+        sock.register(
             "dump_historic_ops",
             lambda cmd: self.op_tracker.dump_historic(),
             "recently completed ops with events + per-stage durations "
@@ -746,9 +767,13 @@ class OSD(Dispatcher):
     def _on_osdmap_msg(self, msg: MOSDMap) -> None:
         """OSD::handle_osd_map: apply full maps / incrementals in epoch
         order, then advance the PGs."""
+        old_map = self.osdmap
         self.osdmap = advance_map(self.osdmap, msg)
         info = self.osdmap.osds.get(self.whoami)
         self.up = bool(info and info.up and info.addr == self.msgr.addr)
+        # storm victim detection: an OSD leaving up+in across this
+        # advance names the whole-OSD rebuild the controller conducts
+        self.recovery_storm.note_osdmap(old_map, self.osdmap)
         self._advance_pgs()
 
     def _advance_pgs(self) -> None:
@@ -844,6 +869,12 @@ class OSD(Dispatcher):
         # the rest monotonic counters — mgr/prometheus._perf_type)
         for name, val in self.tracer.sampling_stats().items():
             perf[f"trace.{name}"] = val
+        # recovery-storm controller counters/gauges (ISSUE 15): the
+        # ceph_tpu_recovery_storm_* scrape families — wave/shed/ramp
+        # totals plus the live wave size, in-flight depth, engagement
+        # flag and local burn rate
+        for name, val in self.recovery_storm.perf_dump().items():
+            perf[f"recovery_storm.{name}"] = val
         # launch counters incl. sharded launches / devices-per-launch
         # (ops/dispatch.py): flat scalars, so the mgr prometheus scrape
         # exports one ceph_tpu_ec_dispatch_* family per counter
@@ -1233,6 +1264,15 @@ class OSD(Dispatcher):
                 continue
             for pg in list(self.pgs.values()):
                 pg.tick()
+            # cross-PG recovery-storm waves ride the same cadence as the
+            # per-PG ticks they coordinate (ISSUE 15); a faulting tick
+            # must not kill the heartbeat task — pings, failure reports
+            # and mgr beacons all ride this loop
+            try:
+                self.recovery_storm.tick()
+            except Exception as e:
+                dout("osd", 0,
+                     f"osd.{self.whoami}: recovery-storm tick raised {e!r}")
             self._maybe_periodic_scrub()
             self._send_mgr_report()
             if self.conf.get("heartbeat_inject_failure") > 0:
@@ -1289,11 +1329,25 @@ class OSD(Dispatcher):
                 if peer not in self._reported_failed:
                     self._reported_failed.add(peer)
                     self.perf.inc("heartbeat_failures")
+                # re-report at most once per grace period while the peer
+                # stays failed (ISSUE 15): reports expire mon-side and a
+                # send can die with its connection, so a one-shot report
+                # could silently never form a markdown quorum — a dead
+                # OSD would stay 'up' forever.  The grace cadence keeps
+                # transient event-loop stalls from double-reporting a
+                # healthy peer every heartbeat.
+                last = self._last_failure_report.get(peer, 0.0)
+                if now - last >= grace:
+                    self._last_failure_report[peer] = now
                     self._report_failure(peer, failed_for)
             else:
                 self._reported_failed.discard(peer)
+                self._last_failure_report.pop(peer, None)
 
     def _report_failure(self, peer: int, failed_for: float) -> None:
+        """Report a dead peer to every mon (re-sent on the grace cadence
+        by _heartbeat_check while the failure persists; the mon dedupes
+        repeats per reporter)."""
         info = self.osdmap.osds.get(peer)
         fail = MOSDFailure(
             target=peer,
@@ -1306,7 +1360,9 @@ class OSD(Dispatcher):
                 try:
                     await self.monc.msgr.send_to(addr, fail)
                 except ConnectionError:
-                    pass
+                    dout("osd", 2,
+                         f"osd.{self.whoami}: failure report for "
+                         f"osd.{peer} lost (mon connection)")
 
             asyncio.get_event_loop().create_task(_send())
 
@@ -1480,6 +1536,10 @@ def _osd_status(osd: "OSD") -> dict:
         # aggregated by the mgr into the digest slice the mon's
         # OSD_SCRUB_ERRORS / PG_DAMAGED HEALTH_ERR checks read
         "scrub_errors": scrub_errors,
+        # whole-OSD rebuild progress (ISSUE 15): the storm controller's
+        # bar — the mgr progress module aggregates these across daemons
+        # into per-victim rebuild bars with rate + ETA
+        "recovery_storm": osd.recovery_storm.status(),
     }
 
 

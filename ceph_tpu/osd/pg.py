@@ -160,6 +160,16 @@ class PG(PGListener):
         self.scrubber = PgScrubber(self)
         self.recovering: set[str] = set()
         self.waiting_for_degraded: dict[str, list[Callable[[], None]]] = {}
+        # stray shard sources (ISSUE 15): EC shard identity is
+        # POSITIONAL (acting index -> shard coll), and CRUSH slot-fill
+        # after an out can reshuffle survivors' slots.  `_shard_holders`
+        # remembers, per slot, who held its data at the last CLEAN tick
+        # — the stray whose old coll still has valid chunks while the
+        # new member rebuilds; `_moved_members` records, per interval,
+        # members whose slot changed (their local chunks sit under the
+        # wrong coll, so activation marks their objects missing).
+        self._shard_holders: dict[int, int] = {}
+        self._moved_members: dict[int, int] = {}  # osd -> old shard
         # backfill driver state (PeeringState Backfilling/WaitRemote states)
         self._bf_granted: set[int] = set()  # targets that granted a slot
         self._bf_inflight: set[str] = set()  # oids being pushed this chunk
@@ -220,10 +230,31 @@ class PG(PGListener):
         self._epoch = epoch
         if not interval_changed:
             return
+        # positional shard moves (ISSUE 15): a surviving member placed
+        # at a DIFFERENT slot holds its chunks under the old shard coll
+        # — wrong bytes for the new slot.  Remember the moves; every
+        # activation of this interval marks those members' objects
+        # missing (rebuild at the new slot), while _shard_holders keeps
+        # redirecting reconstruction reads at the old slot-holder's
+        # still-valid stray chunks.
+        self._moved_members = {}
+        if self.pool.type == POOL_TYPE_ERASURE and self._acting:
+            for s, osd in enumerate(acting):
+                if osd == PG_NONE or osd not in self._acting:
+                    continue
+                old = self._acting.index(osd)
+                if old != s:
+                    self._moved_members[osd] = old
         self._acting = list(acting)
         self._ensure_local_coll()
         self.scrubber.reset()  # an interval change aborts in-flight scrubs
         self._reset_backfill()  # reservations do not survive an interval
+        # in-flight recoveries die with the interval (the reference's
+        # on_change cancels them): a push sent to a member that went
+        # down mid-interval would otherwise pin its oid in `recovering`
+        # forever — re-peering recomputes the missing sets and the next
+        # tick re-admits whatever still needs rebuilding (ISSUE 15)
+        self.recovering.clear()
         # recovery-progress episode dies with the interval: a demoted
         # primary's progress_status goes silent BEFORE its reset branch
         # can run, and stale done counts would otherwise pre-fill the
@@ -239,9 +270,26 @@ class PG(PGListener):
         abort scrubs whose shard died."""
         self.peering.tick()
         self.scrubber.tick(time.monotonic())
+        if (
+            self.pool.type == POOL_TYPE_ERASURE
+            and self.peering.is_active()
+            and self.is_clean
+        ):
+            # last-clean shard-holder snapshot (ISSUE 15): while the PG
+            # is clean every slot's data is exactly where acting says;
+            # this map is what stray-shard redirection falls back to
+            # after the next reshuffle
+            self._shard_holders = {
+                s: o for s, o in enumerate(self._acting) if o != PG_NONE
+            }
         if self.peering.is_active():
             self._kick_recovery()
             self._kick_backfill()
+            # stalled-push retry (ISSUE 15): a recovery push the target
+            # dropped must not park its op in WRITING forever
+            retry = getattr(self.backend, "retry_stalled_pushes", None)
+            if retry is not None and self.peering.is_primary():
+                retry(float(self.osd.conf.get("osd_recovery_push_retry_sec")))
 
     def _ensure_local_coll(self) -> None:
         coll = shard_coll(self.pgid, self.whoami_shard())
@@ -308,7 +356,88 @@ class PG(PGListener):
     def _on_active(self) -> None:
         self._version = max(self._version, self.pg_log.head.version)
         self._rebuild_dup_window()
+        self._apply_shard_moves()
+        # kick the storm controller AT the flood (ISSUE 15): activation
+        # is the moment a whole-OSD failure's missing sets appear, and
+        # waiting for the next heartbeat tick would let the per-PG
+        # trickle race the first wave
+        storm = getattr(self.osd, "recovery_storm", None)
+        if storm is not None:
+            storm.tick()
         self._kick_recovery()
+
+    def _apply_shard_moves(self) -> None:
+        """Primary activation hook (ISSUE 15): members whose shard slot
+        moved this interval have every pre-interval object's chunk under
+        the WRONG coll — mark those objects missing (for self and for
+        peers) so recovery rebuilds them at the new slot.  The census is
+        the primary's own shard coll (its OLD one if it moved itself):
+        a full member's coll lists every object in the PG."""
+        if not self._moved_members or self.pool.type != POOL_TYPE_ERASURE:
+            return
+        census_shard = self._moved_members.get(
+            self.osd.whoami, self.whoami_shard()
+        )
+        if census_shard < 0:
+            return
+        coll = shard_coll(self.pgid, census_shard)
+        try:
+            oids = self.osd.store.list_objects(coll)
+        except Exception as e:
+            dout("osd", 2, f"pg {self.pgid}: shard-move census of {coll} "
+                           f"unavailable ({e!r})")
+            oids = []
+        if not oids:
+            # a primary with an empty coll (fresh member pulled into the
+            # set) still knows the object population from the merged
+            # authoritative log — walk it in order so deletes cancel
+            live: set[str] = set()
+            for e in self.pg_log.entries:
+                if e.is_delete():
+                    live.discard(e.oid)
+                else:
+                    live.add(e.oid)
+            oids = sorted(live)
+        if not oids:
+            return
+        v = self.pg_log.head
+        for osd, old_shard in self._moved_members.items():
+            dout(
+                "osd", 1,
+                f"pg {self.pgid}: osd.{osd} moved shard {old_shard} -> "
+                f"{self._acting.index(osd)}; marking {len(oids)} objects "
+                "for rebuild at the new slot",
+            )
+            if osd == self.osd.whoami:
+                for oid in oids:
+                    self.peering.missing.add(oid, v)
+            else:
+                m = self.peering.peer_missing.setdefault(osd, Missing())
+                for oid in oids:
+                    m.add(oid, v)
+
+    def shard_data_source(self, shard: int, oid: str) -> int:
+        """Stray-shard read sourcing (ISSUE 15; overrides the PGListener
+        default): the acting member serves when placed and not missing
+        the object; otherwise the slot's last-clean HOLDER — whose old
+        coll still has valid chunks, because writes to missing objects
+        are degraded-blocked until recovery lands — serves the
+        reconstruction read."""
+        if self.pool.type != POOL_TYPE_ERASURE:
+            return super().shard_data_source(shard, oid)
+        acting_osd = (
+            self._acting[shard] if shard < len(self._acting) else PG_NONE
+        )
+        if acting_osd != PG_NONE and shard not in self.get_shard_missing(oid):
+            return acting_osd
+        holder = self._shard_holders.get(shard, PG_NONE)
+        if (
+            holder != PG_NONE
+            and holder != acting_osd
+            and self.osd.osdmap.is_up(holder)
+        ):
+            return holder
+        return PG_NONE
 
     def _rebuild_dup_window(self) -> None:
         """Replay reqid dup detection from the PG log on activation.
@@ -333,6 +462,18 @@ class PG(PGListener):
             )
 
     def handle_peering_message(self, msg) -> bool:
+        # peering wedge seam (peering.msg): the message is dropped
+        # before the state machine sees it — a lost query/notify/log
+        # mid-storm.  Self-heal is tick-driven: PeeringState.tick
+        # restarts a primary stuck in GetInfo/GetLog, which re-queries.
+        from ..common.fault_injector import InjectedFailure, faultpoint
+
+        try:
+            faultpoint("peering.msg")
+        except InjectedFailure as e:
+            dout("osd", 1, f"pg {self.pgid}: dropping injected-fault "
+                           f"peering message {type(msg).__name__} ({e})")
+            return True
         if isinstance(msg, MOSDPGQuery):
             self._ensure_local_coll()
             self.peering.handle_query(msg)
@@ -448,6 +589,12 @@ class PG(PGListener):
         self.recovering.discard(oid)
         for cb in self.waiting_for_degraded.pop(oid, []):
             cb()
+        # completion-driven waves (ISSUE 15): while a storm is engaged,
+        # each landed recovery frees in-flight budget — admit the next
+        # wave NOW instead of waiting out the heartbeat tick
+        storm = getattr(self.osd, "recovery_storm", None)
+        if storm is not None and storm.engaged:
+            storm.tick()
         self._kick_recovery()
 
     def clog_error(self, msg: str) -> None:
@@ -1677,8 +1824,15 @@ class PG(PGListener):
 
     def _kick_recovery(self) -> None:
         """Start recoveries up to osd_recovery_max_active
-        (the OSD recovery wq, scaled to this PG)."""
+        (the OSD recovery wq, scaled to this PG).  While the OSD's
+        recovery-storm controller is ENGAGED, admission belongs to its
+        cross-PG waves (ISSUE 15) — the per-PG trickle yields so wave
+        pacing (and its SLO shedding) actually governs; degraded-op
+        prioritization still admits directly via _recover_one."""
         if not self.peering.is_primary() or not self.peering.is_active():
+            return
+        storm = getattr(self.osd, "recovery_storm", None)
+        if storm is not None and storm.engaged:
             return
         max_active = self.osd.conf.get("osd_recovery_max_active")
         for oid in self.peering.all_missing_oids():
@@ -1941,7 +2095,16 @@ class PG(PGListener):
         ):
             return
         if not self._bf_local_reserved:
-            if not self.osd.local_reserver.try_reserve(self._backfill_key()):
+            # backfill rides the base priority so a storm's recovery
+            # reservation (osd_recovery_op_priority, strictly higher)
+            # can preempt it mid-chunk; the preempt callback surrenders
+            # every slot and the tick loop re-grants deterministically
+            # once the storm releases
+            if not self.osd.local_reserver.try_reserve(
+                self._backfill_key(),
+                priority=0,
+                on_preempt=self._on_backfill_preempted,
+            ):
                 return  # all local slots busy; retry next tick
             self._bf_local_reserved = True
         missing_grants = p.backfill_targets - self._bf_granted
@@ -1997,6 +2160,11 @@ class PG(PGListener):
 
         p = self.peering
         if not p.backfill_targets or self._bf_inflight:
+            return
+        if not self._bf_local_reserved:
+            # preempted (or never reserved): the walk stops at the next
+            # chunk boundary; the tick loop re-reserves and resumes from
+            # the cursors once a slot frees
             return
         scan_max = self.osd.conf.get("osd_backfill_scan_max")
         objects = self._list_local()  # store returns them sorted
@@ -2090,6 +2258,18 @@ class PG(PGListener):
         if self._bf_local_reserved:
             self.osd.local_reserver.release(self._backfill_key())
             self._bf_local_reserved = False
+
+    def _on_backfill_preempted(self) -> None:
+        """A higher-priority reservation (recovery-storm rebuild) took
+        our local slot: surrender the remote grants too — holding them
+        while unable to push would starve the targets' other primaries
+        — and let the tick loop re-run the whole handshake once a slot
+        frees.  The local slot is already gone (the reserver popped it
+        before firing this callback), so only the flag resets here;
+        `_surrender_reservations`'s release of the un-held key is the
+        exactly-once no-op the reserver guarantees."""
+        self._bf_local_reserved = False
+        self._surrender_reservations()
 
     def _surrender_reservations(self) -> None:
         """Give back every slot (local + granted remotes) without touching

@@ -80,6 +80,24 @@ class PGListener(abc.ABC):
         """Shard indices known to be missing this object."""
         return set()
 
+    def shard_data_source(self, shard: int, oid: str) -> int:
+        """The osd that can serve `shard`'s bytes for `oid`, or PG_NONE.
+
+        Default: the acting member, when it is placed and not missing
+        the object — the pre-ISSUE-15 sourcing rule.  The PG overrides
+        this with stray-shard redirection: when CRUSH slot-fill
+        reshuffles an EC acting set, a surviving member's chunks live
+        under its OLD shard coll (positional shard identity), and the
+        last-clean holder of a slot keeps serving reconstruction reads
+        for objects still missing on the new member."""
+        from ..osd.osdmap import PG_NONE
+
+        acting = self.acting()
+        osd = acting[shard] if shard < len(acting) else PG_NONE
+        if osd == PG_NONE or shard in self.get_shard_missing(oid):
+            return PG_NONE
+        return osd
+
     def on_local_recover(self, oid: str) -> None:
         pass
 

@@ -18,7 +18,19 @@ failure modes the wired FaultInjector seams expose (ISSUE 7):
   objects), and that client p99 stayed within the QoS bound while the
   scrub stream ran,
 - an OSD flap (stop, degraded writes, restart on the old store) driving
-  peering + recovery pushes.
+  peering + recovery pushes,
+- a whole-OSD recovery storm (ISSUE 15): an OSD dies for good, the
+  mon's dampened down→out sweep remaps it, and every surviving
+  primary's recovery-storm controller batches the flooded missing sets
+  into cross-PG decode waves while mixed load keeps flowing — with
+  recovery-path wedges (`ec.recover_push`, `peering.msg`) armed
+  mid-storm; asserts the rebuild-time bound AND the client-p99 bound
+  simultaneously, and wave batching (decode launches < objects
+  recovered, witnessed by flight records),
+- a flapping-OSD phase: rapid bounces accumulate markdown history, the
+  dampened grace grows exponentially (map stays stable: zero
+  auto-outs), then the same OSD dies for real and is still outed past
+  the longer grace — dampening delays churn without orphaning data.
 
 The run is SEEDED and deterministic in its decision sequence (payloads,
 object names, injection arming order all come from one rng; socket-fault
@@ -58,6 +70,34 @@ def _osd_conf(i: int):
             # within the run instead of riding the 20 s default
             "ec_tpu_launch_timeout_ms": 5000,
             "ec_tpu_probe_interval_ms": 200,
+            # recovery-storm controller (ISSUE 15): engage at smoke
+            # scale, small waves, quick stalled-push retry so the
+            # armed ec.recover_push wedge self-heals within the run
+            "osd_recovery_storm_min_objects": 6,
+            "osd_recovery_storm_wave_objects": 8,
+            "osd_recovery_storm_max_inflight": 24,
+            "osd_recovery_storm_slo_target_ms": 2000.0,
+            "osd_recovery_push_retry_sec": 0.5,
+        },
+        env=False,
+    )
+
+
+def _mon_conf(cfg: dict):
+    """Mon config for the storm/flap phases (ISSUE 15): a fast tick,
+    flap dampening armed, and the down→out sweep DISABLED until the
+    storm phase arms it (runtime `conf.set`) — the early phases' flap
+    must never race the auto-out."""
+    from ceph_tpu.common.config import Config
+
+    return Config(
+        {
+            "name": "mon.chaos",
+            "mon_tick_interval": 0.2,
+            "mon_osd_down_out_interval": 0.0,
+            "mon_osd_flap_window": 120.0,
+            "mon_osd_flap_backoff": 2.0,
+            "mon_osd_flap_max_auto_out_per_tick": 2,
         },
         env=False,
     )
@@ -140,7 +180,10 @@ async def _run(cfg: dict) -> dict:
     hbm.reset_peaks()
 
     monmap = MonMap(addrs=_free_port_addrs(1))
-    mons = [Monitor(n, monmap, election_timeout=0.3) for n in monmap.addrs]
+    mons = [
+        Monitor(n, monmap, election_timeout=0.3, conf=_mon_conf(cfg))
+        for n in monmap.addrs
+    ]
     for m in mons:
         await m.start()
     for m in mons:
@@ -542,6 +585,241 @@ async def _run(cfg: dict) -> dict:
         osds[victim_id] = revived
         report["events"].append(f"osd.{victim_id} flapped")
 
+        # ---- phase 5: whole-OSD recovery storm (ISSUE 15) ----------------
+        # A victim dies for good.  The mon's (dampened) down->out sweep
+        # outs it — first markdown, so the base grace applies — CRUSH
+        # fills its slots in place from the standing membership (the
+        # cluster runs k+m+1 OSDs, so indep placement has a spare to
+        # pull into each hole without disturbing survivor positions),
+        # and every surviving primary's recovery-storm controller
+        # batches the flooded missing sets into cross-PG decode waves
+        # WHILE mixed client load keeps flowing.  Recovery-path wedges
+        # (ec.recover_push, peering.msg) are armed mid-storm so the
+        # stalled-push retry and the peering re-kick self-heal under
+        # fire.  Asserts the ISSUE 15 acceptance: rebuild-time bound
+        # AND client-p99 bound simultaneously, decode launches <
+        # objects recovered (wave batching witnessed by flight
+        # records), and the whole-OSD bar was visible.
+        def _primaries_clean() -> bool:
+            return all(
+                pg.is_clean
+                for o in osds
+                if o._running
+                for pg in o.pgs.values()
+                if pg.peering.is_primary()
+            )
+
+        # let the phase-4 flap's recovery settle before the kill so the
+        # storm phase measures the FAILURE rebuild alone
+        await _wait_until(_primaries_clean, cfg["converge_timeout"],
+                          "pre-storm churn to settle")
+        # arm the mon's down->out sweep NOW (it was off so the earlier
+        # flap could never race an auto-out); from here on a dead OSD's
+        # data is remapped after the (dampened) grace
+        mons[0].conf.set(
+            "mon_osd_down_out_interval", cfg["down_out_interval"]
+        )
+        def _ec_pgs_holding(osd_id: int) -> int:
+            osdmap = mons[0].osdmon.osdmap
+            ec_pool = osdmap.pools[osdmap.pool_name_to_id["chaospool"]]
+            n = 0
+            for ps in range(ec_pool.pg_num):
+                _u, _up, acting, _p = osdmap.pg_to_up_acting_osds(
+                    ec_pool.id, ps
+                )
+                if osd_id in acting:
+                    n += 1
+            return n
+
+        # the storm victim: an original OSD (not the phase-4 flapper,
+        # whose markdown history would dampen the auto-out) holding the
+        # most EC shards, so the kill floods the widest missing set
+        candidates = [i for i in range(cfg["osds"]) if i != victim_id]
+        storm_victim_id = max(candidates, key=_ec_pgs_holding)
+        assert _ec_pgs_holding(storm_victim_id) >= 1, (
+            "chaos: no storm victim holds chaospool shards"
+        )
+        storm_victim = osds[storm_victim_id]
+        decode_storm0 = ec_dispatch.DECODE_LAUNCHES.snapshot()
+        # baselines over the SURVIVOR set: the victim's counters leave
+        # the final sum with it, so including them here would undercount
+        # the delta (earlier phases can legitimately engage storms)
+        storm_objs0 = sum(
+            o.recovery_storm.objects_admitted
+            for o in osds
+            if o._running and o.whoami != storm_victim_id
+        )
+        storm_waves0 = sum(
+            o.recovery_storm.waves
+            for o in osds
+            if o._running and o.whoami != storm_victim_id
+        )
+        wave_recs0 = sum(
+            1 for r in flight_recorder().records()
+            if r["kind"] == "recovery_wave"
+        )
+        await storm_victim.stop()
+        inj.inject("ec.recover_push", 5, hits=2)
+        inj.inject("peering.msg", 5, hits=2)
+        await _wait_until(
+            lambda: not mons[0].osdmon.osdmap.is_up(storm_victim_id),
+            10.0, f"mon marking osd.{storm_victim_id} down",
+        )
+        await _wait_until(
+            lambda: not mons[0].osdmon.osdmap.osds[storm_victim_id].in_,
+            max(20.0, 10 * cfg["down_out_interval"]),
+            f"auto-out of dead osd.{storm_victim_id}",
+        )
+        t_out = time.monotonic()
+        await _wait_until(
+            lambda: not _primaries_clean(), 10.0,
+            "the storm's re-peer/missing flood to become visible",
+        )
+        # mixed load WHILE the rebuild storms, per-op latency sampled
+        # for the simultaneous client-p99 bound
+        storm_lat_s: list[float] = []
+        i = 0
+        while not _primaries_clean() and i < 400:
+            t0 = time.monotonic()
+            await put(f"storm{i}", 8192)
+            storm_lat_s.append(time.monotonic() - t0)
+            oid = f"base{i % cfg['objects']}"
+            back = await io.read(oid)
+            assert back == expected[oid], f"chaos: {oid} lost mid-storm"
+            i += 1
+        await _wait_until(_primaries_clean, cfg["converge_timeout"],
+                          "whole-OSD rebuild to complete")
+        rebuild_seconds = time.monotonic() - t_out
+        inj.clear("ec.recover_push")
+        inj.clear("peering.msg")
+        live = [o for o in osds if o._running]
+        dec_storm = ec_dispatch.DECODE_LAUNCHES.snapshot()
+        storm_launches = dec_storm["launches"] - decode_storm0["launches"]
+        storm_objects = sum(
+            o.recovery_storm.objects_admitted for o in live
+        ) - storm_objs0
+        storm_waves = sum(o.recovery_storm.waves for o in live) - storm_waves0
+        wave_recs = sum(
+            1 for r in flight_recorder().records()
+            if r["kind"] == "recovery_wave"
+        ) - wave_recs0
+        push_retries = sum(
+            getattr(pg.backend, "push_retries", 0)
+            for o in live
+            for pg in o.pgs.values()
+        )
+        storm_lat_s.sort()
+        storm_p99 = (
+            storm_lat_s[int(0.99 * (len(storm_lat_s) - 1))]
+            if storm_lat_s else 0.0
+        )
+        report["rebuild_seconds"] = round(rebuild_seconds, 3)
+        report["storm_p99_ms"] = round(storm_p99 * 1e3, 3)
+        report["storm_waves"] = storm_waves
+        report["storm_objects"] = storm_objects
+        report["storm_decode_launches"] = storm_launches
+        report["storm_wave_flight_records"] = wave_recs
+        report["storm_push_retries"] = push_retries
+        assert storm_waves >= 1, "chaos: no recovery-storm wave launched"
+        assert wave_recs >= 1, (
+            "chaos: storm waves left no flight records"
+        )
+        assert storm_objects >= 5, (
+            f"chaos: storm recovered too few objects ({storm_objects}) "
+            "to witness wave batching"
+        )
+        assert storm_launches < storm_objects, (
+            f"chaos: decode launches ({storm_launches}) did not batch "
+            f"below objects recovered ({storm_objects})"
+        )
+        assert rebuild_seconds <= cfg["storm_rebuild_bound_sec"], (
+            f"chaos: whole-OSD rebuild took {rebuild_seconds:.1f}s, over "
+            f"the {cfg['storm_rebuild_bound_sec']}s bound"
+        )
+        assert storm_p99 * 1e3 <= cfg["storm_p99_bound_ms"], (
+            f"chaos: client p99 {storm_p99 * 1e3:.1f} ms exceeded the "
+            f"{cfg['storm_p99_bound_ms']} ms bound during the storm"
+        )
+        # the storm victim stays dead+out: this framework keeps PG
+        # logs/infos in memory, so a revived-after-reshuffle OSD would
+        # rejoin with no interval history (the one case stray-shard
+        # redirection cannot source) — the cluster runs k+m+2 OSDs so
+        # both failure phases rebuild onto standing capacity instead
+        report["events"].append("whole-OSD storm rebuilt under load")
+
+        # ---- phase 6: flapping OSD vs mon dampening ----------------------
+        # Rapid stop/start bounces accumulate markdowns; the dampened
+        # down->out grace grows exponentially, so the map stays stable
+        # (ZERO auto-outs) through the flaps — then the same OSD dies
+        # for real, and the sweep still outs it past the (longer)
+        # grace, proving dampening delays churn without ever orphaning
+        # a genuinely dead OSD's data.
+        auto_outs0 = mons[0].osdmon.flap_stats()["auto_outs_total"]
+        flapper_id = max(
+            (
+                i for i in range(cfg["osds"])
+                if i not in (victim_id, storm_victim_id)
+            ),
+            key=_ec_pgs_holding,
+        )
+        for _cycle in range(2):
+            flapper = osds[flapper_id]
+            fstore = flapper.store
+            await flapper.stop()
+            await _wait_until(
+                lambda: not mons[0].osdmon.osdmap.is_up(flapper_id),
+                10.0, f"mon marking flapping osd.{flapper_id} down",
+            )
+            flapper = OSD(flapper_id, monmap, conf=_osd_conf(flapper_id),
+                          store=fstore)
+            await flapper.start()
+            await flapper.wait_for_up()
+            osds[flapper_id] = flapper
+        stats = mons[0].osdmon.flap_stats()
+        report["flap_auto_outs"] = (
+            stats["auto_outs_total"] - auto_outs0
+        )
+        fl = stats["osds"].get(flapper_id, {})
+        report["flap_markdowns"] = fl.get("markdowns", 0)
+        report["flap_grace_sec"] = fl.get("grace_sec", 0.0)
+        assert report["flap_auto_outs"] == 0, (
+            f"chaos: dampening failed — {report['flap_auto_outs']} "
+            "auto-out(s) during the flap bounces"
+        )
+        assert report["flap_markdowns"] >= 2, (
+            f"chaos: flap history lost ({report['flap_markdowns']})"
+        )
+        assert report["flap_grace_sec"] >= 2 * cfg["down_out_interval"], (
+            f"chaos: dampened grace {report['flap_grace_sec']}s did not "
+            f"grow past 2x the {cfg['down_out_interval']}s base"
+        )
+        # the genuinely dead case: the flapper dies for good — outed
+        # past the dampened grace, and its data still rebuilds
+        dead = osds[flapper_id]
+        await dead.stop()
+        await _wait_until(
+            lambda: not mons[0].osdmon.osdmap.is_up(flapper_id),
+            10.0, f"mon marking dead osd.{flapper_id} down",
+        )
+        t_dead = time.monotonic()
+        await _wait_until(
+            lambda: not mons[0].osdmon.osdmap.osds[flapper_id].in_,
+            max(30.0, 20 * cfg["down_out_interval"]),
+            "dead flapper's dampened auto-out",
+        )
+        dead_out_wait = time.monotonic() - t_dead
+        report["flap_dead_out_wait_sec"] = round(dead_out_wait, 3)
+        report["flap_dampened_holds"] = (
+            mons[0].osdmon.flap_stats()["dampened_holds"]
+        )
+        assert dead_out_wait >= 1.5 * cfg["down_out_interval"], (
+            f"chaos: dead flapper outed after {dead_out_wait:.1f}s — the "
+            "dampened grace never applied"
+        )
+        await _wait_until(_primaries_clean, cfg["converge_timeout"],
+                          "dead flapper's data to rebuild")
+        report["events"].append("flap dampening held; dead OSD rebuilt")
+
         # ---- convergence ------------------------------------------------
         def all_clean() -> bool:
             # PG.progress_active() is the READ-ONLY predicate:
@@ -732,7 +1010,7 @@ async def _run(cfg: dict) -> dict:
 def run_chaos(
     seed: int = 0xC405,
     smoke: bool = False,
-    osds: int = 4,
+    osds: int = 5,
     objects: int = 24,
     pg_num: int = 4,
 ) -> dict:
@@ -741,8 +1019,13 @@ def run_chaos(
     convergence IS the assertion."""
     if smoke:
         # fast, seed-fixed tier-1 variant: small but still crossing every
-        # phase (sockets, EIO, launch faults, flap + recovery)
-        osds, objects, pg_num = 3, 8, 2
+        # phase (sockets, EIO, launch faults, flap + recovery, whole-OSD
+        # storm + flap dampening).  k+m+2 OSDs: BOTH failure phases
+        # (storm victim, dead flapper) leave their OSD out for good and
+        # rebuild onto standing capacity — CRUSH fills the holes from
+        # the known membership, and the stray-shard redirection covers
+        # the slot reshuffles the fill can cause.
+        osds, objects, pg_num = 5, 8, 4
     cfg = {
         "seed": seed,
         "smoke": smoke,
@@ -767,6 +1050,15 @@ def run_chaos(
         "slo_burn_bound": 1.0,
         "trace_sample_rate": 0.01,
         "trace_budget": 10.0,
+        # ISSUE 15 storm/flap gates: the mon's down->out base interval
+        # (kept small so the auto-out and the dampened grace both land
+        # inside the run), the whole-OSD rebuild-time bound, and the
+        # client p99 bound enforced SIMULTANEOUSLY with it.  Bounds are
+        # generous for shared CI hosts — they catch a rebuild that
+        # stalls or starves clients for seconds, not noise.
+        "down_out_interval": 2.0 if smoke else 5.0,
+        "storm_rebuild_bound_sec": 30.0 if smoke else 60.0,
+        "storm_p99_bound_ms": 2000.0 if smoke else 1000.0,
     }
     return asyncio.run(_run(cfg))
 
@@ -776,7 +1068,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="fast seed-fixed variant (tier-1)")
     ap.add_argument("--seed", type=int, default=0xC405)
-    ap.add_argument("--osds", type=int, default=4)
+    ap.add_argument("--osds", type=int, default=5)
     ap.add_argument("--objects", type=int, default=24)
     ap.add_argument("--pg-num", type=int, default=4)
     ap.add_argument("--out", default="",

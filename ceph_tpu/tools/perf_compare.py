@@ -51,6 +51,12 @@ METRICS: dict[str, tuple[tuple[str, ...], str, bool]] = {
     "rs_8_3_decode_GBps_aggregate": (("multichip", "decode"), "higher", True),
     "chaos_p99_ms": (("chaos", "chaos_p99_ms"), "lower", False),
     "recovery_occupancy": (("chaos", "recovery_occupancy"), "higher", False),
+    # recovery-storm trajectory (ISSUE 15): whole-OSD rebuild time and
+    # client p99 under the storm, both lower-is-better, folded from the
+    # chaos JSON so a PR that slows rebuild (or lets it eat client
+    # latency) flags against the committed best
+    "chaos_rebuild_seconds": (("chaos", "rebuild_seconds"), "lower", False),
+    "chaos_storm_p99_ms": (("chaos", "storm_p99_ms"), "lower", False),
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -115,6 +115,12 @@ def export_chrome_trace(records: list[dict]) -> dict:
     render as instant-like 1 µs slices so the timeline still shows
     them."""
     events: list[dict] = []
+    total_records = len(records)
+    # recovery-storm wave records (ISSUE 15) get their own process row
+    # below — they are admission spans, not device work, and would
+    # fabricate device busy time if interleaved on the device lanes
+    storm_recs = [r for r in records if r.get("kind") == "recovery_wave"]
+    records = [r for r in records if r.get("kind") != "recovery_wave"]
     # device lanes: sequential per lane, with explicit idle gaps.  Lanes
     # split by device width: a 1-device launch and an 8-device launch
     # occupy different hardware, interleaving them on one lane would
@@ -235,6 +241,32 @@ def export_chrome_trace(records: list[dict]) -> dict:
     # it.  Records from pre-ledger dumps (no hbm_bytes key) emit
     # nothing; an explicit 0 still plots (the drain back to baseline is
     # part of the signal).
+    # recovery-storm row (ISSUE 15): one lane per storm group
+    # ("storm:osd.N"), one slice per admitted wave — the decode
+    # launches the wave co-rides show up on the device/sched rows at
+    # the same timestamps, so batching (few wide launches under one
+    # wave slice) is visible as lane alignment.
+    storm_lanes: dict[str, list[dict]] = {}
+    for rec in storm_recs:
+        storm_lanes.setdefault(rec.get("group") or "storm", []).append(rec)
+    for lane, recs in sorted(storm_lanes.items()):
+        prev_end = None
+        for rec in sorted(recs, key=lambda r: r.get("submit_ts", 0.0)):
+            start_us = _us(rec["submit_ts"])
+            if prev_end is not None:
+                start_us = max(start_us, prev_end)
+            dur_us = max(
+                _MIN_DUR_US,
+                _us(rec.get("settle_ts") or 0.0) - _us(rec["submit_ts"]),
+            )
+            events.append(_complete(
+                f"wave ({rec.get('stripes', 0)} objs, "
+                f"{rec.get('tickets', 0)} pgs)",
+                "recovery storm", lane, start_us, dur_us,
+                {"seq": rec["seq"], "objects": rec.get("stripes", 0),
+                 "pgs": rec.get("tickets", 0)},
+            ))
+            prev_end = start_us + dur_us
     for rec in sorted(records, key=_completion_ts):
         if "hbm_bytes" not in rec:
             continue
@@ -251,7 +283,7 @@ def export_chrome_trace(records: list[dict]) -> dict:
         "displayTimeUnit": "ms",
         "otherData": {
             "source": "ceph_tpu flight recorder",
-            "records": len(records),
+            "records": total_records,
         },
     }
 

@@ -2,9 +2,13 @@
 mon+mgr+OSD cluster under mixed load survives socket faults, shard-read
 EIO bursts, device-launch failures (host fallback), a deep scrub under
 client load with planted shard corruption (ISSUE 9: detected via
-aggregated TPU verify launches, client p99 inside the QoS bound), and
-an OSD flap — converging to all-PGs-clean with ZERO lost writes and
-health clear of SLOW_OPS / TPU_BACKEND_DEGRADED.
+aggregated TPU verify launches, client p99 inside the QoS bound), an
+OSD flap, a whole-OSD recovery storm (ISSUE 15: kill + dampened
+auto-out + wave-batched rebuild under load with simultaneous
+rebuild-time and p99 bounds), and a flapping-OSD phase (mon dampening
+keeps the map stable while a genuinely dead OSD still rebuilds) —
+converging to all-PGs-clean with ZERO lost writes and health clear of
+SLOW_OPS / TPU_BACKEND_DEGRADED.
 
 The full-size variant lives in `python -m ceph_tpu.tools.chaos`; this is
 the `--smoke` configuration run in-process so tier-1 exercises the same
@@ -19,7 +23,7 @@ class TestChaosSmoke:
         assert report["converged"], report
         assert report["lost_writes"] == 0, report
         # every chaos phase actually ran
-        assert len(report["events"]) == 8, report["events"]
+        assert len(report["events"]) == 10, report["events"]
         # ISSUE 10: the mixed-load phase attributed the load per pool
         # (windowed p99 keys ride the report for the bench fold), held
         # the SLO burn rate under bound, and kept trace retention
@@ -83,6 +87,29 @@ class TestChaosSmoke:
         # documents the comparison rather than flagging)
         assert "regressions" in report, report
         assert "flagged" in report["regressions"], report
+        # ISSUE 15: the recovery-storm phase's keys are present and
+        # bounded — the dead OSD rebuilt via wave-batched decode
+        # launches (launches < objects recovered, witnessed by flight
+        # records) inside the rebuild-time bound while client p99 held
+        # (both bounds also asserted inside the phase)
+        assert report["rebuild_seconds"] > 0.0, report
+        assert report["rebuild_seconds"] <= 30.0, report
+        assert report["storm_p99_ms"] >= 0.0, report
+        assert report["storm_p99_ms"] <= 2000.0, report
+        assert report["storm_waves"] >= 1, report
+        assert report["storm_wave_flight_records"] >= 1, report
+        assert report["storm_objects"] >= 5, report
+        assert (
+            report["storm_decode_launches"] < report["storm_objects"]
+        ), report
+        # ...and the flap-dampening phase: zero auto-outs while the OSD
+        # bounced, markdown history retained, the dampened grace grew,
+        # and the genuinely dead flapper still got outed (later) so its
+        # data rebuilt
+        assert report["flap_auto_outs"] == 0, report
+        assert report["flap_markdowns"] >= 2, report
+        assert report["flap_grace_sec"] >= 4.0, report
+        assert report["flap_dead_out_wait_sec"] >= 3.0, report
         # health settled: no stuck SLOW_OPS, no lingering degraded check
         assert "SLOW_OPS" not in report["health_checks"], report
         assert "TPU_BACKEND_DEGRADED" not in report["health_checks"], report

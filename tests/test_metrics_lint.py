@@ -518,6 +518,46 @@ class TestClusterScrapeLint:
             ):
                 assert families[fam]["type"] == "gauge", fam
 
+            # recovery-storm families (ISSUE 15): every controller
+            # perf-dump key round-trips onto the scrape as
+            # ceph_tpu_recovery_storm_<key> AND is documented, and vice
+            # versa — every scraped recovery_storm family maps back to
+            # a controller export.  Levels (wave size, in-flight depth,
+            # engagement, burn rate) are gauges; the wave/shed/ramp/
+            # storm totals stay counters.
+            storm_keys = set(osds[0].recovery_storm.perf_dump())
+            for key in storm_keys:
+                fam = f"ceph_tpu_recovery_storm_{_sanitize(key)}"
+                assert fam in families, f"{fam} missing from scrape"
+                assert documented(fam), f"{fam} not documented"
+                assert families[fam]["samples"], (
+                    f"{fam} announced but carries no samples"
+                )
+            for fam in families:
+                if fam.startswith("ceph_tpu_recovery_storm_"):
+                    key = fam.removeprefix("ceph_tpu_recovery_storm_")
+                    assert key in {_sanitize(k) for k in storm_keys}, (
+                        f"scraped {fam} has no RecoveryStormController "
+                        "perf_dump() source"
+                    )
+            for fam in (
+                "ceph_tpu_recovery_storm_wave_objects",
+                "ceph_tpu_recovery_storm_inflight",
+                "ceph_tpu_recovery_storm_engaged",
+                "ceph_tpu_recovery_storm_burn_rate",
+            ):
+                assert families[fam]["type"] == "gauge", fam
+            for fam in (
+                "ceph_tpu_recovery_storm_waves",
+                "ceph_tpu_recovery_storm_objects_admitted",
+                "ceph_tpu_recovery_storm_sheds",
+                "ceph_tpu_recovery_storm_ramps",
+                "ceph_tpu_recovery_storm_storms_started",
+                "ceph_tpu_recovery_storm_storms_completed",
+                "ceph_tpu_recovery_storm_preempted_backfills",
+            ):
+                assert families[fam]["type"] == "counter", fam
+
             # direction 2 (vice versa): every documented metric exists
             # in the scrape, and every scraped ec_dispatch/progress
             # family maps back to a perf-dump key / module gauge

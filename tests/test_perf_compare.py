@@ -106,7 +106,8 @@ class TestMetricSlice:
                 "decode": {"metric": "rs_8_3_decode_GBps_aggregate",
                            "value": 8.0},
             },
-            "chaos": {"chaos_p99_ms": 120.5, "recovery_occupancy": 2.0},
+            "chaos": {"chaos_p99_ms": 120.5, "recovery_occupancy": 2.0,
+                      "rebuild_seconds": 6.25, "storm_p99_ms": 240.0},
         }
         got = metric_slice(parsed)
         assert got == {
@@ -118,6 +119,8 @@ class TestMetricSlice:
             "rs_8_3_decode_GBps_aggregate": 8.0,
             "chaos_p99_ms": 120.5,
             "recovery_occupancy": 2.0,
+            "chaos_rebuild_seconds": 6.25,
+            "chaos_storm_p99_ms": 240.0,
         }
 
     def test_mislabeled_and_nonfinite_values_ignored(self):
@@ -195,6 +198,32 @@ class TestCompare:
             _rounds(),
         )
         assert out["flagged"] == []
+
+    def test_storm_rebuild_keys_gate_lower_is_better(self):
+        """ISSUE 15: rebuild time and under-storm p99 fold from the
+        chaos JSON and flag when a round slows the whole-OSD rebuild
+        (or lets it eat client latency) past the ratio."""
+        rounds = _rounds() + [{
+            "round": 5, "rc": 0, "platform": "cpu",
+            "metrics": {"chaos_rebuild_seconds": 5.0,
+                        "chaos_storm_p99_ms": 200.0},
+        }]
+        out = compare(
+            {"platform": "cpu",
+             "chaos": {"rebuild_seconds": 9.0, "storm_p99_ms": 190.0}},
+            rounds,
+        )
+        flagged = {f["metric"] for f in out["flagged"]}
+        assert flagged == {"chaos_rebuild_seconds"}, out["flagged"]
+        out = compare(
+            {"platform": "cpu",
+             "chaos": {"rebuild_seconds": 5.5, "storm_p99_ms": 190.0}},
+            rounds,
+        )
+        assert out["flagged"] == []
+        # baselines carry the best (lowest) committed values
+        assert out["baselines"]["chaos_rebuild_seconds"]["value"] == 5.0
+        assert out["baselines"]["chaos_storm_p99_ms"]["value"] == 200.0
 
     def test_no_baseline_no_flag(self):
         """First round / new metric / platform switch: nothing to judge
