@@ -16,6 +16,8 @@ import os
 import socket
 from typing import Awaitable, Callable
 
+from .log import dout
+
 # A hook receives the parsed command dict and returns a JSON-serializable
 # payload (AdminSocketHook::call).
 Hook = Callable[[dict], object]
@@ -24,15 +26,29 @@ Hook = Callable[[dict], object]
 class AdminSocket:
     def __init__(self, path: str):
         self.path = path
-        self._hooks: dict[str, tuple[Hook, str]] = {}
+        self._hooks: dict[str, tuple[Hook, str, bool]] = {}
         self._server: asyncio.AbstractServer | None = None
+        # audit sink (ISSUE 16): the owning daemon wires this to its
+        # cluster-log client so every MUTATING asok command lands on the
+        # `audit` channel; called as audit_cb(prefix, cmd)
+        self.audit_cb: Callable[[str, dict], None] | None = None
         self.register("help", lambda cmd: {
-            prefix: desc for prefix, (_, desc) in sorted(self._hooks.items())
+            prefix: desc for prefix, (_, desc, _m) in sorted(self._hooks.items())
         }, "list available commands")
 
-    def register(self, prefix: str, hook: Hook, desc: str = "") -> None:
-        """AdminSocket::register_command."""
-        self._hooks[prefix] = (hook, desc)
+    def register(
+        self, prefix: str, hook: Hook, desc: str = "", mutating: bool = False
+    ) -> None:
+        """AdminSocket::register_command.  `mutating` marks commands
+        that change daemon/cluster state (injectargs, fault arming,
+        mark_unfound_lost, ...): they are audited through audit_cb, and
+        the metrics lint's audit-discipline check enumerates them."""
+        self._hooks[prefix] = (hook, desc, mutating)
+
+    def mutating_prefixes(self) -> list[str]:
+        """Commands registered as mutating (the audit-discipline lint's
+        enumeration surface)."""
+        return sorted(p for p, (_, _, m) in self._hooks.items() if m)
 
     async def start(self) -> None:
         if os.path.exists(self.path):
@@ -64,7 +80,13 @@ class AdminSocket:
             if entry is None:
                 reply = {"error": f"unknown command {prefix!r}"}
             else:
-                hook, _ = entry
+                hook, _, mutating = entry
+                if mutating and self.audit_cb is not None:
+                    try:
+                        self.audit_cb(prefix, cmd)
+                    except Exception as e:
+                        # auditing must never block the command itself
+                        dout("asok", 1, f"audit hook failed for {prefix!r}: {e}")
                 try:
                     result = hook(cmd)
                     if asyncio.iscoroutine(result):

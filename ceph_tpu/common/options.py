@@ -613,6 +613,13 @@ OPTIONS: dict[str, Option] = _opts(
            "the whole map in one epoch; the remainder keep their "
            "down-clock and go out on later ticks.  <= 0 removes the "
            "cap", see_also=("mon_osd_down_out_interval",), runtime=True),
+    Option("mon_log_max", int, 500, A,
+           "committed cluster-log entries each mon retains (the `log "
+           "last` tail; mon/log_monitor.py).  Entries past the bound "
+           "age out oldest-first on the next commit; lowering it at "
+           "runtime trims immediately, raising it lets the tail grow. "
+           "History beyond the bound lives only in daemon logs",
+           runtime=True),
     # --- messenger (global.yaml.in:1240-1271 fault injection) ---------------
     Option("ms_type", str, "async+posix", A,
            "messenger stack: async+posix (TCP) or async+inproc "

@@ -1,6 +1,7 @@
 """Manager daemon + module runtime (SURVEY.md §2.7; src/mgr +
 src/pybind/mgr)."""
 
+from .clog import ClogModule
 from .dashboard import DashboardModule
 from .iostat import IostatModule
 from .metrics_history import MetricsHistoryModule
@@ -11,6 +12,7 @@ from .progress import ProgressModule
 from .telemetry import TelemetryModule
 
 __all__ = [
+    "ClogModule",
     "DashboardModule",
     "IostatModule",
     "MetricsHistoryModule",

@@ -128,6 +128,18 @@ class DashboardModule(HttpServedModule, MgrModule):
             "sentinels": mod.history_digest()["sentinels"],
         }
 
+    def api_log(self) -> dict:
+        """The /api/log payload (dashboard/controllers/logs.py analog):
+        the clog module's recent committed entries plus the health-event
+        digest.  Empty when the module isn't registered (opt-in)."""
+        from .modules import find_module
+
+        mod = find_module(self.mgr, "clog")
+        if mod is None:
+            return {"entries": [], "counts": {}, "events_total": 0,
+                    "muted": []}
+        return {"entries": mod.log_last(n=50), **mod.clog_digest()}
+
     def prometheus_metrics(self) -> list[tuple[str, str, str, list[str]]]:
         """Module-metrics hook: `map_errors` (PGs skipped as unmappable
         in api_pgs) was a module-local counter nobody could see — a
@@ -149,6 +161,7 @@ class DashboardModule(HttpServedModule, MgrModule):
             "/api/pgs": self.api_pgs,
             "/api/daemons": self.api_daemons,
             "/api/perf_history": self.api_perf_history,
+            "/api/log": self.api_log,
         }
         fn = routes.get(path)
         if fn is not None:
@@ -168,7 +181,7 @@ class DashboardModule(HttpServedModule, MgrModule):
                 f"<table border=1><tr><th>daemon</th><th>state</th><th>membership"
                 f"</th></tr>{rows}</table>"
                 "<p>API: /api/health /api/osds /api/pools /api/pgs "
-                "/api/daemons /api/perf_history</p>"
+                "/api/daemons /api/perf_history /api/log</p>"
                 "</body></html>"
             )
             return 200, "text/html", body

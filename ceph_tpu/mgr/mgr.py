@@ -21,6 +21,7 @@ import asyncio
 import json
 import time
 
+from ..common.clog import ClusterLogClient
 from ..common.config import Config
 from ..common.log import dout
 from ..mon.client import MonClient
@@ -60,6 +61,7 @@ class Mgr(Dispatcher):
         stack = self.conf.get("ms_type")
         self.msgr = Messenger(f"mgr.{name}", stack=stack)
         self.monc = MonClient(f"mgr.{name}", monmap, stack=stack)
+        self.clogc = ClusterLogClient(f"mgr.{name}", send=self.monc.send_log)
         self.osdmap = OSDMap()
         self.mgrmap_epoch = 0
         self.active = False
@@ -144,6 +146,11 @@ class Mgr(Dispatcher):
         self.admin_socket = sock
 
     async def stop(self) -> None:
+        try:
+            await asyncio.wait_for(self.clogc.flush(), timeout=0.5)
+        except Exception as e:
+            # best-effort: the mon may already be gone at shutdown
+            dout("mgr", 5, f"final clog flush failed: {e}")
         self._running = False
         for t in self._tasks:
             t.cancel()
@@ -437,6 +444,8 @@ class Mgr(Dispatcher):
                 self.active = msg.active_name == self.name
                 if self.active and not was:
                     dout("mgr", 1, f"mgr.{self.name} is now active")
+                    if self._running:
+                        self.clogc.info(f"mgr.{self.name} is now active")
             return True
         if isinstance(msg, MMgrReport):
             st = self.daemons.setdefault(msg.daemon, DaemonState())

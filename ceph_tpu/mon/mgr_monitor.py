@@ -48,6 +48,13 @@ class MgrMonitor:
         # each other's updates.
         self._props = ProposalQueue(mon, "mgr")
 
+    def _clog(self, prio: str, msg: str) -> None:
+        """Cluster-log a lifecycle transition; unit harnesses drive this
+        service with a bare mon stub that has no LogMonitor."""
+        logmon = getattr(self.mon, "logmon", None)
+        if logmon is not None:
+            logmon.log(prio, f"mon.{self.mon.name}", msg)
+
     def on_election_changed(self) -> None:
         self._props.reset()
         # Re-baseline beacon timestamps: a newly elected leader has an empty
@@ -102,8 +109,17 @@ class MgrMonitor:
                 name = sorted(standbys)[0]
                 addr = standbys.pop(name)
                 dout("mon", 1, f"mgr {failed} failed; promoting {name}")
+                self._clog(
+                    "warn",
+                    f"mgr {failed} failed (no beacon); failing over to "
+                    f"standby {name}",
+                )
                 return (name, addr, standbys)
             dout("mon", 1, f"mgr {failed} failed; no standby")
+            self._clog(
+                "warn",
+                f"mgr {failed} failed (no beacon); no standby available",
+            )
             return ("", "", {})
 
         self._queue(mutate)

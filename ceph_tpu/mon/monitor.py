@@ -191,6 +191,9 @@ class Monitor(Dispatcher):
                 self.mgrmon.tick()
                 self.mdsmon.tick()
                 self.osdmon.tick()
+                # health-event history + mute maintenance (ISSUE 16):
+                # diffs rendered checks against committed state
+                self.logmon.tick()
 
     async def wait_for_quorum(self, timeout: float = 5.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -425,6 +428,17 @@ class Monitor(Dispatcher):
                 conn, MMonCommandAck(tid=msg.tid, retval=retval, rs=rs, outbl=outbl)
             )
 
+        if mutating:
+            # every mutating command lands on the audit channel (the
+            # reference mon's `audit` LogChannel: "from='client...'
+            # cmd=[...]: dispatch"), logged at dispatch on the leader
+            entity = conn.peer_name or "client.?"
+            self.logmon.log(
+                "info",
+                entity,
+                f"from='{entity}' cmd={json.dumps(cmd)}: dispatch",
+                channel="audit",
+            )
         try:
             handler(cmd, reply)
         except Exception as e:  # command bugs must not kill the mon
@@ -567,11 +581,19 @@ class Monitor(Dispatcher):
             def handler(cmd, reply):
                 # `ceph health [detail]`: the status handler's checks,
                 # served standalone (ClusterHealth essence); `detail`
-                # adds the per-daemon breakdown lines
+                # adds the per-daemon breakdown lines.  Muted checks
+                # (ISSUE 16) drop out of the banner and the overall
+                # status but are named, so the operator sees what is
+                # silenced — the raw checks keep being evaluated and
+                # scraped underneath.
                 checks, details = self.health_checks()
+                checks, details, muted = self.logmon.filter_muted(
+                    checks, details
+                )
                 payload = {
                     "status": health_status(checks),
                     "checks": checks,
+                    "muted": muted,
                 }
                 if cmd.get("detail"):
                     payload["detail"] = details
@@ -585,6 +607,9 @@ class Monitor(Dispatcher):
             def handler(cmd, reply):
                 m = self.osdmon.osdmap
                 checks, _details = self.health_checks()
+                checks, _details, muted = self.logmon.filter_muted(
+                    checks, _details
+                )
                 reply(
                     0,
                     "",
@@ -593,6 +618,7 @@ class Monitor(Dispatcher):
                             "health": {
                                 "status": health_status(checks),
                                 "checks": checks,
+                                "muted": muted,
                             },
                             "quorum": sorted(self.quorum),
                             "osdmap_epoch": m.epoch,
@@ -618,6 +644,10 @@ class Monitor(Dispatcher):
                             # ISSUE 14) — the sentinel evidence,
                             # machine-readable from `status`
                             "history": self.pg_digest.get("history", {}),
+                            # cluster-log tail (ISSUE 16): the last few
+                            # committed entries, `ceph -s`'s recent-
+                            # events block
+                            "log": list(self.logmon.entries)[-10:],
                         }
                     ).encode(),
                 )

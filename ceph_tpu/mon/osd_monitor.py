@@ -59,6 +59,9 @@ class OSDMonitor:
         self._recent_markdowns: dict[int, list[float]] = {}
         self.auto_outs_total = 0  # lifetime auto-out count (the sweep's)
         self.dampened_holds = 0   # sweep passes where dampening held fire
+        # OSDs whose current down episode already clog'd a dampening
+        # hold (one timeline entry per episode, not one per sweep tick)
+        self._hold_logged: set[int] = set()
         # OSDs the sweep auto-outed: marked back IN on reboot (the
         # reference's mon_osd_auto_mark_auto_out_in), unlike an
         # operator's explicit `osd out` which sticks
@@ -66,6 +69,13 @@ class OSDMonitor:
         # queued mutations: (mutate(map) -> rs, reply or None)
         self._pending: list[tuple[Callable, Callable | None]] = []
         self._proposing = False
+
+    def _clog(self, prio: str, msg: str, code: str | None = None) -> None:
+        """Cluster-log a lifecycle transition; unit harnesses drive this
+        service with a bare mon stub that has no LogMonitor."""
+        logmon = getattr(self.mon, "logmon", None)
+        if logmon is not None:
+            logmon.log(prio, f"mon.{self.mon.name}", msg, code=code)
 
     # -- paxos plumbing --------------------------------------------------------
 
@@ -209,6 +219,9 @@ class OSDMonitor:
             return f"osd.{osd} boot"
 
         self._queue(mutate, None)
+        # lifecycle timeline (ISSUE 16): boots, markdowns and auto-outs
+        # all land in the cluster log, not just dout
+        self._clog("info", f"osd.{osd} boot")
 
     def prepare_failure(self, msg: MOSDFailure, reporter: str) -> None:
         """Quorum-check failure reports (OSDMonitor.cc:2791, :3134).
@@ -230,6 +243,7 @@ class OSDMonitor:
                 f"osd.{target} failure: {len(reporters)}/{self.min_down_reporters} reporters",
             )
             return
+        nrep = len(reporters)
         self.failure_reports.pop(target, None)
         self._note_markdown(target, now)
 
@@ -238,6 +252,10 @@ class OSDMonitor:
             return f"osd.{target} marked down"
 
         self._queue(mutate, None)
+        self._clog(
+            "warn", f"osd.{target} marked down ({nrep} reporters)",
+            code="OSD_DOWN",
+        )
 
     # -- flap dampening (ISSUE 15) --------------------------------------------
 
@@ -769,6 +787,7 @@ class OSDMonitor:
         for oid, info in list(self.osdmap.osds.items()):
             if info.up or not info.in_:
                 self._down_since.pop(oid, None)
+                self._hold_logged.discard(oid)
                 continue
             t0 = self._down_since.setdefault(oid, now)
             if interval <= 0:
@@ -780,10 +799,23 @@ class OSDMonitor:
                     # grace: the hold is the dampening WORKING, counted
                     # so chaos/tests can witness it
                     self.dampened_holds += 1
+                    if oid not in self._hold_logged:
+                        # one timeline entry per down episode: the
+                        # "flap-dampened" step in the storm sequence
+                        self._hold_logged.add(oid)
+                        self._clog(
+                            "info",
+                            f"osd.{oid} down {now - t0:.0f}s; auto-out "
+                            f"deferred by flap dampening "
+                            f"(grace {grace:.0f}s, "
+                            f"{self._recent_markdown_count(oid, now)} "
+                            f"recent markdowns)",
+                        )
                 continue
             if budget > 0 and outed >= budget:
                 continue  # churn cap: keep the clock, out it next tick
             self._down_since.pop(oid, None)
+            self._hold_logged.discard(oid)
             outed += 1
             self.auto_outs_total += 1
 
@@ -795,6 +827,10 @@ class OSDMonitor:
             dout("mon", 1, f"osd.{oid} down {now - t0:.0f}s >= "
                            f"{grace:.0f}s (dampened grace): marking out")
             self._queue(mutate, None)
+            self._clog(
+                "warn",
+                f"osd.{oid} marked out after {grace:.0f}s down (auto-out)",
+            )
 
     def _cmd_out(self, cmd, reply) -> None:
         osd = int(cmd["id"])

@@ -136,8 +136,11 @@ class DeviceGuard:
                 self.degraded_since = time.monotonic()
                 self.degraded_total += 1
                 # next launch may probe immediately: a transient error
-                # (one bad compile) should not cost a full interval
-                self._last_probe = 0.0
+                # (one bad compile) should not cost a full interval.
+                # -inf, not 0.0 — monotonic() starts at boot, so on a
+                # freshly booted host 0.0 is less than one interval ago
+                # and would gate the heal probe
+                self._last_probe = float("-inf")
                 self._probe_cold = True
             self.reason = reason
         if entered:

@@ -30,6 +30,7 @@ import random
 import time
 
 from ..common import tracer as tracer_mod
+from ..common.clog import ClusterLogClient
 from ..common.config import Config
 from ..common.log import dout
 from ..common.perf_counters import PerfCountersBuilder
@@ -206,6 +207,15 @@ class OSD(Dispatcher):
         b.add_histogram("ec_decode_latency", "EC reconstruct decode (s)")
         self.perf = b.create_perf_counters()
         self.clog: list[str] = []
+        # structured cluster-log client (ISSUE 16): batching + dedup +
+        # rate limit in front of monc.send_log; every load-bearing
+        # transition (DEGRADED/heal, HBM pressure, storm engage/shed/
+        # disengage, scrub found/repaired) lands here, and the asok's
+        # mutating commands audit through it
+        self.clogc = ClusterLogClient(f"osd.{whoami}", send=self.monc.send_log)
+        # last-seen transition state for the beacon-driven clog diffs
+        self._clog_degraded = False
+        self._clog_hbm_stage = 0
         self._pushed_config: set[str] = set()  # mon-managed option names
         # backfill reservation slots (AsyncReserver pair, OSDService):
         # local = backfills this OSD primaries, remote = slots granted to
@@ -518,6 +528,15 @@ class OSD(Dispatcher):
         from ..common.admin_socket import AdminSocket
 
         sock = AdminSocket(path)
+        # every MUTATING asok command lands on the audit channel (ISSUE
+        # 16): injectargs fault arming, mark_unfound_lost, ... — the
+        # operator's state-changing actions are part of the timeline
+        sock.audit_cb = lambda prefix, cmd: self.cluster_log(
+            "info",
+            f"asok from='osd.{self.whoami}' cmd={prefix!r} "
+            f"args={ {k: v for k, v in cmd.items() if k != 'prefix'} }",
+            channel="audit",
+        )
         # the OSD's encode/decode aggregators (the shared instances this
         # daemon configured at init) export their occupancy/launch-size
         # distributions alongside the daemon counters
@@ -612,6 +631,7 @@ class OSD(Dispatcher):
             },
             "give up on unfound objects: delete + release waiters "
             "(args: pool, ps[, mode=delete])",
+            mutating=True,
         )
         def _injectargs(cmd: dict) -> dict:
             """injectargs-style runtime fault arming: the harness and the
@@ -653,6 +673,7 @@ class OSD(Dispatcher):
             _injectargs,
             "arm/clear fault-injection points + runtime config sets "
             "(args: point, error, hits, one_in, clear, conf)",
+            mutating=True,
         )
         def _dump_flight(cmd: dict) -> dict:
             # the launch flight recorder (ops/flight_recorder.py): the
@@ -733,6 +754,12 @@ class OSD(Dispatcher):
         self.admin_socket = sock
 
     async def stop(self) -> None:
+        try:
+            # ship any batched clog entries before the messenger dies
+            await asyncio.wait_for(self.clogc.flush(), timeout=0.5)
+        except Exception as e:
+            # best-effort: the mon may already be gone at shutdown
+            dout("osd", 5, f"final clog flush failed: {e}")
         self._running = False
         for t in self._tasks + list(self._out_tasks.values()):
             t.cancel()
@@ -897,12 +924,14 @@ class OSD(Dispatcher):
         # snapshot per report, two export names
         perf["ec_device_busy_seconds"] = perf["ec_dispatch.device_busy_seconds"]
         perf["ec_device_occupancy"] = perf["ec_dispatch.device_occupancy"]
+        status = _osd_status(self)
+        self._clog_transitions(status)
         self._send_addr(
             self.mgr_addr,
             MMgrReport(
                 daemon=f"osd.{self.whoami}",
                 perf=json.dumps(perf).encode(),
-                status=json.dumps(_osd_status(self)).encode(),
+                status=json.dumps(status).encode(),
             ),
         )
 
@@ -1413,22 +1442,70 @@ class OSD(Dispatcher):
             except (KeyError, ValueError) as e:
                 dout("osd", 5, f"osd.{self.whoami} config push skipped {name}: {e}")
 
+    def cluster_log(
+        self,
+        prio: str,
+        msg: str,
+        channel: str = "cluster",
+        code: str | None = None,
+    ) -> None:
+        """Structured cluster-log entry (clog → ClusterLogClient →
+        LogMonitor): batched, deduped and rate-limited client-side, then
+        committed through the mons' paxos so the whole quorum holds the
+        same timeline."""
+        dout("osd", 0 if prio == "error" else 5,
+             f"osd.{self.whoami} clog: {msg}")
+        if self._running:
+            self.clogc.log(prio, msg, channel=channel, code=code)
+
     def clog_error(self, msg: str) -> None:
         """Cluster-log error: recorded locally and shipped to the mons'
-        LogMonitor (clog → LogClient → LogMonitor; the EC CRC-mismatch
-        sink, src/osd/ECBackend.cc:1080)."""
+        LogMonitor (the EC CRC-mismatch sink, src/osd/ECBackend.cc:1080)."""
         self.clog.append(msg)
-        dout("osd", 0, f"osd.{self.whoami} clog: {msg}")
-        if self._running:
-            import time as _time
+        self.cluster_log("error", msg)
 
-            entry = {
-                "prio": "error",
-                "who": f"osd.{self.whoami}",
-                "stamp": _time.time(),
-                "msg": msg,
-            }
-            asyncio.get_event_loop().create_task(self.monc.send_log([entry]))
+    def _clog_transitions(self, status: dict) -> None:
+        """Diff the beacon's status blob against the last one and emit
+        cluster-log entries for the load-bearing transitions that used
+        to live only in dout: device-backend DEGRADED/heal and the HBM
+        pressure stages (ISSUE 16)."""
+        tb = status.get("tpu_backend") or {}
+        degraded = bool(tb.get("degraded"))
+        if degraded != self._clog_degraded:
+            self._clog_degraded = degraded
+            if degraded:
+                self.cluster_log(
+                    "warn",
+                    "TPU backend DEGRADED: "
+                    f"{tb.get('reason') or 'unknown'} (host fallback engaged)",
+                    code="TPU_BACKEND_DEGRADED",
+                )
+            else:
+                self.cluster_log(
+                    "info",
+                    "TPU backend healed: device launches resumed",
+                    code="TPU_BACKEND_DEGRADED",
+                )
+        hp = status.get("hbm_pressure") or {}
+        stage = int(hp.get("stage") or 0)
+        if stage != self._clog_hbm_stage:
+            prev = self._clog_hbm_stage
+            self._clog_hbm_stage = stage
+            if stage > prev:
+                self.cluster_log(
+                    "warn",
+                    f"HBM pressure stage {stage} "
+                    f"({hp.get('stage_name', '?')}) engaged: "
+                    f"residency ratio {hp.get('ratio', 0.0)}",
+                    code="TPU_HBM_PRESSURE",
+                )
+            else:
+                self.cluster_log(
+                    "info",
+                    f"HBM pressure relieved (stage {prev} -> {stage}): "
+                    f"residency ratio {hp.get('ratio', 0.0)}",
+                    code="TPU_HBM_PRESSURE",
+                )
 
     def num_pgs(self) -> int:
         return len(self.pgs)

@@ -218,6 +218,15 @@ class RecoveryStormController:
             self._last_tick = now
             self._last_done = self._done
 
+    def _clog(self, prio: str, msg: str) -> None:
+        """Storm timeline entries (ISSUE 16): engage/shed/wave/complete
+        land in the cluster log so the storm is reconstructable from
+        `log last` alone.  Guarded — unit tests drive the controller
+        with a bare fake OSD."""
+        clog = getattr(self.osd, "cluster_log", None)
+        if clog is not None:
+            clog(prio, msg, code="RECOVERY_STORM")
+
     # -- engagement ------------------------------------------------------------
 
     def _engage(self, total: int) -> None:
@@ -268,6 +277,11 @@ class RecoveryStormController:
             f"({total} objects outstanding, victims "
             f"{sorted(self.victims) or '[]'})",
         )
+        self._clog(
+            "info",
+            f"recovery storm ENGAGED: {total} objects outstanding, "
+            f"victims {sorted(self.victims) or '[]'}",
+        )
 
     def _disengage(self) -> None:
         self.engaged = False
@@ -288,6 +302,11 @@ class RecoveryStormController:
             "osd", 1,
             f"osd.{self.osd.whoami}: recovery storm complete "
             f"({self._total} objects, {self.waves} waves lifetime)",
+        )
+        self._clog(
+            "info",
+            f"recovery storm complete: {self._total} objects rebuilt, "
+            f"{self.waves} waves lifetime",
         )
 
     # -- wave admission --------------------------------------------------------
@@ -318,6 +337,14 @@ class RecoveryStormController:
             queues = next_queues
         if admitted:
             self._record_wave(t0, admitted, len(pgs_touched))
+            # per-wave timeline breadcrumb at debug severity: the
+            # "waves" step of the storm sequence, cheap enough that the
+            # client-side rate limiter is the only bound it needs
+            self._clog(
+                "debug",
+                f"recovery storm wave: {admitted} objects across "
+                f"{len(pgs_touched)} pgs (wave size {self._wave})",
+            )
         return admitted
 
     def _record_wave(self, t0: float, objects: int, pgs: int) -> None:
@@ -364,6 +391,11 @@ class RecoveryStormController:
             new = max(floor, self._wave // 2)
             if new < self._wave:
                 self.sheds += 1
+                self._clog(
+                    "info",
+                    f"recovery storm SHED: wave {self._wave} -> {new} "
+                    f"(client burn {self._burn:.2f} > {threshold})",
+                )
         else:
             new = min(ceiling, max(self._wave * 2, floor))
             if new > self._wave:

@@ -585,6 +585,20 @@ class PgScrubber:
                     self.pg.mark_shard_missing(oid, osd)
                 res.repaired += 1
                 self.pg.request_recovery(oid)
+        if res.repaired:
+            # the repair side of the scrub timeline (ISSUE 16): the
+            # error entries above raised it, this closes it.  Guarded —
+            # unit tests scrub against a bare fake PG/OSD.
+            clog = getattr(
+                getattr(self.pg, "osd", None), "cluster_log", None
+            )
+            if clog is not None:
+                clog(
+                    "info",
+                    f"pg {self.pg.pgid} repair: {res.repaired} object(s) "
+                    "re-queued for recovery (shards rebuilt)",
+                    code="OSD_SCRUB_ERRORS",
+                )
         dout(
             "osd",
             5,

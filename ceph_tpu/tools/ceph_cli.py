@@ -33,6 +33,10 @@ _PREFIXES = [
     "osd pool application enable",
     "osd pool application get",
     "osd df",
+    "log last",
+    "health history",
+    "health mute",
+    "health unmute",
     "health",
     "osd pool rm",
     "osd tier add",
@@ -114,6 +118,31 @@ def build_cmd(words: list[str]) -> dict:
                 # `ceph health detail`: per-daemon breakdown of each check
                 if rest and rest[0] == "detail":
                     cmd["detail"] = True
+            elif prefix == "log last":
+                # `ceph log last [n] [channel] [severity]` — positional n
+                # first, then channel/severity keywords in either order
+                for r in rest:
+                    if r.isdigit():
+                        cmd["num"] = int(r)
+                    elif r in ("cluster", "audit"):
+                        cmd["channel"] = r
+                    elif r in ("debug", "info", "warn", "error"):
+                        cmd["level"] = r
+            elif prefix == "health mute":
+                # `ceph health mute <CODE> [<ttl>] [--sticky]`
+                for r in rest:
+                    if r == "--sticky":
+                        cmd["sticky"] = True
+                    elif "code" not in cmd:
+                        cmd["code"] = r
+                    else:
+                        cmd["ttl"] = r
+            elif prefix == "health unmute":
+                if rest:
+                    cmd["code"] = rest[0]
+            elif prefix == "health history":
+                if rest and rest[0].isdigit():
+                    cmd["num"] = int(rest[0])
             elif prefix.startswith("osd erasure-code-profile"):
                 if rest:
                     cmd["name"] = rest[0]

@@ -253,6 +253,29 @@ async def _run(cfg: dict) -> dict:
     )
     io = await client.open_ioctx("chaospool")
 
+    # cluster-event timeline (ISSUE 16): every fault point the harness
+    # arms ships an `audit` entry through the mon's LogMonitor, exactly
+    # like an operator command — the end-of-run asserts reconstruct the
+    # run's story from `log last` output alone
+    armed_points: list[str] = []
+
+    async def _audit_arm(point: str, detail: str) -> None:
+        armed_points.append(point)
+        await client.objecter.monc.send_log([{
+            "prio": "info", "channel": "audit", "who": "client.chaos",
+            "seq": len(armed_points), "stamp": time.time(),
+            "msg": f"from='client.chaos' cmd=fault-arm point={point} "
+                   f"{detail}: dispatch",
+        }])
+
+    async def arm_prob(point: str, one_in: int) -> None:
+        inj.inject_probabilistic(point, one_in)
+        await _audit_arm(point, f"one_in={one_in}")
+
+    async def arm(point: str, err: int, hits: int) -> None:
+        inj.inject(point, err, hits=hits)
+        await _audit_arm(point, f"err={err} hits={hits}")
+
     expected: dict[str, bytes] = {}
 
     async def put(oid: str, nbytes: int) -> None:
@@ -267,7 +290,7 @@ async def _run(cfg: dict) -> dict:
         report["events"].append("baseline written")
 
         # ---- phase 1: socket faults under load --------------------------
-        inj.inject_probabilistic("msgr.send", cfg["sock_one_in"])
+        await arm_prob("msgr.send", cfg["sock_one_in"])
         for i in range(cfg["objects"] // 2):
             await put(f"sock{i}", 8192)
             back = await io.read(f"base{i % cfg['objects']}")
@@ -367,7 +390,7 @@ async def _run(cfg: dict) -> dict:
         # survivor set remains; a read whose EVERY shard answered EIO is
         # correctly failed to the client and retried), later reads run
         # clean as the hit budget drains
-        inj.inject("ec.sub_read", 5, hits=cfg["eio_hits"])
+        await arm("ec.sub_read", 5, cfg["eio_hits"])
         eio_retries = 0
         for i in range(cfg["objects"] // 2):
             oid = f"base{i % cfg['objects']}"
@@ -385,7 +408,7 @@ async def _run(cfg: dict) -> dict:
         report["events"].append("EIO burst reconstructed")
 
         # ---- phase 3: device-launch faults -> host fallback -------------
-        inj.inject("codec.launch", 5, hits=cfg["launch_faults"])
+        await arm("codec.launch", 5, cfg["launch_faults"])
         for i in range(cfg["objects"] // 2):
             await put(f"launch{i}", 2 * 8192)
         inj.clear("codec.launch")
@@ -526,7 +549,7 @@ async def _run(cfg: dict) -> dict:
             nrng.integers(0, 256, (2, 4, 4096), dtype=np.uint8)
             for _ in range(8)
         ]
-        inj.inject("codec.launch", 5, hits=2)
+        await arm("codec.launch", 5, 2)
         tickets = [pagg.submit(ec42, b) for b in batches]
         inj.clear("codec.launch")
         pagg.flush()
@@ -659,8 +682,8 @@ async def _run(cfg: dict) -> dict:
             if r["kind"] == "recovery_wave"
         )
         await storm_victim.stop()
-        inj.inject("ec.recover_push", 5, hits=2)
-        inj.inject("peering.msg", 5, hits=2)
+        await arm("ec.recover_push", 5, 2)
+        await arm("peering.msg", 5, 2)
         await _wait_until(
             lambda: not mons[0].osdmon.osdmap.is_up(storm_victim_id),
             10.0, f"mon marking osd.{storm_victim_id} down",
@@ -952,6 +975,98 @@ async def _run(cfg: dict) -> dict:
         await _wait_until(health_clear, 10.0,
                           "health to settle for the final snapshot")
         report["health_checks"] = mons[0].health_checks()[0]
+
+        # ---- cluster-event timeline (ISSUE 16) --------------------------
+        # The run's story must be reconstructable from `log last` output
+        # ALONE: pull the committed tail once, then derive every verdict
+        # below from that single payload — no daemon introspection.
+        rv, rs, out = await client.mon_command(
+            {"prefix": "log last", "num": 1000}, timeout=10.0
+        )
+        assert rv == 0, f"chaos: log last failed: {rs}"
+        clog_tail = json.loads(out)["entries"]
+        rv, _, out = await client.mon_command(
+            {"prefix": "log last", "num": 1000, "channel": "audit"},
+            timeout=10.0,
+        )
+        assert rv == 0
+        audit_tail = json.loads(out)["entries"]
+        report["clog_entries"] = len(clog_tail)
+        err_entries = [e for e in clog_tail if e.get("prio") == "error"]
+        report["clog_errors"] = len(err_entries)
+        # a healthy converged run carries NO error entries beyond the
+        # ones the harness deliberately caused: the planted scrub
+        # corruption (including the OSD_SCRUB_ERRORS health raise, when
+        # the mon tick catches it before the repair clears it) and the
+        # armed fault points.  A repeat-dedup marker inherits the
+        # collapsed entry's prio, so an error-level "last message
+        # repeated" stands for an already-allowed error.
+        expected_err = ("inconsistent", "crc mismatch", "recovery of",
+                        "backfill push", "RMW read", "encode launch",
+                        "scrub errors", "last message repeated")
+        unexpected = [
+            e["msg"] for e in err_entries
+            if not any(pat in e["msg"] for pat in expected_err)
+        ]
+        assert not unexpected, (
+            f"chaos: unexpected ERR cluster-log entries: {unexpected}"
+        )
+        # every armed fault point produced an audit entry, and so did
+        # the run's mutating mon commands (profile/pool creation)
+        assert all(e.get("channel") == "audit" for e in audit_tail), (
+            "chaos: `log last channel=audit` returned non-audit entries"
+        )
+        audit_msgs = [e["msg"] for e in audit_tail]
+        for point in sorted(set(armed_points)):
+            assert any(f"point={point}" in m for m in audit_msgs), (
+                f"chaos: armed fault point {point} left no audit entry"
+            )
+        assert any("osd pool create" in m for m in audit_msgs), (
+            "chaos: pool creation left no audit entry"
+        )
+        report["audit_entries"] = len(audit_tail)
+
+        # storm-phase reconstruction: the ordered milestone subsequence
+        # (down -> out -> engage -> wave -> complete for the storm
+        # victim; down -> dampened hold -> out for the dead flapper)
+        # must read straight out of the committed log, in order
+        def _subsequence(entries, milestones, start=0):
+            found, pos = [], start
+            for label, pat in milestones:
+                idx = next(
+                    (j for j in range(pos, len(entries))
+                     if pat in entries[j]["msg"]),
+                    -1,
+                )
+                assert idx >= 0, (
+                    f"chaos: timeline milestone {label!r} ({pat!r}) "
+                    f"missing from the cluster log after index {pos}"
+                )
+                found.append(label)
+                pos = idx + 1
+            return found
+
+        storm_timeline = _subsequence(clog_tail, [
+            ("down", f"osd.{storm_victim_id} marked down"),
+            ("out", f"osd.{storm_victim_id} marked out"),
+            ("storm_engaged", "recovery storm ENGAGED"),
+            ("wave", "recovery storm wave"),
+            ("storm_complete", "recovery storm complete"),
+        ])
+        # the dead flapper's final down is its LAST markdown entry; the
+        # dampened hold ("osd.N down Xs; auto-out deferred ...") and the
+        # auto-out must follow it
+        last_down = max(
+            j for j, e in enumerate(clog_tail)
+            if f"osd.{flapper_id} marked down" in e["msg"]
+        )
+        flap_timeline = ["down"] + _subsequence(clog_tail, [
+            ("dampened", f"osd.{flapper_id} down"),
+            ("out", f"osd.{flapper_id} marked out"),
+        ], start=last_down + 1)
+        report["storm_timeline"] = storm_timeline
+        report["flap_timeline"] = flap_timeline
+        report["events"].append("timeline reconstructed from cluster log")
         # lock-order verdict (ISSUE 12 tracked keys): zero violations is
         # part of convergence, and the observed ordering graph rides the
         # JSON so a run's lock hierarchy is inspectable after the fact

@@ -109,11 +109,15 @@ def _completion_ts(rec: dict) -> float:
     )
 
 
-def export_chrome_trace(records: list[dict]) -> dict:
+def export_chrome_trace(records: list[dict], clog: list[dict] | None = None) -> dict:
     """Chrome trace dict from flight records (oldest first — re-sorted
     defensively).  Span-less records (raw dispatch-witness entries)
     render as instant-like 1 µs slices so the timeline still shows
-    them."""
+    them.  ``clog`` (ISSUE 16) takes committed cluster-log entries
+    (the `log last` shape) and renders each as a Perfetto instant
+    event ("i") on a "cluster events" process row, one lane per
+    channel — storm engage/shed, health transitions, and audit
+    commands line up against the device work they explain."""
     events: list[dict] = []
     total_records = len(records)
     # recovery-storm wave records (ISSUE 15) get their own process row
@@ -278,6 +282,26 @@ def export_chrome_trace(records: list[dict]) -> dict:
             "ts": _us(rec.get("dispatch_ts") or rec.get("submit_ts", 0.0)),
             "args": {"bytes": int(rec["hbm_bytes"])},
         })
+    # cluster-events row (ISSUE 16): clog entries as instant events,
+    # one lane per channel.  Entries carry wall-clock stamps while the
+    # flight recorder is monotonic-clocked, so the row is internally
+    # ordered but only loosely aligned to the device rows — the SEQUENCE
+    # (down → storm engage → waves → complete) is the signal.
+    for e in sorted(clog or [], key=lambda e: e.get("stamp", 0.0)):
+        ev = {
+            "name": str(e.get("msg", ""))[:120] or "(empty)",
+            "ph": "i",
+            "s": "t",  # thread-scoped instant: a tick on its lane
+            "pid": "cluster events",
+            "tid": str(e.get("channel", "cluster")),
+            "ts": _us(float(e.get("stamp", 0.0))),
+            "args": {
+                "who": e.get("who", "?"),
+                "severity": e.get("prio", "info"),
+                **({"code": e["code"]} if e.get("code") else {}),
+            },
+        }
+        events.append(ev)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -299,6 +323,16 @@ def validate_chrome_trace(trace: dict) -> None:
     lanes: dict[tuple, int] = {}
     slices = []
     for ev in events:
+        if ev.get("ph") == "i":
+            # instant event (ISSUE 16 cluster-events track): a point in
+            # time, no dur, timestamps may repeat on a lane
+            for key in ("name", "pid", "tid", "ts"):
+                assert key in ev, f"instant event missing {key}: {ev}"
+            assert ev.get("s") in ("t", "p", "g"), (
+                f"instant event with bad scope: {ev}"
+            )
+            assert isinstance(ev["ts"], int) and ev["ts"] >= 0, ev
+            continue
         if ev.get("ph") == "C":
             for key in ("name", "pid", "ts", "args"):
                 assert key in ev, f"counter event missing {key}: {ev}"
@@ -343,10 +377,19 @@ def main(argv: list[str] | None = None) -> int:
     src = ap.add_mutually_exclusive_group()
     src.add_argument("--asok", help="daemon admin socket to dump_flight from")
     src.add_argument("--dump", help="saved dump_flight JSON payload")
+    ap.add_argument("--clog",
+                    help="cluster-log JSON to merge as a 'cluster events' "
+                         "instant-event track (a `log last` payload or a "
+                         "bare entry list)")
     ap.add_argument("-o", "--out", default="-",
                     help="output trace file (default stdout)")
     args = ap.parse_args(argv)
-    trace = export_chrome_trace(_load_records(args))
+    clog = None
+    if args.clog:
+        with open(args.clog) as f:
+            payload = json.load(f)
+        clog = payload["entries"] if isinstance(payload, dict) else payload
+    trace = export_chrome_trace(_load_records(args), clog=clog)
     validate_chrome_trace(trace)
     payload = json.dumps(trace, indent=1)
     if args.out == "-":

@@ -143,6 +143,7 @@ class DevCluster:
             await self.mgr.wait_for_active()
             # standard module set (vstart.sh enables the same four)
             from ..mgr import (
+                ClogModule,
                 DashboardModule,
                 IostatModule,
                 MetricsHistoryModule,
@@ -172,6 +173,10 @@ class DevCluster:
                 # TPU_THROUGHPUT_REGRESSION family of checks work in
                 # the operator path out of the box
                 MetricsHistoryModule(),
+                # cluster-event timeline (ISSUE 16): /api/log on the
+                # dashboard + the ceph_tpu_clog_* / ceph_tpu_health_*
+                # scrape families
+                ClogModule(),
             ):
                 self.mgr.register_module(module)
         if self.with_mds:

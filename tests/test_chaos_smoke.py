@@ -23,7 +23,7 @@ class TestChaosSmoke:
         assert report["converged"], report
         assert report["lost_writes"] == 0, report
         # every chaos phase actually ran
-        assert len(report["events"]) == 10, report["events"]
+        assert len(report["events"]) == 11, report["events"]
         # ISSUE 10: the mixed-load phase attributed the load per pool
         # (windowed p99 keys ride the report for the bench fold), held
         # the SLO burn rate under bound, and kept trace retention
@@ -110,6 +110,17 @@ class TestChaosSmoke:
         assert report["flap_markdowns"] >= 2, report
         assert report["flap_grace_sec"] >= 4.0, report
         assert report["flap_dead_out_wait_sec"] >= 3.0, report
+        # ISSUE 16: the cluster-event timeline — the committed clog tail
+        # was non-empty, carried no unexpected ERR entries (asserted
+        # inside the run), every armed fault point audited, and BOTH
+        # failure stories read straight out of `log last` in order
+        assert report["clog_entries"] >= 1, report
+        assert report["clog_errors"] >= 1, report  # planted corruption
+        assert report["audit_entries"] >= 1, report
+        assert report["storm_timeline"] == [
+            "down", "out", "storm_engaged", "wave", "storm_complete",
+        ], report
+        assert report["flap_timeline"] == ["down", "dampened", "out"], report
         # health settled: no stuck SLOW_OPS, no lingering degraded check
         assert "SLOW_OPS" not in report["health_checks"], report
         assert "TPU_BACKEND_DEGRADED" not in report["health_checks"], report

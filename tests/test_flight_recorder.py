@@ -135,7 +135,12 @@ class TestAggregatedLaunchRecords:
         # kernel/d2h spans exist (may be ~0 when the device finished
         # under the reap, which is exactly what they measure)
         assert rec["kernel_s"] >= 0.0 and rec["d2h_s"] >= 0.0
-        assert not any(rec["flags"].values())
+        # a clean launch raises no FAILURE flags; overlap is benign —
+        # it just means the device finished before the reaper arrived,
+        # which depends on host speed, not on correctness
+        assert not any(
+            v for k, v in rec["flags"].items() if k != "overlap"
+        )
 
     def test_decode_launch_record_has_subspans(self):
         agg = DecodeAggregator(window=2)

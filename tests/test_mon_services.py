@@ -208,6 +208,360 @@ class TestLogMonitor:
         asyncio.run(run())
 
 
+def _slo_digest(*pools: str) -> dict:
+    """A faked mgr PGMap digest slice that raises SLO_LATENCY_BREACH
+    with one detail line per pool (the iostat module's breach shape)."""
+    return {
+        "slo": {
+            "breaches": {
+                str(7 + i): {
+                    "pool": p,
+                    "target_ms": 10.0,
+                    "burn_fast": 2.0,
+                    "burn_slow": 1.5,
+                    "p99_ms": 50.0,
+                }
+                for i, p in enumerate(pools)
+            }
+        }
+    }
+
+
+class TestClusterEventTimeline:
+    """ISSUE 16: LogMonitor paxos semantics — bounded committed tail,
+    quorum convergence, election durability — plus health-event history
+    and the mute lifecycle (TTL, worsen auto-unmute, sticky)."""
+
+    def test_log_flood_bounded_and_quorum_identical(self):
+        """Flooding 3x past `mon_log_max` keeps every member's committed
+        tail bounded AND byte-identical across the quorum; `log last`
+        channel/severity filters slice the same committed tail."""
+
+        async def run():
+            import time
+
+            monmap, mons = await start_mons(3)
+            # satellite: the keep bound is the registered option, not a
+            # baked-in constant — lower it at runtime and flood past it
+            assert mons[0].conf.get_option("mon_log_max").default == 500
+            for m in mons:
+                m.conf.set("mon_log_max", 40)
+            client = Rados(monmap)
+            await client.connect()
+            monc = client.objecter.monc
+
+            for i in range(120):
+                await monc.send_log(
+                    [
+                        {
+                            "prio": "error" if i % 3 == 0 else "info",
+                            "channel": "audit" if i % 5 == 0 else "cluster",
+                            "who": "client.flood",
+                            "seq": i + 1,
+                            "stamp": time.time(),
+                            "msg": f"flood entry {i}",
+                        }
+                    ]
+                )
+            await wait_until(
+                lambda: any(
+                    "flood entry 119" in e["msg"] for e in mons[0].logmon.entries
+                ),
+                5.0,
+                "flood committed",
+            )
+            await wait_until(
+                lambda: all(
+                    m.logmon.version == mons[0].logmon.version for m in mons
+                ),
+                3.0,
+                "log versions converge",
+            )
+            lead = [(e["who"], e.get("seq"), e["msg"]) for e in mons[0].logmon.entries]
+            for m in mons:
+                assert 0 < len(m.logmon.entries) <= 40, len(m.logmon.entries)
+                assert [
+                    (e["who"], e.get("seq"), e["msg"]) for e in m.logmon.entries
+                ] == lead
+
+            # channel filter slices the committed tail
+            rv, _, out = await client.mon_command(
+                {"prefix": "log last", "num": 1000, "channel": "audit"}
+            )
+            assert rv == 0
+            got = json.loads(out)["entries"]
+            assert got and all(e["channel"] == "audit" for e in got)
+            # severity filter is an exact match, not a floor
+            rv, _, out = await client.mon_command(
+                {"prefix": "log last", "num": 1000, "level": "error"}
+            )
+            got = json.loads(out)["entries"]
+            assert got and all(e["prio"] == "error" for e in got)
+
+            await client.shutdown()
+            await stop_cluster(mons, [])
+
+        asyncio.run(run())
+
+    def test_election_preserves_committed_entries(self):
+        """Committed clog entries survive losing the leader: the new
+        quorum serves the same tail and keeps accepting appends."""
+
+        async def run():
+            import time
+
+            monmap, mons = await start_mons(3)
+            client = Rados(monmap)
+            await client.connect()
+            monc = client.objecter.monc
+            for i in range(10):
+                await monc.send_log(
+                    [
+                        {
+                            "prio": "info",
+                            "channel": "cluster",
+                            "who": "client.pre",
+                            "seq": i + 1,
+                            "stamp": time.time(),
+                            "msg": f"pre-election {i}",
+                        }
+                    ]
+                )
+            await wait_until(
+                lambda: all(
+                    any("pre-election 9" in e["msg"] for e in m.logmon.entries)
+                    for m in mons
+                ),
+                5.0,
+                "pre-election entries committed everywhere",
+            )
+            committed = [
+                e["msg"] for e in mons[1].logmon.entries
+                if e["msg"].startswith("pre-election")
+            ]
+            assert len(committed) == 10
+
+            await mons[0].stop()
+            mons[1].elector.start()
+            await wait_until(
+                lambda: any(m.is_leader() for m in mons[1:]),
+                5.0,
+                "re-election",
+            )
+            # every committed entry survived on both survivors (the new
+            # leader's health tick may append MON_DOWN lines on top)
+            for m in mons[1:]:
+                msgs = [e["msg"] for e in m.logmon.entries]
+                assert all(c in msgs for c in committed), msgs
+            # ...and the new quorum keeps accepting appends (a command
+            # first: send_log is best-effort at the monc's current
+            # target, and that target just died — the command hunt
+            # re-points the monc at a live mon)
+            rv, _, _ = await client.mon_command({"prefix": "health"})
+            assert rv == 0
+            await monc.send_log(
+                [
+                    {
+                        "prio": "info",
+                        "channel": "cluster",
+                        "who": "client.post",
+                        "seq": 1,
+                        "stamp": time.time(),
+                        "msg": "post-election entry",
+                    }
+                ]
+            )
+            await wait_until(
+                lambda: all(
+                    any("post-election" in e["msg"] for e in m.logmon.entries)
+                    for m in mons[1:]
+                ),
+                5.0,
+                "post-election append committed",
+            )
+
+            await client.shutdown()
+            await stop_cluster(mons[1:], [])
+
+        asyncio.run(run())
+
+    def test_health_mute_ttl_worsen_sticky(self):
+        """The mute lifecycle on one mon: TTL expiry re-raises the
+        banner, a non-sticky mute auto-clears when the check worsens, a
+        sticky mute survives worsening, and the history command shows
+        the raise/update transitions."""
+
+        async def run():
+            monmap, mons = await start_mons(1)
+            mon = mons[0]
+            mon.conf.set("mon_tick_interval", 0.05)
+            client = Rados(monmap)
+            await client.connect()
+
+            mon.pg_digest = _slo_digest("cacheA")
+            await wait_until(
+                lambda: "SLO_LATENCY_BREACH" in mon.logmon.active_checks,
+                5.0,
+                "SLO check raised",
+            )
+            rv, _, out = await client.mon_command({"prefix": "health"})
+            h = json.loads(out)
+            assert h["status"] == "HEALTH_WARN"
+            assert "SLO_LATENCY_BREACH" in h["checks"]
+
+            # TTL mute: banner goes green, the raw check keeps being
+            # evaluated underneath, and expiry re-raises the banner
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "health mute", "code": "SLO_LATENCY_BREACH",
+                 "ttl": "1s"}
+            )
+            assert rv == 0 and "muted" in rs, rs
+            rv, _, out = await client.mon_command({"prefix": "health"})
+            h = json.loads(out)
+            assert h["status"] == "HEALTH_OK"
+            assert "SLO_LATENCY_BREACH" in h["muted"]
+            assert "SLO_LATENCY_BREACH" not in h["checks"]
+            assert "SLO_LATENCY_BREACH" in mon.health_checks()[0]
+            await wait_until(
+                lambda: "SLO_LATENCY_BREACH" not in mon.logmon.mutes,
+                5.0,
+                "ttl expiry committed",
+            )
+            rv, _, out = await client.mon_command({"prefix": "health"})
+            assert json.loads(out)["status"] == "HEALTH_WARN"
+            assert any(
+                "health mute SLO_LATENCY_BREACH expired" in e["msg"]
+                for e in mon.logmon.entries
+            )
+
+            # non-sticky mute auto-clears when the check worsens
+            rv, _, _ = await client.mon_command(
+                {"prefix": "health mute", "code": "SLO_LATENCY_BREACH"}
+            )
+            assert rv == 0
+            assert "SLO_LATENCY_BREACH" in mon.logmon.mutes
+            mon.pg_digest = _slo_digest("cacheA", "cacheB")  # 1 -> 2 pools
+            await wait_until(
+                lambda: "SLO_LATENCY_BREACH" not in mon.logmon.mutes,
+                5.0,
+                "worsen auto-unmute",
+            )
+            assert any(
+                "check worsened (1 -> 2)" in e["msg"]
+                for e in mon.logmon.entries
+            )
+            rv, _, out = await client.mon_command({"prefix": "health"})
+            assert json.loads(out)["status"] == "HEALTH_WARN"
+
+            # a sticky mute survives the same worsening
+            rv, _, _ = await client.mon_command(
+                {"prefix": "health mute", "code": "SLO_LATENCY_BREACH",
+                 "sticky": True}
+            )
+            assert rv == 0
+            mon.pg_digest = _slo_digest("cacheA", "cacheB", "cacheC")
+            await asyncio.sleep(0.4)  # several leader ticks
+            assert "SLO_LATENCY_BREACH" in mon.logmon.mutes
+            rv, _, out = await client.mon_command({"prefix": "health"})
+            assert json.loads(out)["status"] == "HEALTH_OK"
+
+            # the history shows the transitions and the live mute
+            rv, _, out = await client.mon_command({"prefix": "health history"})
+            body = json.loads(out)
+            assert body["events_total"] >= 2
+            kinds = {(ev["type"], ev["code"]) for ev in body["events"]}
+            assert ("raise", "SLO_LATENCY_BREACH") in kinds
+            assert ("update", "SLO_LATENCY_BREACH") in kinds
+            assert "SLO_LATENCY_BREACH" in body["mutes"]
+            assert body["mutes"]["SLO_LATENCY_BREACH"]["sticky"] is True
+
+            # unmute; a second unmute is ENOENT, an empty code EINVAL
+            rv, _, _ = await client.mon_command(
+                {"prefix": "health unmute", "code": "SLO_LATENCY_BREACH"}
+            )
+            assert rv == 0
+            rv, _, _ = await client.mon_command(
+                {"prefix": "health unmute", "code": "SLO_LATENCY_BREACH"}
+            )
+            assert rv == -2
+            rv, _, _ = await client.mon_command(
+                {"prefix": "health mute", "code": ""}
+            )
+            assert rv == -22
+
+            await client.shutdown()
+            await stop_cluster(mons, [])
+
+        asyncio.run(run())
+
+    def test_muted_check_survives_election(self):
+        """ISSUE 16 acceptance: mute SLO_LATENCY_BREACH, the banner goes
+        HEALTH_OK while the raw check keeps being evaluated, the mute
+        replicates to every quorum member via paxos, and it survives
+        losing the leader."""
+
+        async def run():
+            monmap, mons = await start_mons(3)
+            for m in mons:
+                m.conf.set("mon_tick_interval", 0.05)
+                m.pg_digest = _slo_digest("hotpool")
+            client = Rados(monmap)
+            await client.connect()
+
+            await wait_until(
+                lambda: "SLO_LATENCY_BREACH" in mons[0].logmon.active_checks,
+                5.0,
+                "SLO check raised",
+            )
+            rv, rs, _ = await client.mon_command(
+                {"prefix": "health mute", "code": "SLO_LATENCY_BREACH"}
+            )
+            assert rv == 0, rs
+            rv, _, out = await client.mon_command({"prefix": "health"})
+            h = json.loads(out)
+            assert h["status"] == "HEALTH_OK"
+            assert "SLO_LATENCY_BREACH" in h["muted"]
+            # the mute is committed state on EVERY member, and the raw
+            # check is still evaluated (still scraped) underneath
+            await wait_until(
+                lambda: all(
+                    "SLO_LATENCY_BREACH" in m.logmon.mutes for m in mons
+                ),
+                3.0,
+                "mute replicated to quorum",
+            )
+            assert "SLO_LATENCY_BREACH" in mons[0].health_checks()[0]
+            # the mutating command landed on the audit channel everywhere
+            await wait_until(
+                lambda: any(
+                    e["channel"] == "audit" and "health mute" in e["msg"]
+                    for e in mons[1].logmon.entries
+                ),
+                3.0,
+                "mute audited",
+            )
+
+            # leader dies; survivors elect; the mute rode paxos
+            await mons[0].stop()
+            mons[1].elector.start()
+            await wait_until(
+                lambda: any(m.is_leader() for m in mons[1:]),
+                5.0,
+                "re-election",
+            )
+            for m in mons[1:]:
+                assert "SLO_LATENCY_BREACH" in m.logmon.mutes
+            rv, _, out = await client.mon_command({"prefix": "health"})
+            h = json.loads(out)
+            assert "SLO_LATENCY_BREACH" in h["muted"]
+            assert "SLO_LATENCY_BREACH" not in h["checks"]
+
+            await client.shutdown()
+            await stop_cluster(mons[1:], [])
+
+        asyncio.run(run())
+
+
 class TestAuthMonitor:
     def test_key_crud_replicates(self):
         async def run():
