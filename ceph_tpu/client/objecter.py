@@ -222,6 +222,24 @@ class Objecter(Dispatcher):
             0.5 + self._backoff_rng.random() / 2.0
         )
 
+    def _backoff_or_timeout(self, deadline, attempt, reqid, oid,
+                            span) -> float:
+        """Resend pacing with fail-fast (ISSUE 17 bugfix): returns the
+        backoff delay to sleep before the retry, or raises TimeoutError
+        NOW when the op's deadline lands inside that backoff — the old
+        `min(remaining, delay)` shape slept the deadline away and only
+        noticed at the top of the loop, turning a doomed op's last
+        moments into a pointless wait for a retry it could not use."""
+        delay = self._backoff_delay(attempt)
+        if deadline - time.monotonic() <= delay:
+            span.event("deadline exhausted mid-backoff: fail fast")
+            self.perf.inc("op_timeout")
+            raise TimeoutError(
+                f"op {reqid.key()} on {oid} timed out "
+                "(deadline inside resend backoff)"
+            )
+        return delay
+
     async def _op_submit(
         self, pool_id, oid, ops, timeout, ps, snap_seq, snaps, snap_id,
         reqid, span,
@@ -260,6 +278,12 @@ class Objecter(Dispatcher):
                 snap_id=snap_id,
             )
             tracer_mod.inject(span, msg)
+            # end-to-end deadline propagation (ISSUE 17): the op's
+            # remaining budget rides the envelope so the OSD can shed
+            # already-expired work at admission and EC sub-reads inherit
+            # the budget instead of pinning shard sources for a reply
+            # nobody is waiting for
+            msg.deadline = deadline
             fut: asyncio.Future = asyncio.get_event_loop().create_future()
             self._replies[reqid.tid] = fut
             try:
@@ -273,22 +297,33 @@ class Objecter(Dispatcher):
                 # (or a backoff delay) and resend — Objecter's resend
                 # loop, paced so client fleets don't retry in lockstep.
                 span.event("resend: connection lost or reply timed out")
-                self.perf.inc("op_resend")
                 self._replies.pop(reqid.tid, None)
-                await self._wait_map_change(
-                    min(remaining, self._backoff_delay(attempt))
-                )
+                delay = self._backoff_or_timeout(deadline, attempt, reqid,
+                                                 oid, span)
+                self.perf.inc("op_resend")
+                await self._wait_map_change(delay)
                 attempt += 1
                 continue
             if reply.result == -EAGAIN:
                 # Not primary / not yet active: refresh + retry.
                 span.event("resend: target not active (-EAGAIN)")
+                delay = self._backoff_or_timeout(deadline, attempt, reqid,
+                                                 oid, span)
                 self.perf.inc("op_resend")
-                await self._wait_map_change(
-                    min(remaining, self._backoff_delay(attempt))
-                )
+                await self._wait_map_change(delay)
                 attempt += 1
                 continue
+            if reply.result == -ETIMEDOUT:
+                # the OSD shed this op at admission (ISSUE 17): its
+                # deadline expired in flight/queue, so it was never
+                # executed — surface the same TimeoutError a local
+                # expiry raises instead of handing back a corpse
+                span.event("osd shed op at admission (-ETIMEDOUT)")
+                self.perf.inc("op_timeout")
+                raise TimeoutError(
+                    f"op {reqid.key()} on {oid} timed out "
+                    "(shed at osd admission)"
+                )
             span.event("reply received")
             self.perf.inc("op_reply")
             return reply

@@ -32,6 +32,13 @@ class FaultInjector:
         self._lock = make_lock("fault_injector")
         self._points: dict[str, tuple[int, int]] = {}  # name -> (errno, hits)
         self._probabilistic: dict[str, float] = {}  # name -> probability
+        # delay_ms latency mode (ISSUE 17): name -> (delay_ms, hits, who).
+        # A delayed point is slow, not failed — the gray-failure shape.
+        # `who` scopes the delay to one caller identity ("osd.3"): the
+        # injector is process-global, but a GRAY failure is one slow
+        # daemon among healthy ones, so the harness must be able to
+        # slow a single victim ("" = every caller, the legacy shape)
+        self._delays: dict[str, tuple[float, int, str]] = {}
         self._rng = random.Random(0xEC)
 
     def inject(self, point: str, err: int, hits: int = -1) -> None:
@@ -47,14 +54,52 @@ class FaultInjector:
             else:
                 self._probabilistic[point] = 1.0 / one_in
 
+    def inject_delay(
+        self, point: str, delay_ms: float, hits: int = -1, who: str = ""
+    ) -> None:
+        """Arm a LATENCY fault: the next `hits` checks at `point` report
+        a pending delay of `delay_ms` (hits<0 = forever, <= 0 ms clears).
+        Unlike `inject`, the seam stays functionally correct — callers
+        apply the delay async-safely (sleep / call_later), never raise.
+        `who` restricts the delay to one caller identity (e.g. "osd.3"):
+        with daemons sharing one process-global injector, this is how a
+        harness slows a single gray victim while its peers stay fast."""
+        with self._lock:
+            if delay_ms <= 0:
+                self._delays.pop(point, None)
+            else:
+                self._delays[point] = (delay_ms, hits, who)
+
+    def check_delay(self, point: str, who: str = "") -> float:
+        """Pending injected delay in SECONDS for one pass through `point`
+        (0.0 = none).  Decrements the hit budget like `check`.  A delay
+        armed with a `who` scope only fires (and only spends hits) for
+        the matching caller identity."""
+        with self._lock:
+            armed = self._delays.get(point)
+            if armed is None:
+                return 0.0
+            delay_ms, hits, scope = armed
+            if scope and scope != who:
+                return 0.0
+            if hits > 0:
+                hits -= 1
+                if hits == 0:
+                    del self._delays[point]
+                else:
+                    self._delays[point] = (delay_ms, hits, scope)
+            return delay_ms / 1000.0
+
     def clear(self, point: str | None = None) -> None:
         with self._lock:
             if point is None:
                 self._points.clear()
                 self._probabilistic.clear()
+                self._delays.clear()
             else:
                 self._points.pop(point, None)
                 self._probabilistic.pop(point, None)
+                self._delays.pop(point, None)
 
     def check(self, point: str) -> None:
         """Call at the injection point; raises InjectedFailure if armed."""
@@ -75,7 +120,11 @@ class FaultInjector:
 
     def armed(self, point: str) -> bool:
         with self._lock:
-            return point in self._points or point in self._probabilistic
+            return (
+                point in self._points
+                or point in self._probabilistic
+                or point in self._delays
+            )
 
 
 # The injection-point catalog: every name wired through `faultpoint()`
@@ -87,7 +136,10 @@ FAULT_POINTS: dict[str, str] = {
     "msgr.send": (
         "messenger frame send, checked before any bytes reach the wire "
         "(ms_inject_socket_failures semantics: lossy connections reset, "
-        "lossless ones transparently reconnect and resend)"
+        "lossless ones transparently reconnect and resend).  In "
+        "delay_ms mode the frame is held for the injected latency with "
+        "an async-safe sleep before it is written — a slow NIC, not a "
+        "dead one"
     ),
     "msgr.recv": (
         "messenger frame receive, checked after a frame is read; faults "
@@ -108,7 +160,10 @@ FAULT_POINTS: dict[str, str] = {
     "ec.sub_read": (
         "EC shard-side sub-read in ECBackend.handle_sub_read: the shard "
         "answers with a per-object EIO, driving redundant-read "
-        "escalation and reconstruction on the primary"
+        "escalation and reconstruction on the primary.  In delay_ms "
+        "mode the shard answers CORRECTLY but late (the reply is "
+        "deferred on the event loop, never blocking it) — the gray "
+        "failure that drives adaptive hedged reads"
     ),
     "codec.launch": (
         "device coding-launch submit in LaunchAggregator._launch: the "
@@ -151,3 +206,16 @@ def faultpoint(point: str) -> None:
     if point not in FAULT_POINTS:
         raise ValueError(f"unregistered fault point {point!r}")
     _global.check(point)
+
+
+def faultpoint_delay(point: str, who: str = "") -> float:
+    """Pending injected delay (seconds) for a REGISTERED point on the
+    process-global injector — the latency twin of `faultpoint()`.  The
+    caller owns applying it async-safely (`await asyncio.sleep(d)` on
+    the messenger path, `loop.call_later(d, ...)` around a synchronous
+    reply) so an injected delay can never block the event loop.  `who`
+    is the caller's daemon identity ("osd.3"); a delay armed with a
+    scope only fires for the matching caller."""
+    if point not in FAULT_POINTS:
+        raise ValueError(f"unregistered fault point {point!r}")
+    return _global.check_delay(point, who)

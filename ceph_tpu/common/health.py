@@ -33,6 +33,32 @@ def slow_ops_detail(slow: dict[str, dict]) -> list[str]:
     ]
 
 
+def slow_peer_summary(laggy: dict[int, dict]) -> str | None:
+    """The OSD_SLOW_PEER check summary for a laggy-OSD slice
+    ({osd_id: {reporters, rtt_ms, since_sec}}), or None when no peer is
+    laggy (ISSUE 17).  Non-fatal by construction: these OSDs answer
+    heartbeats — slowly — so the check is a WARN and never feeds a
+    markdown."""
+    if not laggy:
+        return None
+    worst = max(v.get("rtt_ms", 0.0) for v in laggy.values())
+    return (
+        f"{len(laggy)} osd(s) laggy — heartbeats answer but service is "
+        f"slow (worst rtt ewma {worst:.0f} ms): "
+        f"[{','.join(f'osd.{o}' for o in sorted(laggy))}]"
+    )
+
+
+def slow_peer_detail(laggy: dict[int, dict]) -> list[str]:
+    """Per-OSD breakdown lines (`health detail`)."""
+    return [
+        f"osd.{o}: laggy for {v.get('since_sec', 0.0):.0f} sec, rtt ewma "
+        f"{v.get('rtt_ms', 0.0):.0f} ms, reported by "
+        f"[{','.join(str(r) for r in v.get('reporters', []))}]"
+        for o, v in sorted(laggy.items())
+    ]
+
+
 def tpu_degraded_summary(degraded: dict[str, dict]) -> str | None:
     """The TPU_BACKEND_DEGRADED check summary for a per-daemon degraded
     slice ({daemon: {degraded_for_sec, reason, fallback_launches}}), or

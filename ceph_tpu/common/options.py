@@ -566,6 +566,42 @@ OPTIONS: dict[str, Option] = _opts(
     Option("osd_heartbeat_grace", float, 6.0, A,
            "seconds without reply before reporting failure "
            "(OSDMonitor.cc:3240)", runtime=True),
+    # --- gray-failure tolerance (ISSUE 17; osd/ec_backend.py hedging,
+    # --- osd laggy detection) -----------------------------------------------
+    Option("osd_ec_hedge_quantile", float, 3.0, A,
+           "hedge trigger as a multiple of the shard source's EWMA "
+           "sub-read latency: an outstanding EC sub-read older than "
+           "quantile x the peer's smoothed round-trip (floored at "
+           "osd_ec_hedge_min_ms) triggers one speculative read to an "
+           "unused shard source; first k replies win through the "
+           "redundant-read escalation path, the loser is reaped when "
+           "its tid completes.  <= 0 disables hedging",
+           see_also=("osd_ec_hedge_min_ms",
+                     "osd_ec_hedge_budget_percent"), runtime=True),
+    Option("osd_ec_hedge_min_ms", float, 10.0, A,
+           "floor (ms) under the EWMA-scaled hedge threshold: sub-reads "
+           "younger than this never hedge, so microsecond-fast healthy "
+           "clusters do not hedge on scheduling noise",
+           see_also=("osd_ec_hedge_quantile",), runtime=True),
+    Option("osd_ec_hedge_budget_percent", float, 5.0, A,
+           "token-bucket hedge budget as a percentage of issued "
+           "sub-reads (burst = 10 tokens): each sub-read earns "
+           "percent/100 of a token, each hedge spends one, and an empty "
+           "bucket falls back to plain waiting — a cluster-wide "
+           "slowdown cannot melt itself with speculative load.  "
+           "<= 0 removes the cap",
+           see_also=("osd_ec_hedge_quantile",), runtime=True),
+    Option("osd_heartbeat_slow_factor", float, 8.0, A,
+           "laggy-peer threshold: a peer whose EWMA ping RTT (or EC "
+           "sub-read service time) inflates past this multiple of the "
+           "cluster-median peer RTT (floored at 10 ms absolute) is "
+           "reported to the mon as LAGGY — a non-fatal OSD_SLOW_PEER "
+           "health warn, never an auto-down/out; primaries deprioritize "
+           "the peer as an EC read source and hedge it preemptively.  "
+           "The report clears when the peer's RTT recovers.  <= 0 "
+           "disables laggy detection",
+           see_also=("osd_heartbeat_grace",
+                     "osd_ec_hedge_quantile"), runtime=True),
     Option("osd_scrub_interval", float, 0.0, A,
            "periodic scrub interval; 0 disables the timer"),
     Option("osd_pool_default_pg_num", int, 8, B, ""),

@@ -175,6 +175,27 @@ class PerfCounters:
         with self._lock:
             self._counters[name].hist.sample(x, y)
 
+    def ensure_histogram(
+        self,
+        name: str,
+        desc: str = "",
+        lowest: float = 1e-6,
+        buckets: int = 25,
+    ) -> None:
+        """Lazily declare a 1D log2 histogram OUTSIDE the builder —
+        for per-peer families whose membership is unknown at daemon
+        construction (the osd_heartbeat_rtt_osd_<N> family, ISSUE 17).
+        Idempotent; an existing counter of any type is left alone."""
+        with self._lock:
+            if name in self._counters:
+                return
+            self._counters[name] = _Counter(
+                name,
+                PERFCOUNTER_TIME | PERFCOUNTER_HISTOGRAM,
+                desc,
+                hist=PerfHistogram(PerfHistogramAxis(lowest, buckets)),
+            )
+
     def get(self, name: str) -> float:
         with self._lock:
             return self._counters[name].value

@@ -263,6 +263,12 @@ class Mgr(Dispatcher):
             # per-pool SLO burn-rate slice: the mon-side
             # SLO_LATENCY_BREACH check reads `breaches`
             "slo": self._module_digest("slo_digest"),
+            # gray-failure slice (ISSUE 17): per-daemon laggy-peer views
+            # and hedge/shed ledgers from the OSD status blobs — the
+            # evidence trail beside the mon's own OSD_SLOW_PEER state
+            # (which rides the direct MOSDFailure(laggy) path, not this
+            # digest) and the chaos harness's hedge-rate assertions
+            "slow_peers": self.slow_peers_by_daemon(),
             # trend-sentinel slice from the metrics-history module
             # (ISSUE 14): raised TPU_THROUGHPUT_REGRESSION /
             # TPU_OCCUPANCY_COLLAPSE / TPU_QUEUE_WAIT_INFLATION checks
@@ -285,6 +291,23 @@ class Mgr(Dispatcher):
         """The registered progress module's digest slice, or {} when the
         module isn't loaded."""
         return self._module_digest("progress_digest")
+
+    def slow_peers_by_daemon(self) -> dict[str, dict]:
+        """Per-daemon gray-failure views (ISSUE 17): which peers each
+        OSD currently flags laggy plus its hedge/deadline-shed counters.
+        Daemons seeing no laggy peers and holding all-zero ledgers are
+        elided; a down daemon's stale view is dropped like slow-ops."""
+        out: dict[str, dict] = {}
+        for daemon, st in self.daemons.items():
+            sp = (st.status or {}).get("slow_peers") or {}
+            if not sp.get("laggy") and not any(
+                v for k, v in sp.items() if k != "laggy"
+            ):
+                continue
+            if not self._daemon_report_live(daemon):
+                continue
+            out[daemon] = dict(sp)
+        return out
 
     def tpu_degraded_by_daemon(self) -> dict[str, dict]:
         """Daemons reporting a DEGRADED device backend (the OSD status'

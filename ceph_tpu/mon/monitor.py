@@ -463,6 +463,16 @@ class Monitor(Dispatcher):
                 + ", ".join(f"osd.{o}" for o in sorted(down))
             )
             details["OSD_DOWN"] = [f"osd.{o} is down" for o in sorted(down)]
+        # slow-but-alive peers (ISSUE 17): laggy evidence from the OSDs'
+        # heartbeat/sub-read RTT reports (OSDMonitor.laggy).  Non-fatal
+        # — the target serves I/O, slowly — so a WARN, never a markdown;
+        # clears when reporters send the recovery report or their
+        # evidence expires
+        laggy = self.osdmon.slow_peers()
+        summary = health.slow_peer_summary(laggy)
+        if summary:
+            checks["OSD_SLOW_PEER"] = summary
+            details["OSD_SLOW_PEER"] = health.slow_peer_detail(laggy)
         if len(self.quorum) < self.monmap.size():
             out = self.monmap.size() - len(self.quorum)
             checks["MON_DOWN"] = f"{out} monitor(s) out of quorum"

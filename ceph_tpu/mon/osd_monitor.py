@@ -66,6 +66,18 @@ class OSDMonitor:
         # reference's mon_osd_auto_mark_auto_out_in), unlike an
         # operator's explicit `osd out` which sticks
         self._auto_outed: set[int] = set()
+        # laggy (slow-but-alive) OSDs (ISSUE 17): target -> {reporter:
+        # {at, rtt}} evidence from MOSDFailure(laggy=1) reports, plus
+        # the episode's start stamp.  NON-FATAL: no osdmap mutation, no
+        # auto-out — only the OSD_SLOW_PEER health warn and a clog
+        # event per episode (set and clear, dampened like ISSUE 13's
+        # markdown timeline: one entry per transition, never per report)
+        self.laggy: dict[int, dict] = {}
+        # seconds laggy evidence stays valid without a refresh: reports
+        # re-send on the reporter's heartbeat-grace cadence, so 3x the
+        # failure-report expiry forgives a couple of lost beacons while
+        # still self-clearing if the reporter dies mid-episode
+        self.laggy_report_expiry = 3 * self.report_expiry
         # queued mutations: (mutate(map) -> rs, reply or None)
         self._pending: list[tuple[Callable, Callable | None]] = []
         self._proposing = False
@@ -229,6 +241,11 @@ class OSDMonitor:
         from a long-past blip must not combine with a fresh one to mark
         a healthy OSD down (failure_info_t's report window)."""
         target = msg.target
+        if getattr(msg, "laggy", 0):
+            # laggy reports branch BEFORE the is_up gate: a laggy target
+            # is by definition still up (it answers heartbeats — slowly)
+            self._handle_laggy_report(msg, reporter)
+            return
         if not self.osdmap.is_up(target):
             return
         now = time.monotonic()
@@ -245,6 +262,9 @@ class OSDMonitor:
             return
         nrep = len(reporters)
         self.failure_reports.pop(target, None)
+        # a quorum-confirmed death retires any laggy episode: dead beats
+        # slow, and OSD_DOWN must not double-bill as OSD_SLOW_PEER
+        self._laggy_retire(target, reason="marked down")
         self._note_markdown(target, now)
 
         def mutate(m: OSDMap) -> str:
@@ -316,6 +336,84 @@ class OSDMonitor:
             "dampened_holds": self.dampened_holds,
             "osds": per_osd,
         }
+
+    # -- laggy (slow-but-alive) OSDs (ISSUE 17) -------------------------------
+
+    def _handle_laggy_report(self, msg: MOSDFailure, reporter: str) -> None:
+        """A peer reports the target LAGGY (laggy=1, failed_for carries
+        the reporter's RTT EWMA) or recovered (laggy=2).  Pure health
+        state: no osdmap mutation, no markdown, no auto-out — the
+        target still serves I/O, just slowly.  One clog entry per
+        episode edge (set/clear), never per report."""
+        target = msg.target
+        now = time.monotonic()
+        if msg.laggy == 2:
+            ent = self.laggy.get(target)
+            if ent is None:
+                return
+            ent["reporters"].pop(reporter, None)
+            if not ent["reporters"]:
+                self._laggy_retire(target, reason="recovered")
+            return
+        if not self.osdmap.is_up(target):
+            return  # dead beats laggy
+        ent = self.laggy.setdefault(
+            target, {"reporters": {}, "since": now, "new": True}
+        )
+        ent["reporters"][reporter] = {"at": now, "rtt": float(msg.failed_for)}
+        self._prune_laggy(target, now)
+        if ent.get("new") and target in self.laggy:
+            ent["new"] = False
+            rtt_ms = max(
+                r["rtt"] for r in ent["reporters"].values()
+            ) * 1000.0
+            self._clog(
+                "warn",
+                f"osd.{target} reported laggy by {reporter} "
+                f"(rtt ewma {rtt_ms:.0f} ms): heartbeats answer but "
+                "service is slow",
+                code="OSD_SLOW_PEER",
+            )
+
+    def _prune_laggy(self, target: int, now: float) -> None:
+        """Expire stale laggy evidence; retire the episode when the last
+        reporter ages out (a reporter that died mid-episode must not
+        pin a recovered OSD in OSD_SLOW_PEER forever)."""
+        ent = self.laggy.get(target)
+        if ent is None:
+            return
+        for r, info in list(ent["reporters"].items()):
+            if now - info["at"] > self.laggy_report_expiry:
+                del ent["reporters"][r]
+        if not ent["reporters"]:
+            self._laggy_retire(target, reason="reports expired")
+
+    def _laggy_retire(self, target: int, reason: str) -> None:
+        ent = self.laggy.pop(target, None)
+        if ent is None or ent.get("new"):
+            return  # never surfaced: no clear entry for an unlogged set
+        self._clog(
+            "info", f"osd.{target} no longer laggy ({reason})",
+            code="OSD_SLOW_PEER",
+        )
+
+    def slow_peers(self) -> dict[int, dict]:
+        """Current laggy OSDs for the health surface: target -> episode
+        summary (reporters, worst reported RTT EWMA, age)."""
+        now = time.monotonic()
+        for target in list(self.laggy):
+            self._prune_laggy(target, now)
+        out: dict[int, dict] = {}
+        for target, ent in self.laggy.items():
+            out[target] = {
+                "reporters": sorted(ent["reporters"]),
+                "rtt_ms": round(
+                    max(r["rtt"] for r in ent["reporters"].values()) * 1000.0,
+                    3,
+                ),
+                "since_sec": round(now - ent["since"], 3),
+            }
+        return out
 
     # -- commands --------------------------------------------------------------
 
@@ -517,6 +615,11 @@ class OSDMonitor:
         if not self.mon.is_leader():
             return
         self._tick_down_out()
+        # expire stale laggy evidence even when nobody reads health: the
+        # clog clear must fire from the timeline, not a status request
+        now = time.monotonic()
+        for target in list(self.laggy):
+            self._prune_laggy(target, now)
         stats = (self.mon.pg_digest or {}).get("pools", {})
         for p in list(self.osdmap.pools.values()):
             if not p.quota_max_bytes and not p.quota_max_objects:

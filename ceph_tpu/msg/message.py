@@ -124,6 +124,11 @@ class Message(Encodable):
     # 0 = no decision (untraced / legacy sender), 1 = sampled (keep),
     # 2 = head-sampled out (downstream spans stay provisional)
     trace_sampled = 0
+    # end-to-end op deadline (ISSUE 17): absolute time.monotonic() stamp
+    # set by the client; receivers shed already-expired work instead of
+    # executing it.  Valid because every daemon shares one process clock
+    # (the MOSDPing.stamp precedent).  0.0 = no deadline
+    deadline = 0.0
 
     def __init__(self, **kwargs):
         self.src = ""
@@ -133,7 +138,7 @@ class Message(Encodable):
         for k, v in kwargs.items():
             if k not in {n for n, _ in self.FIELDS} | {
                 "src", "seq", "priority", "trace_id", "span_id",
-                "trace_sampled",
+                "trace_sampled", "deadline",
             }:
                 raise TypeError(f"{type(self).__name__} has no field {k}")
             setattr(self, k, v)
@@ -173,6 +178,7 @@ def encode_message(msg: Message) -> tuple[bytes, bytes]:
         .u64(msg.trace_id)
         .u64(msg.span_id)
         .u8(msg.trace_sampled)
+        .f64(msg.deadline)
         .tobytes()
     )
     return env, msg.tobytes()
@@ -187,6 +193,7 @@ def decode_message(envelope: bytes, payload: bytes) -> Message:
     trace_id = d.u64()
     span_id = d.u64()
     trace_sampled = d.u8()
+    deadline = d.f64()
     cls = _REGISTRY.get(type_id)
     if cls is None:
         raise ValueError(f"unknown message type {type_id}")
@@ -197,4 +204,5 @@ def decode_message(envelope: bytes, payload: bytes) -> Message:
     msg.trace_id = trace_id
     msg.span_id = span_id
     msg.trace_sampled = trace_sampled
+    msg.deadline = deadline
     return msg

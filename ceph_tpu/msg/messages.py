@@ -293,7 +293,19 @@ class MOSDFailure(Message):
         ("target_addr", "str"),
         ("failed_for", "f64"),
         ("epoch", "u32"),
+        # ISSUE 17: 0 = dead (unresponsive, the classic report); 1 =
+        # laggy (heartbeats answered but slow — the gray-failure state:
+        # mon surfaces OSD_SLOW_PEER, never marks down); 2 = laggy
+        # cleared (the reporter's peer recovered)
+        ("laggy", "u8"),
     ]
+
+    def __init__(self, target=0, target_addr="", failed_for=0.0,
+                 epoch=0, laggy=0, **kw):
+        super().__init__(
+            target=target, target_addr=target_addr,
+            failed_for=failed_for, epoch=epoch, laggy=laggy, **kw,
+        )
 
 
 @message_type(13)

@@ -24,7 +24,11 @@ import random
 from dataclasses import dataclass
 
 from ..common import tracer as tracer_mod
-from ..common.fault_injector import InjectedFailure, faultpoint
+from ..common.fault_injector import (
+    InjectedFailure,
+    faultpoint,
+    faultpoint_delay,
+)
 from ..common.log import dout
 from ..common.throttle import AsyncThrottle
 from .crypto import (
@@ -276,6 +280,12 @@ class Connection:
                         await self._connect()
                     faultpoint("msgr.send")
                     self.msgr._maybe_inject_fault()
+                    delay = faultpoint_delay("msgr.send", who=self.msgr.name)
+                    if delay > 0:
+                        # latency injection (ISSUE 17): a slow NIC, not a
+                        # dead one — the frame still goes out, late.  The
+                        # sleep holds only THIS connection's send lock
+                        await asyncio.sleep(delay)
                     raw = frame.pack(self.msgr.crc_data)
                     if self._onwire is not None:
                         raw = self._onwire.wrap(raw)

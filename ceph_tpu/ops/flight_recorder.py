@@ -22,7 +22,10 @@ carries:
 - flags: ``sharded``, ``fallback`` (completed on the host oracle),
   ``degraded_bypass`` (device skipped entirely while DEGRADED),
   ``timeout`` (a DeviceGuard deadline fired), ``throttle_stall`` (a
-  submitter hit the inflight-byte bound), ``error`` (sticky failure).
+  submitter hit the inflight-byte bound), ``error`` (sticky failure),
+  ``hedged`` (ISSUE 17: the decode's shard set includes a speculative
+  hedged sub-read that beat a straggler — gray-failure mitigation is
+  visible on the same timeline as the launches it saved).
 
 Producers hold the record through a contextvar scope
 (``active_scope``): ops/dispatch.py annotates devices/kind on the
@@ -69,6 +72,24 @@ import contextvars
 _ACTIVE: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
     "flight_record", default=None
 )
+
+# hedge hint (ISSUE 17): set by ECBackend around a reconstruct whose
+# shard set includes a winning hedged sub-read, read by new_record — the
+# decode launch is created levels below (aggregator flush inside
+# pend.result()), so a contextvar is the only plumbing-free channel.
+_HEDGED: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "flight_hedged", default=False
+)
+
+
+@contextlib.contextmanager
+def hedged_hint():
+    """Mark flight records created inside this scope as ``hedged``."""
+    token = _HEDGED.set(True)
+    try:
+        yield
+    finally:
+        _HEDGED.reset(token)
 
 
 def new_record(
@@ -139,6 +160,8 @@ def new_record(
             # served from the device-resident chunk cache: no H2D, no
             # kernel, only the D2H copy (ops/device_cache.py)
             "cache_hit": False,
+            # a winning hedged sub-read fed this decode (ISSUE 17)
+            "hedged": _HEDGED.get(),
         },
     }
 

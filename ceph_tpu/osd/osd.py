@@ -88,6 +88,11 @@ BACKEND_MSGS = (
 PEERING_MSGS = (MOSDPGQuery, MOSDPGNotify, MOSDPGLog)
 SCRUB_MSGS = (MOSDRepScrub, MOSDRepScrubMap)
 
+# laggy detection's absolute RTT floor (ISSUE 17): below this a peer is
+# never laggy no matter how it compares to the median — an all-local toy
+# mesh has microsecond RTTs where relative inflation is pure noise
+LAGGY_RTT_FLOOR = 0.010
+
 
 class OSD(Dispatcher):
     def __init__(
@@ -193,7 +198,13 @@ class OSD(Dispatcher):
         self._sched_kick = asyncio.Event()
         b = PerfCountersBuilder(f"osd.{whoami}")
         for c in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
-                  "recovery_ops", "heartbeat_failures", "backfill_pushes"):
+                  "recovery_ops", "heartbeat_failures", "backfill_pushes",
+                  # gray-failure tolerance (ISSUE 17): ops shed at
+                  # admission / sub-reads shed shard-side after the
+                  # deadline, and the hedged-read ledger (issued /
+                  # joined-the-decode-set / budget-denied)
+                  "op_deadline_shed", "subread_deadline_shed",
+                  "ec_hedge_reads", "ec_hedge_wins", "ec_hedge_denied"):
             b.add_u64_counter(c)
         # latency distributions (PerfHistogram; the reference's
         # op_latency / op_w_latency_in_bytes_histogram family): log2
@@ -205,6 +216,12 @@ class OSD(Dispatcher):
         )
         b.add_histogram("ec_encode_latency", "EC encode launch->reap (s)")
         b.add_histogram("ec_decode_latency", "EC reconstruct decode (s)")
+        # heartbeat ping + EC sub-read round-trips, aggregate; per-peer
+        # osd_heartbeat_rtt_osd_<N> twins are declared lazily on first
+        # sample (ensure_histogram) since peer membership is an osdmap
+        # fact.  The osd_ prefix puts the scrape family at
+        # ceph_tpu_osd_heartbeat_rtt_* — the name the docs index.
+        b.add_histogram("osd_heartbeat_rtt", "peer ping/sub-read rtt (s)")
         self.perf = b.create_perf_counters()
         self.clog: list[str] = []
         # structured cluster-log client (ISSUE 16): batching + dedup +
@@ -472,6 +489,14 @@ class OSD(Dispatcher):
         self._hb_first_tx: dict[int, float] = {}
         self._reported_failed: set[int] = set()
         self._last_failure_report: dict[int, float] = {}
+        # laggy-OSD detection (ISSUE 17): per-peer RTT EWMA fed by ping
+        # replies AND EC sub-read round-trips; peers past the slow-factor
+        # threshold are flagged laggy — alive but slow, the gray failure
+        # the markdown path cannot see — reported non-fatally to the mon
+        # and deprioritized as EC sub-read sources
+        self._peer_rtt_ewma: dict[int, float] = {}
+        self._laggy_peers: set[int] = set()
+        self._laggy_reported: dict[int, float] = {}  # peer -> last report
         # ordered cluster sends: addr -> queue + drain task
         self._out_q: dict[str, asyncio.Queue] = {}
         self._out_tasks: dict[str, asyncio.Task] = {}
@@ -640,10 +665,12 @@ class OSD(Dispatcher):
 
             Forms: {point, error?, hits?} arms a counted errno fault;
             {point, one_in} arms a probabilistic fault
-            (ms_inject_socket_failures semantics); {clear: true, point?}
-            disarms one point or everything; {conf: {name: value}}
-            additionally applies runtime config sets (the classic
-            `injectargs '--opt val'` use)."""
+            (ms_inject_socket_failures semantics); {point, delay_ms}
+            arms a LATENCY fault — the seam stays functionally correct
+            but slow, the gray-failure shape (ISSUE 17); {clear: true,
+            point?} disarms one point or everything; {conf: {name:
+            value}} additionally applies runtime config sets (the
+            classic `injectargs '--opt val'` use)."""
             from ..common.fault_injector import FAULT_POINTS, global_injector
 
             inj = global_injector()
@@ -655,6 +682,15 @@ class OSD(Dispatcher):
                     raise ValueError(f"unregistered fault point {point!r}")
                 if "one_in" in cmd:
                     inj.inject_probabilistic(point, int(cmd["one_in"]))
+                elif "delay_ms" in cmd:
+                    # `who` ("osd.3") scopes the latency to one daemon:
+                    # the injector is process-global, a gray failure is
+                    # one slow daemon among healthy ones
+                    inj.inject_delay(
+                        point, float(cmd["delay_ms"]),
+                        hits=int(cmd.get("hits", -1)),
+                        who=str(cmd.get("who", "")),
+                    )
                 else:
                     inj.inject(
                         point, int(cmd.get("error", 5)),
@@ -672,7 +708,7 @@ class OSD(Dispatcher):
             "injectargs",
             _injectargs,
             "arm/clear fault-injection points + runtime config sets "
-            "(args: point, error, hits, one_in, clear, conf)",
+            "(args: point, error, hits, one_in, delay_ms, who, clear, conf)",
             mutating=True,
         )
         def _dump_flight(cmd: dict) -> dict:
@@ -1104,10 +1140,12 @@ class OSD(Dispatcher):
             # (misdirected / not-yet-peered) are NOT accounted — the op
             # was never executed and the client's retry will be, so
             # counting both would inflate the pool's ops over what the
-            # client actually submitted
-            from ..common.errs import EAGAIN
+            # client actually submitted.  -ETIMEDOUT admission sheds
+            # (ISSUE 17) are excluded for the same reason: the op never
+            # executed, only its corpse was returned
+            from ..common.errs import EAGAIN, ETIMEDOUT
 
-            if rep.result != -EAGAIN:
+            if rep.result not in (-EAGAIN, -ETIMEDOUT):
                 # real payload bytes, NOT `cost` — the QoS cost floors
                 # zero-payload ops (delete/create/truncate) at 4096,
                 # which would add phantom write bytes to the pool and
@@ -1137,6 +1175,28 @@ class OSD(Dispatcher):
 
             asyncio.get_event_loop().create_task(_send())
 
+        deadline = getattr(msg, "deadline", 0.0)
+        if deadline and time.monotonic() > deadline:
+            # admission-time deadline shed (ISSUE 17): the client has
+            # already given up on this op — queue wait ate its budget —
+            # so executing it now would spend OSD time nobody is waiting
+            # on.  -ETIMEDOUT back (the objecter maps it to the same
+            # TimeoutError a local expiry raises), never executed, and
+            # excluded from io-accounting like the -EAGAIN bounce.
+            from ..common.errs import ETIMEDOUT
+
+            self.perf.inc("op_deadline_shed")
+            op_span.event("deadline expired at admission: shed")
+            reply(
+                MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=-ETIMEDOUT,
+                    outdata=[],
+                    version=0,
+                    epoch=self.osdmap.epoch,
+                )
+            )
+            return
         if pg is None:
             from ..common.errs import EAGAIN
 
@@ -1372,17 +1432,93 @@ class OSD(Dispatcher):
             else:
                 self._reported_failed.discard(peer)
                 self._last_failure_report.pop(peer, None)
+        self._laggy_check(now)
 
-    def _report_failure(self, peer: int, failed_for: float) -> None:
+    def _laggy_check(self, now: float) -> None:
+        """Laggy-OSD detection (ISSUE 17): a peer whose RTT EWMA (ping
+        replies + EC sub-read service, _note_peer_rtt) inflates past
+        osd_heartbeat_slow_factor x the cluster-median peer EWMA —
+        floored at 10 ms absolute so a uniformly-fast mesh never flags
+        on microsecond noise — is LAGGY: alive (heartbeats answer) but
+        slow, the gray failure the markdown path cannot see.  Reported
+        to the mon as a non-fatal MOSDFailure(laggy=1) on the grace
+        cadence while it persists; hysteresis (exit at half the enter
+        threshold) stops boundary flapping; recovery sends a one-shot
+        laggy=2 so the mon retires its OSD_SLOW_PEER evidence."""
+        factor = self.conf.get("osd_heartbeat_slow_factor")
+        if factor <= 0:
+            for peer in list(self._laggy_peers):
+                self._laggy_clear(peer)
+            return
+        samples = sorted(self._peer_rtt_ewma.values())
+        if len(samples) < 3:
+            return  # too few peers for a meaningful median
+        median = samples[len(samples) // 2]
+        enter = max(factor * median, LAGGY_RTT_FLOOR)
+        grace = self.conf.get("osd_heartbeat_grace")
+        for peer, ewma in list(self._peer_rtt_ewma.items()):
+            if peer in self._reported_failed:
+                # dead beats laggy: the markdown path owns this peer
+                if peer in self._laggy_peers:
+                    self._laggy_peers.discard(peer)
+                    self._laggy_reported.pop(peer, None)
+                continue
+            if peer not in self._laggy_peers:
+                if ewma >= enter:
+                    self._laggy_peers.add(peer)
+                    self._laggy_reported[peer] = now
+                    self._report_failure(peer, ewma, laggy=1)
+            elif ewma <= enter / 2.0:
+                self._laggy_clear(peer)
+            elif now - self._laggy_reported.get(peer, 0.0) >= grace:
+                # re-report on the grace cadence: mon-side evidence
+                # expires and a send can die with its connection
+                self._laggy_reported[peer] = now
+                self._report_failure(peer, ewma, laggy=1)
+
+    def _laggy_clear(self, peer: int) -> None:
+        self._laggy_peers.discard(peer)
+        self._laggy_reported.pop(peer, None)
+        self._report_failure(peer, 0.0, laggy=2)
+
+    def laggy_peers(self) -> set[int]:
+        """Peers currently flagged laggy — EC read planning (via the PG
+        listener) deprioritizes these as sub-read sources."""
+        return set(self._laggy_peers)
+
+    def _note_peer_rtt(self, peer: int, rtt: float) -> None:
+        """One peer round-trip sample: EWMA for the laggy detector plus
+        the aggregate and lazily-declared per-peer RTT histograms."""
+        prev = self._peer_rtt_ewma.get(peer)
+        self._peer_rtt_ewma[peer] = (
+            rtt if prev is None else 0.2 * rtt + 0.8 * prev
+        )
+        self.perf.hinc("osd_heartbeat_rtt", rtt)
+        name = f"osd_heartbeat_rtt_osd_{peer}"
+        self.perf.ensure_histogram(name, f"ping/sub-read rtt to osd.{peer} (s)")
+        self.perf.hinc(name, rtt)
+
+    def note_subread_rtt(self, peer: int, rtt: float) -> None:
+        """EC sub-read service-time sample (PG listener hook): feeds the
+        same per-peer EWMA as ping RTT, so a peer that answers pings
+        promptly but serves reads slowly still trips laggy detection."""
+        if peer == self.whoami:
+            return  # self-sends are a function call, not the network
+        self._note_peer_rtt(peer, rtt)
+
+    def _report_failure(self, peer: int, failed_for: float, laggy: int = 0) -> None:
         """Report a dead peer to every mon (re-sent on the grace cadence
         by _heartbeat_check while the failure persists; the mon dedupes
-        repeats per reporter)."""
+        repeats per reporter).  laggy=1/2 reports the non-fatal
+        slow-peer state instead (failed_for then carries the RTT EWMA);
+        the mon surfaces OSD_SLOW_PEER and never marks the target down."""
         info = self.osdmap.osds.get(peer)
         fail = MOSDFailure(
             target=peer,
             target_addr=info.addr if info else "",
             failed_for=failed_for,
             epoch=self.osdmap.epoch,
+            laggy=laggy,
         )
         for name in self.monmap.ranks:
             async def _send(addr=self.monmap.addrs[name]):
@@ -1408,7 +1544,11 @@ class OSD(Dispatcher):
                 ),
             )
         elif msg.op == MOSDPing.PING_REPLY:
-            self._hb_last_rx[msg.from_osd] = time.monotonic()
+            now = time.monotonic()
+            self._hb_last_rx[msg.from_osd] = now
+            # ping round-trip (now - our PING's stamp, echoed back):
+            # the laggy detector's baseline signal (ISSUE 17)
+            self._note_peer_rtt(msg.from_osd, now - msg.stamp)
 
     # -- misc ------------------------------------------------------------------
 
@@ -1617,6 +1757,18 @@ def _osd_status(osd: "OSD") -> dict:
         # bar — the mgr progress module aggregates these across daemons
         # into per-victim rebuild bars with rate + ETA
         "recovery_storm": osd.recovery_storm.status(),
+        # gray-failure tolerance (ISSUE 17): peers this OSD currently
+        # sees as laggy plus its hedge/shed ledger — the evidence trail
+        # behind the mon's OSD_SLOW_PEER detail and the chaos harness's
+        # hedge-rate assertions
+        "slow_peers": {
+            "laggy": sorted(osd._laggy_peers),
+            "hedge_reads": osd.perf.get("ec_hedge_reads"),
+            "hedge_wins": osd.perf.get("ec_hedge_wins"),
+            "hedge_denied": osd.perf.get("ec_hedge_denied"),
+            "op_deadline_shed": osd.perf.get("op_deadline_shed"),
+            "subread_deadline_shed": osd.perf.get("subread_deadline_shed"),
+        },
     }
 
 

@@ -515,6 +515,34 @@ class PG(PGListener):
         except (KeyError, AttributeError):
             pass  # harness OSD without the histogram declared
 
+    def perf_inc(self, name: str, n: int = 1) -> None:
+        """EC hedge/shed accounting -> the OSD's counters (ISSUE 17)."""
+        perf = getattr(self.osd, "perf", None)
+        if perf is None:
+            return
+        try:
+            perf.inc(name, n)
+        except (KeyError, AttributeError):
+            pass  # harness OSD without the counter declared
+
+    def conf_get(self, name: str):
+        """Runtime-mutable knob lookup for the EC backend (hedge
+        quantile/floor/budget ride the OSD's live Config)."""
+        conf = getattr(self.osd, "conf", None)
+        return conf.get(name) if conf is not None else None
+
+    def note_peer_rtt(self, peer: int, rtt: float) -> None:
+        """Sub-read service-time sample -> the OSD's laggy detector."""
+        hook = getattr(self.osd, "note_subread_rtt", None)
+        if hook is not None:
+            hook(peer, rtt)
+
+    def laggy_peers(self) -> set[int]:
+        """OSDs the heartbeat subsystem flags as slow-but-alive; the EC
+        backend deprioritizes them as sub-read sources."""
+        hook = getattr(self.osd, "laggy_peers", None)
+        return set(hook()) if hook is not None else set()
+
     def whoami_shard(self) -> int:
         if self.pool.type != POOL_TYPE_ERASURE:
             return -1
@@ -1086,7 +1114,11 @@ class PG(PGListener):
             )
 
         self.backend.objects_read_and_reconstruct(
-            {target: [ext for _i, ext in read_extents]}, on_read
+            {target: [ext for _i, ext in read_extents]},
+            on_read,
+            # end-to-end budget (ISSUE 17): sub-reads inherit the op's
+            # remaining deadline so shards shed a doomed read's work
+            deadline=getattr(msg, "deadline", 0.0),
         )
 
     def _finish_write(

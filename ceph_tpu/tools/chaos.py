@@ -8,6 +8,12 @@ failure modes the wired FaultInjector seams expose (ISSUE 7):
   failures analog) under lossless-policy resend,
 - objectstore EIO bursts (`os.read`) driving EC redundant-read
   escalation and reconstruction,
+- a gray-OSD phase (ISSUE 17): one OSD's shard reads are delayed ~50x
+  (`ec.sub_read` delay_ms mode, scoped to the victim daemon) while its
+  heartbeats stay on time — adaptive hedged reads keep client p99
+  bounded under the injected delay, the laggy detector raises
+  OSD_SLOW_PEER on exactly the victim and clears it when the delay
+  lifts, and a healthy control window proves hedging is quiescent,
 - device coding-launch failures (`codec.launch`) driving the
   DEGRADED-backend host fallback + re-probe self-heal,
 - a deep-scrub-under-load phase (ISSUE 9): silent shard corruption is
@@ -406,6 +412,190 @@ async def _run(cfg: dict) -> dict:
         inj.clear("ec.sub_read")
         report["eio_client_retries"] = eio_retries
         report["events"].append("EIO burst reconstructed")
+
+        # ---- phase 2.5: gray OSD — hedged reads + laggy detection -------
+        # The gray failure (ISSUE 17): one OSD heartbeats on time but
+        # serves shard reads ~50x slow.  A healthy CONTROL window first
+        # proves hedging is quiescent; then the victim's sub-reads are
+        # delayed (delay_ms mode scoped to the victim daemon — its peers
+        # stay fast), and the phase asserts the whole tolerance chain at
+        # once: client read p99 stays UNDER the injected delay because
+        # hedged/re-planned reads win, the victim — and ONLY the victim
+        # — is detected laggy and surfaced as OSD_SLOW_PEER, the hedge
+        # spend stays within the token-bucket budget, every read stays
+        # byte-identical (no lost or doubled completions), and the laggy
+        # state + health warn CLEAR once the delay lifts.
+        from ceph_tpu.common.options import OPTIONS as _opts
+        from ceph_tpu.osd.ec_backend import HEDGE_BURST
+        from ceph_tpu.osd.pg_backend import shard_coll as _gray_coll
+
+        chaos_primaries = [
+            (o, pg)
+            for o in osds
+            if o._running
+            for pg in o.pgs.values()
+            if pg.pool.name == "chaospool" and pg.peering.is_primary()
+        ]
+        prim_count = {i: 0 for i in range(cfg["osds"])}
+        for o, _pg in chaos_primaries:
+            prim_count[o.whoami] += 1
+        # a non-primary DATA-shard slot (acting[:k], k=2 for chaos21) is
+        # where a gray peer actually hurts reads: normal whole-object
+        # reads fetch exactly the k data shards
+        data_member = {i: 0 for i in range(cfg["osds"])}
+        for o, pg in chaos_primaries:
+            for w in pg.acting()[:2]:
+                if w != o.whoami:
+                    data_member[w] += 1
+        gray_id = min(
+            (i for i in range(cfg["osds"]) if data_member[i] > 0),
+            key=lambda i: (prim_count[i], -data_member[i], i),
+        )
+        gray_pgs = [
+            (o, pg)
+            for o, pg in chaos_primaries
+            if o.whoami != gray_id and gray_id in pg.acting()[:2]
+        ]
+        assert gray_pgs, "chaos: gray victim serves no remote data shards"
+        gray_oids = sorted(
+            oid
+            for o, pg in gray_pgs
+            for oid in o.store.list_objects(
+                _gray_coll(pg.pgid, pg.whoami_shard())
+            )
+            if oid in expected
+        )[: 2 * cfg["objects"]]
+        assert gray_oids, "chaos: no readable objects behind the gray victim"
+
+        def _hedge_totals() -> dict[str, int]:
+            return {
+                k: sum(int(o.perf.get(k)) for o in osds if o._running)
+                for k in ("ec_hedge_reads", "ec_hedge_wins",
+                          "ec_hedge_denied")
+            }
+
+        hedge0 = _hedge_totals()
+        for oid in gray_oids:  # control window: healthy reads
+            assert await io.read(oid) == expected[oid]
+        control = _hedge_totals()
+        control_hedges = (
+            control["ec_hedge_reads"] - hedge0["ec_hedge_reads"]
+        )
+        report["control_hedges"] = control_hedges
+        assert control_hedges <= max(2, len(gray_oids) // 10), (
+            f"chaos: healthy control window hedged {control_hedges} "
+            f"times over {len(gray_oids)} reads"
+        )
+        # gray the victim: its sub-reads answer correctly but late
+        inj.inject_delay(
+            "ec.sub_read", cfg["gray_delay_ms"], who=f"osd.{gray_id}"
+        )
+        await _audit_arm(
+            "ec.sub_read",
+            f"delay_ms={cfg['gray_delay_ms']:.0f} who=osd.{gray_id}",
+        )
+        # priming reads: the first slow round trips are what the EWMA
+        # laggy detector feeds on; reactive hedges keep even these fast
+        # (the late losers land their RTT through the late-send ledger)
+        for oid in gray_oids:
+            assert await io.read(oid) == expected[oid]
+        detectors = [
+            o for o in osds if o._running and o.whoami != gray_id
+        ]
+        await _wait_until(
+            lambda: any(gray_id in o.laggy_peers() for o in detectors),
+            cfg["converge_timeout"],
+            f"osd.{gray_id} to be detected laggy",
+        )
+        await _wait_until(
+            lambda: gray_id in mons[0].osdmon.slow_peers(),
+            cfg["converge_timeout"],
+            "the mon to surface the laggy report",
+        )
+        slow = mons[0].osdmon.slow_peers()
+        assert set(slow) == {gray_id}, (
+            f"chaos: laggy detection fingered the wrong victim(s): "
+            f"{sorted(slow)} (expected {{{gray_id}}})"
+        )
+        checks, _details = mons[0].health_checks()
+        assert "OSD_SLOW_PEER" in checks, (
+            f"chaos: no OSD_SLOW_PEER health warn ({sorted(checks)})"
+        )
+        assert f"osd.{gray_id}" in checks["OSD_SLOW_PEER"], (
+            f"chaos: OSD_SLOW_PEER names the wrong victim: "
+            f"{checks['OSD_SLOW_PEER']}"
+        )
+        # measured window: mixed load with the victim still gray — the
+        # laggy deprioritization re-plans reads around it, so p99 must
+        # land far under the injected delay
+        gray_lat_s: list[float] = []
+        for i in range(2 * len(gray_oids)):
+            oid = gray_oids[i % len(gray_oids)]
+            t0 = time.monotonic()
+            back = await io.read(oid)
+            gray_lat_s.append(time.monotonic() - t0)
+            assert back == expected[oid], (
+                f"chaos: {oid} corrupt while reading around the gray OSD"
+            )
+            if i % 4 == 0:
+                await put(f"gray{i}", 8192)
+        inj.clear("ec.sub_read")
+        gray = _hedge_totals()
+        gray_lat_s.sort()
+        gray_p99_s = gray_lat_s[int(0.99 * (len(gray_lat_s) - 1))]
+        gray_reads = len(gray_oids) + len(gray_lat_s)
+        gray_hedges = (
+            gray["ec_hedge_reads"] - control["ec_hedge_reads"]
+        )
+        report["gray_victim"] = gray_id
+        report["gray_delay_ms"] = cfg["gray_delay_ms"]
+        report["gray_reads"] = gray_reads
+        report["gray_p99_ms"] = round(gray_p99_s * 1e3, 3)
+        report["gray_hedges"] = gray_hedges
+        report["gray_hedge_wins"] = (
+            gray["ec_hedge_wins"] - hedge0["ec_hedge_wins"]
+        )
+        report["gray_hedge_denied"] = (
+            gray["ec_hedge_denied"] - hedge0["ec_hedge_denied"]
+        )
+        report["hedge_rate"] = round(gray_hedges / max(1, gray_reads), 4)
+        assert gray_hedges >= 1, "chaos: the gray window never hedged"
+        assert report["gray_hedge_wins"] >= 1, (
+            "chaos: no hedged read ever beat the gray straggler"
+        )
+        assert gray_p99_s * 1e3 <= cfg["gray_p99_bound_ms"], (
+            f"chaos: gray-window read p99 {gray_p99_s * 1e3:.1f} ms "
+            f"exceeded the {cfg['gray_p99_bound_ms']} ms bound (injected "
+            f"delay {cfg['gray_delay_ms']:.0f} ms — hedging failed)"
+        )
+        # budget contract: spend is bounded by every primary backend's
+        # burst plus the percent-of-subreads earn over the window
+        # (k=2 sub-reads per read, plus the hedges themselves)
+        pct = float(_opts["osd_ec_hedge_budget_percent"].default)
+        budget_bound = HEDGE_BURST * len(chaos_primaries) + (
+            pct / 100.0
+        ) * (3 * gray_reads) + 1
+        assert gray_hedges <= budget_bound, (
+            f"chaos: {gray_hedges} hedges burst past the token-bucket "
+            f"bound {budget_bound:.0f}"
+        )
+        # the delay lifted: laggy state and the health warn must CLEAR
+        # (ping RTT keeps sampling the victim, decaying the EWMA through
+        # the exit hysteresis; each reporter retracts, the mon retires)
+        await _wait_until(
+            lambda: all(
+                gray_id not in o.laggy_peers() for o in detectors
+            ),
+            cfg["converge_timeout"],
+            f"osd.{gray_id}'s laggy state to clear",
+        )
+        await _wait_until(
+            lambda: "OSD_SLOW_PEER" not in mons[0].health_checks()[0],
+            cfg["converge_timeout"], "OSD_SLOW_PEER to clear",
+        )
+        report["events"].append(
+            f"gray osd.{gray_id} hedged around, detected laggy, cleared"
+        )
 
         # ---- phase 3: device-launch faults -> host fallback -------------
         await arm("codec.launch", 5, cfg["launch_faults"])
@@ -1174,6 +1364,14 @@ def run_chaos(
         "down_out_interval": 2.0 if smoke else 5.0,
         "storm_rebuild_bound_sec": 30.0 if smoke else 60.0,
         "storm_p99_bound_ms": 2000.0 if smoke else 1000.0,
+        # ISSUE 17 gray-OSD gates: the injected sub-read delay (the
+        # "~50x" gray multiplier against millisecond-scale healthy
+        # reads) and the client read-p99 bound the hedged/re-planned
+        # reads must beat.  The bound sits DELIBERATELY under the delay:
+        # if hedging fails, every victim-shard read eats the full delay
+        # and the assertion trips — it cannot pass vacuously.
+        "gray_delay_ms": 3000.0,
+        "gray_p99_bound_ms": 2000.0 if smoke else 1000.0,
     }
     return asyncio.run(_run(cfg))
 

@@ -57,6 +57,12 @@ METRICS: dict[str, tuple[tuple[str, ...], str, bool]] = {
     # latency) flags against the committed best
     "chaos_rebuild_seconds": (("chaos", "rebuild_seconds"), "lower", False),
     "chaos_storm_p99_ms": (("chaos", "storm_p99_ms"), "lower", False),
+    # gray-failure trajectory (ISSUE 17): client read p99 with one OSD's
+    # shard reads delayed ~50x (hedged reads must keep beating the
+    # injected delay round over round) and the hedge rate the window
+    # paid for it — both lower-is-better, folded from the chaos JSON
+    "chaos_gray_p99_ms": (("chaos", "gray_p99_ms"), "lower", False),
+    "chaos_hedge_rate": (("chaos", "hedge_rate"), "lower", False),
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -90,6 +90,9 @@ def _flags_args(rec: dict) -> dict:
     # this launch dispatched (absent on pre-pipeline records)
     if rec.get("inflight_depth"):
         args["inflight_depth"] = rec["inflight_depth"]
+    # truthy flags render as one sorted CSV; "hedged" (ISSUE 17) marks
+    # decode launches fed by a winning speculative sub-read — the gray
+    # failure a straggler would have caused is visible per launch
     flags = [k for k, v in rec.get("flags", {}).items() if v]
     if flags:
         args["flags"] = ",".join(sorted(flags))

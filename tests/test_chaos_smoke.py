@@ -1,6 +1,9 @@
 """Seed-fixed chaos smoke in tier-1 (ISSUE 7 acceptance): a real
 mon+mgr+OSD cluster under mixed load survives socket faults, shard-read
-EIO bursts, device-launch failures (host fallback), a deep scrub under
+EIO bursts, a gray OSD (ISSUE 17: one daemon's shard reads delayed ~50x
+— hedged reads bound client p99, the laggy detector raises and clears
+OSD_SLOW_PEER on exactly the victim), device-launch failures (host
+fallback), a deep scrub under
 client load with planted shard corruption (ISSUE 9: detected via
 aggregated TPU verify launches, client p99 inside the QoS bound), an
 OSD flap, a whole-OSD recovery storm (ISSUE 15: kill + dampened
@@ -23,7 +26,23 @@ class TestChaosSmoke:
         assert report["converged"], report
         assert report["lost_writes"] == 0, report
         # every chaos phase actually ran
-        assert len(report["events"]) == 11, report["events"]
+        assert len(report["events"]) == 12, report["events"]
+        # ISSUE 17: the gray-OSD phase — one OSD's shard reads delayed
+        # ~50x while its heartbeats stayed on time.  Hedged/re-planned
+        # reads kept client p99 under the injected delay, the victim
+        # (and only the victim) raised OSD_SLOW_PEER and cleared after
+        # the delay lifted (asserted inside the phase), hedge spend
+        # stayed within the token-bucket budget, and the healthy
+        # control window hedged ~never
+        assert report["gray_p99_ms"] is not None, report
+        assert 0.0 < report["gray_p99_ms"] <= 2000.0, report
+        assert report["gray_p99_ms"] < report["gray_delay_ms"], report
+        assert report["gray_hedges"] >= 1, report
+        assert report["gray_hedge_wins"] >= 1, report
+        assert 0.0 < report["hedge_rate"], report
+        assert report["control_hedges"] <= 2, report
+        assert report["gray_victim"] >= 0, report
+        assert report["gray_reads"] >= 1, report
         # ISSUE 10: the mixed-load phase attributed the load per pool
         # (windowed p99 keys ride the report for the bench fold), held
         # the SLO burn rate under bound, and kept trace retention

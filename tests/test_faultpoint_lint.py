@@ -13,10 +13,13 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 PKG = REPO / "ceph_tpu"
 
-# faultpoint("name") / _faultpoint("name", ...) — the two spellings the
-# seams use (objectstore routes through ObjectStore._faultpoint so the
-# InjectedFailure -> StoreError mapping lives in one place)
-_CALL = re.compile(r"""\b_?faultpoint\(\s*["']([a-z0-9_.]+)["']""")
+# faultpoint("name") / _faultpoint("name", ...) / faultpoint_delay("name")
+# — the spellings the seams use (objectstore routes through
+# ObjectStore._faultpoint so the InjectedFailure -> StoreError mapping
+# lives in one place; faultpoint_delay is the ISSUE 17 latency twin)
+_CALL = re.compile(
+    r"""\b_?faultpoint(?:_delay)?\(\s*["']([a-z0-9_.]+)["']"""
+)
 
 
 def _call_sites() -> dict[str, list[str]]:
@@ -97,3 +100,44 @@ class TestFaultPointCatalog:
                 inj.check("os.read")
         inj.check("os.read")  # budget drained: no longer armed
         assert not inj.armed("os.read")
+
+    def test_delay_mode_reports_seconds_and_drains_hits(self):
+        """delay_ms mode (ISSUE 17): the seam stays functionally correct
+        but slow — check_delay reports seconds, spends the hit budget
+        like check(), and clear()/armed() cover delayed points too."""
+        from ceph_tpu.common.fault_injector import FaultInjector
+
+        inj = FaultInjector()
+        inj.inject_delay("ec.sub_read", 250.0, hits=2)
+        assert inj.armed("ec.sub_read")
+        assert inj.check_delay("ec.sub_read") == 0.25
+        assert inj.check_delay("ec.sub_read") == 0.25
+        assert inj.check_delay("ec.sub_read") == 0.0  # budget drained
+        assert not inj.armed("ec.sub_read")
+        inj.inject_delay("msgr.send", 100.0)
+        assert inj.armed("msgr.send")
+        inj.clear("msgr.send")
+        assert inj.check_delay("msgr.send") == 0.0
+
+    def test_delay_scoped_to_one_daemon(self):
+        """A gray failure is ONE slow daemon among healthy ones: a
+        who-scoped delay fires (and spends hits) only for the matching
+        caller identity, so the chaos harness can slow a single victim
+        through the process-global injector."""
+        from ceph_tpu.common.fault_injector import FaultInjector
+
+        inj = FaultInjector()
+        inj.inject_delay("ec.sub_read", 100.0, hits=1, who="osd.3")
+        assert inj.check_delay("ec.sub_read", who="osd.1") == 0.0
+        assert inj.check_delay("ec.sub_read") == 0.0
+        assert inj.armed("ec.sub_read")  # mismatches spent no hits
+        assert inj.check_delay("ec.sub_read", who="osd.3") == 0.1
+        assert not inj.armed("ec.sub_read")
+
+    def test_faultpoint_delay_rejects_unregistered_names(self):
+        import pytest
+
+        from ceph_tpu.common.fault_injector import faultpoint_delay
+
+        with pytest.raises(ValueError, match="unregistered"):
+            faultpoint_delay("no.such.point")

@@ -1,0 +1,386 @@
+"""Gray-failure tolerance tests (ISSUE 17): adaptive hedged EC reads,
+end-to-end deadline propagation, and the late-loser RTT ledger.
+
+The unit tier drives ECBackend through test_ec_backend's pumped-queue
+cluster — there is no event loop, so hedge timers are inert and the
+hedge check fires explicitly (`_hedge_fire`), which is exactly what
+makes the race windows deterministic: a "slow" peer is one whose
+messages the pump holds back.  The integration tier boots a real
+mon+OSD cluster to witness admission-time deadline shedding and the
+laggy-peer detector end to end.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.common.errs import EIO
+from ceph_tpu.osd.osdmap import PG_NONE
+
+from test_ec_backend import Cluster, ec_pool, payload
+
+
+def attach_perf(c: Cluster) -> dict:
+    """Wire every listener's perf_inc hook into one shared counter dict
+    (the harness Listener has none; ECBackend drops counts without it)."""
+    counters: dict[str, int] = {}
+
+    def inc(name, n=1):
+        counters[name] = counters.get(name, 0) + n
+
+    for listener in c.listeners:
+        listener.perf_inc = inc
+    return counters
+
+
+def start_read(c: Cluster, oid: str, length: int, deadline: float = 0.0) -> dict:
+    """Queue a read WITHOUT pumping; the caller owns message delivery."""
+    out: dict = {}
+    c.primary.objects_read_and_reconstruct(
+        {oid: [(0, length)]}, lambda res: out.update(res), deadline=deadline
+    )
+    return out
+
+
+def pump_except(c: Cluster, holdback: set[int]) -> list:
+    """Deliver queued messages, HOLDING anything addressed to an OSD in
+    `holdback` — the pump-level model of a slow peer.  Returns the held
+    (osd, msg) pairs so the test can deliver the late replies later."""
+    held = []
+    steps = 0
+    while True:
+        for b in c.backends:
+            b.flush_encodes()
+        if not c.queue:
+            break
+        osd, msg = c.queue.pop(0)
+        if osd in holdback:
+            held.append((osd, msg))
+            continue
+        if osd == PG_NONE or not (0 <= osd < len(c.backends)):
+            continue
+        c.backends[osd].handle_message(msg)
+        steps += 1
+        assert steps < 100000, "message storm"
+    return held
+
+
+def deliver(c: Cluster, held: list) -> None:
+    """Hand held messages to their targets, then drain the fallout."""
+    for osd, msg in held:
+        c.backends[osd].handle_message(msg)
+    c.pump()
+
+
+class TestHedgedEcReads:
+    """Tentpole tier 2: the hedge fires on a slow outstanding sub-read,
+    first-k-wins, the budget gates spend, and late losers are reaped
+    into the RTT ledger instead of double-counting."""
+
+    def _slow_shard1_read(self, k=2, m=2):
+        pool, profiles = ec_pool(k, m)
+        c = Cluster(pool, profiles)
+        data = payload(pool.stripe_width)
+        c.write("obj", 0, data)
+        counters = attach_perf(c)
+        out = start_read(c, "obj", len(data))
+        held = pump_except(c, {1})  # shard 1's source answers... never
+        assert not out, "read completed without shard 1"
+        prim = c.primary
+        ((tid, rop),) = prim.read_ops.items()
+        # age shard 1's sub-read past any threshold (floor is 10 ms)
+        rop.send_ts[1] -= 1.0
+        return c, prim, tid, rop, out, held, counters, data
+
+    def test_hedge_winner_first_k_wins_byte_identical(self):
+        c, prim, tid, rop, out, held, counters, data = self._slow_shard1_read()
+        prim._hedge_fire(tid)
+        assert counters.get("ec_hedge_reads") == 1
+        assert rop.hedge_shards and rop.hedge_shards <= {2, 3}
+        # the speculative read answers; shard 1 still dark — first k win
+        pump_except(c, {1})
+        assert out["obj"][0] == 0
+        assert out["obj"][1][0] == data
+        assert counters.get("ec_hedge_wins") == 1
+        assert not prim.read_ops  # retired; a loser reply cannot re-enter
+
+    def test_late_loser_feeds_rtt_ledger_then_is_reaped(self):
+        c, prim, tid, rop, out, held, counters, data = self._slow_shard1_read()
+        rtts = []
+        c.listeners[0].note_peer_rtt = lambda peer, rtt: rtts.append((peer, rtt))
+        prim._hedge_fire(tid)
+        pump_except(c, {1})
+        assert out["obj"][0] == 0
+        # the op retired with shard 1 outstanding: the ledger remembers
+        # where that sub-read went so the eventual reply is attributable
+        assert tid in prim._late_sends
+        before = counters.get("ec_hedge_wins", 0)
+        deliver(c, held)  # the slow peer finally answers
+        assert tid not in prim._late_sends
+        # the late reply landed ONE rtt sample >= the 1 s we aged it by
+        # (hedging must not mask the slowness the laggy detector needs)
+        assert any(peer == 1 and rtt >= 1.0 for peer, rtt in rtts), rtts
+        assert prim._peer_ewma[1] >= 0.2  # EWMA pulled up by the sample
+        # ...and nothing else: no double completion, no second hedge win
+        assert counters.get("ec_hedge_wins", 0) == before
+        assert out["obj"][1][0] == data
+
+    def test_budget_exhaustion_means_plain_waiting(self):
+        c, prim, tid, rop, out, held, counters, data = self._slow_shard1_read()
+        prim._hedge_tokens = 0.0  # bucket drained (after earlier earns)
+        prim._hedge_fire(tid)
+        assert counters.get("ec_hedge_denied") == 1
+        assert not rop.hedge_shards
+        assert not c.queue, "denied hedge must send nothing"
+        assert tid in prim.read_ops  # still waiting, not failed
+        deliver(c, held)  # the slow reply eventually arrives
+        assert out["obj"][0] == 0
+        assert out["obj"][1][0] == data
+        assert "ec_hedge_wins" not in counters
+
+    def test_hedge_never_spends_on_doomed_read(self):
+        c, prim, tid, rop, out, held, counters, data = self._slow_shard1_read()
+        rop.deadline = time.monotonic() - 0.01  # budget spent in flight
+        tokens = prim._hedge_tokens
+        prim._hedge_fire(tid)
+        assert not rop.hedge_shards
+        assert prim._hedge_tokens == tokens
+        assert "ec_hedge_reads" not in counters
+
+    def test_hedge_with_eio_peer_same_readop(self):
+        """The escalation matrix: one peer answers EIO while another is
+        slow — the hedge and the error path compose in one ReadOp and
+        the decode still comes back byte-identical."""
+        from ceph_tpu.common.fault_injector import global_injector
+
+        pool, profiles = ec_pool(2, 2)
+        c = Cluster(pool, profiles)
+        data = payload(pool.stripe_width)
+        c.write("obj", 0, data)
+        counters = attach_perf(c)
+        inj = global_injector()
+        inj.inject("ec.sub_read", EIO, hits=1)
+        try:
+            out = start_read(c, "obj", len(data))
+            # shard 0's sub-read (queued first) eats the EIO; shard 1 held
+            held = pump_except(c, {1})
+        finally:
+            inj.clear("ec.sub_read")
+        prim = c.primary
+        ((tid, rop),) = prim.read_ops.items()
+        assert 0 in rop.errors, "shard 0 should have answered EIO"
+        rop.send_ts[1] -= 1.0
+        prim._hedge_fire(tid)
+        assert counters.get("ec_hedge_reads") == 1
+        pump_except(c, {1})
+        # one good shard (the hedge) is short of k=2: still waiting
+        assert not out and tid in prim.read_ops
+        deliver(c, held)  # the slow peer completes the decode set
+        assert out["obj"][0] == 0
+        assert out["obj"][1][0] == data
+        assert counters.get("ec_hedge_wins") == 1
+
+    def test_ledger_prunes_stale_entries(self):
+        pool, profiles = ec_pool(2, 1)
+        c = Cluster(pool, profiles)
+        prim = c.primary
+        prim._late_sends[999] = (
+            time.monotonic() - prim.LATE_SEND_TTL - 1.0,
+            {1: (1, 0.0)},
+        )
+        prim._prune_late_sends()
+        assert 999 not in prim._late_sends
+
+
+class TestLaggyReadPlanning:
+    """Tentpole tier 3, primary side: reads route around peers the
+    heartbeat subsystem flags laggy, hedging preemptively when a laggy
+    source is unavoidable."""
+
+    def test_laggy_source_deprioritized_in_plan(self):
+        pool, profiles = ec_pool(2, 2)
+        c = Cluster(pool, profiles)
+        data = payload(pool.stripe_width)
+        c.write("obj", 0, data)
+        c.listeners[0].laggy_peers = lambda: {1}
+        out = start_read(c, "obj", len(data))
+        ((_tid, rop),) = c.primary.read_ops.items()
+        assert 1 not in set(rop.sources.values()), rop.sources
+        c.pump()
+        assert out["obj"][0] == 0
+        assert out["obj"][1][0] == data
+
+    def test_unavoidable_laggy_source_hedged_preemptively(self):
+        pool, profiles = ec_pool(2, 2)
+        c = Cluster(pool, profiles)
+        data = payload(pool.stripe_width)
+        c.write("obj", 0, data)
+        counters = attach_perf(c)
+        # shard 0 is gone and every source of a clean stripe is laggy:
+        # the plan cannot avoid laggy peers, so it hedges up front
+        c.missing["obj"] = {0}
+        c.listeners[0].laggy_peers = lambda: {1, 2}
+        out = start_read(c, "obj", len(data))
+        ((_tid, rop),) = c.primary.read_ops.items()
+        assert rop.hedge_shards, "expected a preemptive hedge"
+        assert counters.get("ec_hedge_reads") == 1
+        c.pump()
+        assert out["obj"][0] == 0
+        assert out["obj"][1][0] == data
+        # in this harness the "laggy" peers answer instantly, so the
+        # minimum set completes first and the hedge reply is a late
+        # loser — reaped through the ledger, never double-counted
+        assert not c.primary.read_ops
+        assert not c.primary._late_sends
+        assert len(out) == 1
+
+
+class TestSubReadDeadlineShed:
+    """Tentpole tier 1, shard side: an expired inherited deadline sheds
+    the sub-read at the shard — counted, -ETIMEDOUT, store untouched —
+    releasing the source instead of pinning it for a corpse."""
+
+    def test_expired_subreads_shed_everywhere_and_fail(self):
+        pool, profiles = ec_pool(2, 1)
+        c = Cluster(pool, profiles)
+        data = payload(pool.stripe_width)
+        c.write("obj", 0, data)
+        counters = attach_perf(c)
+        out = start_read(c, "obj", len(data), deadline=time.monotonic() - 0.1)
+        c.pump()
+        # every source shed (k data shards + the escalation try): the
+        # read fails without any shard touching its store
+        assert counters.get("subread_deadline_shed") == 3
+        assert out["obj"][0] == -EIO
+        # replies carried -ETIMEDOUT per object, recorded as errors
+        # (nothing left outstanding — the sources were released)
+        assert not c.primary.read_ops
+
+    def test_live_deadline_reads_normally(self):
+        pool, profiles = ec_pool(2, 1)
+        c = Cluster(pool, profiles)
+        data = payload(pool.stripe_width)
+        c.write("obj", 0, data)
+        counters = attach_perf(c)
+        out = start_read(c, "obj", len(data), deadline=time.monotonic() + 60.0)
+        c.pump()
+        assert out["obj"][0] == 0
+        assert out["obj"][1][0] == data
+        assert "subread_deadline_shed" not in counters
+
+
+class TestAdmissionShedIntegration:
+    """Tentpole tier 1 end to end: a real OSD sheds an op whose envelope
+    deadline expired before dispatch — counted, -ETIMEDOUT mapped back
+    to the client's TimeoutError, excluded from io-accounting."""
+
+    def test_expired_op_shed_at_admission(self):
+        async def run():
+            from ceph_tpu.client import Rados
+            from ceph_tpu.msg.messages import MOSDOp
+
+            from test_cluster import start_cluster, stop_cluster
+
+            monmap, mons, osds = await start_cluster(1, 3)
+            client = Rados(monmap)
+            await client.connect()
+            await client.pool_create("grayp", "replicated", size=3, pg_num=2)
+            io = await client.open_ioctx("grayp")
+            await io.write_full("obj", b"x" * 4096)
+            assert await io.read("obj") == b"x" * 4096
+
+            def accounted_reads():
+                return sum(
+                    cls.get("read", {}).get("ops", 0)
+                    for o in osds
+                    for cls in o.io_accountant.dump_pools().values()
+                )
+
+            before_acct = accounted_reads()
+            # queue wait ate the budget: every op leaves the client with
+            # its deadline already in the past
+            ob = client.objecter
+            orig_send = ob.msgr.send_to
+
+            async def stale_send(addr, msg):
+                if isinstance(msg, MOSDOp):
+                    msg.deadline = time.monotonic() - 0.05
+                await orig_send(addr, msg)
+
+            ob.msgr.send_to = stale_send
+            try:
+                with pytest.raises(TimeoutError, match="shed at osd admission"):
+                    await io.read("obj")
+            finally:
+                ob.msgr.send_to = orig_send
+            shed = sum(o.perf.get("op_deadline_shed") for o in osds)
+            assert shed >= 1, "no OSD counted an admission shed"
+            # never executed -> never accounted (like the -EAGAIN bounce)
+            assert accounted_reads() == before_acct
+            # the object is untouched and serves normally afterwards
+            assert await io.read("obj") == b"x" * 4096
+            await client.shutdown()
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())
+
+
+class TestLaggyDetectorIntegration:
+    """Tentpole tier 3 end to end: inflated peer RTT flips the detector
+    (with hysteresis), feeds the per-peer histograms satellite, and
+    surfaces OSD_SLOW_PEER at the mon — clearing once the peer recovers."""
+
+    def test_rtt_inflation_detects_surfaces_and_clears(self):
+        async def run():
+            from test_cluster import start_cluster, stop_cluster, wait_until
+
+            monmap, mons, osds = await start_cluster(1, 4)
+            o = osds[0]
+            # a healthy mesh: everyone answers in ~1 ms but peer 1
+            for _ in range(30):
+                for peer in (1, 2, 3):
+                    o._note_peer_rtt(peer, 0.5 if peer == 1 else 0.001)
+            o._laggy_check(time.monotonic())
+            assert o.laggy_peers() == {1}
+            # satellite (c): the sample stream filled the aggregate AND
+            # the lazily-declared per-peer RTT histograms on perf dump
+            dump = o.perf.dump()
+            assert "histogram" in dump["osd_heartbeat_rtt"]
+            for peer in (1, 2, 3):
+                hist = dump[f"osd_heartbeat_rtt_osd_{peer}"]["histogram"]
+                assert hist["count"] >= 30, hist
+            # the laggy report reaches the mon: OSD_SLOW_PEER with the
+            # victim named in the detail, and the victim stays up/in.
+            # The poll keeps feeding slow samples so the background
+            # heartbeat loop's real (fast) pings can't decay the EWMA
+            # under the exit threshold mid-wait.
+            def still_slow():
+                o._note_peer_rtt(1, 0.5)
+                o._laggy_check(time.monotonic())
+                return 1 in mons[0].osdmon.slow_peers()
+
+            await wait_until(still_slow, 5.0, "mon slow_peers carries osd.1")
+            checks, _detail = mons[0].health_checks()
+            assert "OSD_SLOW_PEER" in checks
+            assert "osd.1" in checks["OSD_SLOW_PEER"]
+            assert mons[0].osdmon.osdmap.osds[1].up
+            # recovery: fast samples decay the EWMA under the exit
+            # threshold (hysteresis at enter/2) and the one-shot
+            # laggy=2 retires the mon-side evidence
+            for _ in range(200):
+                o._note_peer_rtt(1, 0.001)
+            o._laggy_check(time.monotonic())
+            assert o.laggy_peers() == set()
+
+            def retired():
+                checks, _ = mons[0].health_checks()
+                return (
+                    1 not in mons[0].osdmon.slow_peers()
+                    and "OSD_SLOW_PEER" not in checks
+                )
+
+            await wait_until(retired, 5.0, "OSD_SLOW_PEER retired")
+            await stop_cluster(mons, osds)
+
+        asyncio.run(run())

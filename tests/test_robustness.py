@@ -407,6 +407,31 @@ class TestObjecterBackoff:
         run_b = [b._backoff_delay(i) for i in range(8)]
         assert run_a != run_b
 
+    def test_backoff_fails_fast_when_deadline_inside_delay(self):
+        """ISSUE 17 bugfix regression: an op whose deadline lands inside
+        the next backoff window must raise NOW — the old shape slept the
+        remaining budget away and failed only at the top of the loop."""
+        import time
+
+        from ceph_tpu.msg.messages import ReqId
+
+        ob = self._objecter()
+        span = ob.tracer.start_span("t")
+        reqid = ReqId("client.bk", 1)
+        with pytest.raises(TimeoutError, match="inside resend backoff"):
+            # backoff floor is 0.0125 s; 1 ms of budget sits inside it
+            ob._backoff_or_timeout(
+                time.monotonic() + 0.001, 0, reqid, "oid", span
+            )
+        assert ob.perf.get("op_timeout") == 1
+        # ample budget: the jittered delay comes back, nothing counted
+        d = ob._backoff_or_timeout(
+            time.monotonic() + 60.0, 0, reqid, "oid", span
+        )
+        assert 0.0 < d <= 1.0
+        assert ob.perf.get("op_timeout") == 1
+        span.finish()
+
     def test_resends_counted_in_perfcounter(self):
         import asyncio
 
