@@ -591,6 +591,19 @@ class DonationPool:
         self._free.clear()
         return freed
 
+    def drop_batch(self, batch: int) -> int:
+        """Drop the FREE pooled buffers whose leading (batch) dimension
+        is `batch` — shapes a retired pad bucket can no longer produce
+        (ISSUE 18): when the bucket learner evicts a target, every
+        pooled output at that geometry is dead weight, and bucket churn
+        must not pin HBM in the mempool ledger.  Returns bytes freed;
+        live refcounts are untouched."""
+        freed = 0
+        for shape in [s for s in self._free if s and s[0] == batch]:
+            for buf in self._free.pop(shape):
+                freed += self._mem_release(buf)
+        return freed
+
     # mapping-ish view (tests and introspection): the shapes with at
     # least one FREE buffer pooled
     def __iter__(self):
@@ -598,6 +611,80 @@ class DonationPool:
 
     def __len__(self) -> int:
         return sum(1 for slot in self._free.values() if slot)
+
+
+class _PadBuckets:
+    """Learned launch-size buckets for one (matrix, chunk-size) group
+    key (ISSUE 18): replaces the static pow2/64-multiple `_pad_target`
+    with a small set of batch sizes the key's workload actually
+    produces.  A batch size seen `PROMOTE_AFTER` times becomes a bucket
+    (padding a recurring 23-stripe launch to 32 wastes 28% of every
+    launch forever; padding it to 23 wastes nothing and still recurs
+    for the jit cache and the donation pool); the slot set is bounded
+    and LRU-evicted so the jit-cache geometry count stays capped, and
+    the caller drops the evicted target's pooled output buffers
+    (DonationPool.drop_batch).  A padding-waste EWMA per key feeds the
+    `padding_waste_ratio` export.  Callers serialize access under the
+    aggregator-wide lock."""
+
+    PROMOTE_AFTER = 3
+    EWMA_ALPHA = 0.2
+    # candidate-count map bound: recurring sizes promote out of it long
+    # before this; a never-repeating workload must not grow it unboundedly
+    CANDIDATE_CAP = 64
+
+    __slots__ = ("buckets", "_counts", "_lru", "_seq", "waste_ewma")
+
+    def __init__(self) -> None:
+        self.buckets: list[int] = []  # sorted learned batch targets
+        self._counts: "OrderedDict[int, int]" = OrderedDict()
+        self._lru: dict[int, int] = {}  # bucket -> last-use seq
+        self._seq = 0
+        self.waste_ewma = 0.0
+
+    def target(self, stripes: int, static: int, cap: int) -> tuple[int, int | None]:
+        """(pad target for `stripes`, evicted bucket or None).
+
+        The smallest learned bucket >= `stripes` wins when it beats the
+        static bucket; otherwise the static target stands.  Learning:
+        `stripes` itself is promoted to a bucket once seen
+        PROMOTE_AFTER times (exact fit = zero waste for the recurring
+        size); past `cap` buckets the least-recently-used target is
+        evicted and returned so the caller can drop its pooled buffers."""
+        self._seq += 1
+        evicted: int | None = None
+        target = static
+        for b in self.buckets:  # sorted: first fit is smallest
+            if b >= stripes:
+                if b < static:
+                    target = b
+                break
+        if target in self._lru:
+            self._lru[target] = self._seq
+        if target != stripes and stripes not in self.buckets:
+            # static padding is wasting stripes on this size: count it
+            # toward promotion
+            seen = self._counts.get(stripes, 0) + 1
+            if seen >= self.PROMOTE_AFTER:
+                self._counts.pop(stripes, None)
+                self.buckets.append(stripes)
+                self.buckets.sort()
+                self._lru[stripes] = self._seq
+                target = stripes
+                if len(self.buckets) > max(1, cap):
+                    evicted = min(self.buckets, key=lambda b: self._lru[b])
+                    self.buckets.remove(evicted)
+                    self._lru.pop(evicted, None)
+                    if evicted == target:  # evicted ourselves: static stands
+                        target = static
+            else:
+                self._counts[stripes] = seen
+                self._counts.move_to_end(stripes)
+                while len(self._counts) > self.CANDIDATE_CAP:
+                    self._counts.popitem(last=False)
+        waste = (target - stripes) / target if target else 0.0
+        self.waste_ewma += self.EWMA_ALPHA * (waste - self.waste_ewma)
+        return target, evicted
 
 
 class _AggGroup:
@@ -608,7 +695,7 @@ class _AggGroup:
         "key", "ec", "ctx", "arrays", "tickets", "stripes", "nbytes",
         "parity", "host", "pad", "error", "donatable", "lock",
         "input", "credit", "flight", "submit_ts", "stalled", "held",
-        "mem",
+        "mem", "fused_windows",
     )
 
     def __init__(self, key, ec, ctx=None):
@@ -642,6 +729,11 @@ class _AggGroup:
         self.flight: dict | None = None
         self.submit_ts = time.monotonic()
         self.stalled = False
+        # super-launch fusion (ISSUE 18): > 0 once this group's window
+        # trip was deferred because the in-flight ring was full — the
+        # group keeps accumulating whole windows behind the backlog and
+        # launches them fused (one dispatch, per-ticket settle slices)
+        self.fused_windows = 0
         # serializes THIS group's launch/materialization (the encode
         # dispatch + blocking device wait) without stalling the
         # aggregator-wide lock; RLock because a reap-forced launch runs
@@ -687,7 +779,9 @@ class LaunchAggregator:
 
     def __init__(self, window: int = 0, max_bytes: int = 64 << 20,
                  pad_pow2: bool = True, inflight_max_bytes: int | None = None,
-                 pipeline_depth: int | None = None):
+                 pipeline_depth: int | None = None,
+                 fuse_max_windows: int | None = None,
+                 pad_buckets: int | None = None):
         from ceph_tpu.common.perf_counters import PerfCountersBuilder
         from ceph_tpu.common.throttle import Throttle
 
@@ -705,6 +799,26 @@ class LaunchAggregator:
 
             pipeline_depth = int(OPTIONS["ec_tpu_pipeline_depth"].default)
         self.pipeline_depth = int(pipeline_depth)
+        # super-launch fusion bound (ISSUE 18): with the in-flight ring
+        # full, a group whose window trips may keep accumulating up to
+        # this many windows and launch them as ONE fused dispatch —
+        # amortizing dispatch overhead exactly when the backlog proves
+        # demand.  <= 1 disables fusion (every window trip launches).
+        if fuse_max_windows is None:
+            from ceph_tpu.common.options import OPTIONS
+
+            fuse_max_windows = int(OPTIONS["ec_tpu_fuse_max_windows"].default)
+        self.fuse_max_windows = int(fuse_max_windows)
+        # learned pad-bucket slots per group key (ISSUE 18): recurring
+        # batch sizes promote to exact-fit launch targets, bounded and
+        # LRU-evicted so the jit cache stays capped.  <= 0 keeps the
+        # static pow2/64-multiple targets only.
+        if pad_buckets is None:
+            from ceph_tpu.common.options import OPTIONS
+
+            pad_buckets = int(OPTIONS["ec_tpu_pad_buckets"].default)
+        self.pad_buckets = int(pad_buckets)
+        self._pad_state: dict[tuple, _PadBuckets] = {}
         from ceph_tpu.ops.dispatch import PIPELINE
 
         PIPELINE.set_depth(self.pipeline_depth)
@@ -734,7 +848,7 @@ class LaunchAggregator:
         for c in ("submits", "launches", "flush_window", "flush_bytes",
                   "flush_explicit", "flush_immediate", "flush_reap",
                   "flush_backpressure", "pad_stripes", "host_fallbacks",
-                  "throttle_stalls"):
+                  "throttle_stalls", "fused_launches", "fused_windows"):
             b.add_u64_counter(c)
         b.add_histogram("stripes_per_launch",
                         "stripe-batch occupancy of each device launch",
@@ -752,7 +866,9 @@ class LaunchAggregator:
 
     def configure(self, window: int | None = None, max_bytes: int | None = None,
                   inflight_max_bytes: int | None = None,
-                  pipeline_depth: int | None = None) -> None:
+                  pipeline_depth: int | None = None,
+                  fuse_max_windows: int | None = None,
+                  pad_buckets: int | None = None) -> None:
         """Apply live config (the OSD wires its Config + runtime observers
         here, so the aggregate_* settings reach the shared instance)."""
         if window is not None:
@@ -761,6 +877,26 @@ class LaunchAggregator:
             self.max_bytes = int(max_bytes)
         if inflight_max_bytes is not None:
             self.inflight.limit = int(inflight_max_bytes)
+        if fuse_max_windows is not None:
+            self.fuse_max_windows = int(fuse_max_windows)
+        if pad_buckets is not None:
+            self.pad_buckets = int(pad_buckets)
+            with self._lock:
+                # shrinking the bucket bound must trim now-dead shapes:
+                # retired targets' pooled outputs would pin HBM forever
+                for state in self._pad_state.values():
+                    while len(state.buckets) > max(1, self.pad_buckets):
+                        gone = min(
+                            state.buckets, key=lambda b: state._lru[b]
+                        )
+                        state.buckets.remove(gone)
+                        state._lru.pop(gone, None)
+                        self._donate_pool.drop_batch(gone)
+                if self.pad_buckets <= 0:
+                    for state in self._pad_state.values():
+                        for b in state.buckets:
+                            self._donate_pool.drop_batch(b)
+                    self._pad_state.clear()
         if pipeline_depth is not None:
             self.pipeline_depth = int(pipeline_depth)
             with self._lock:
@@ -821,6 +957,25 @@ class LaunchAggregator:
                 reason = "flush_bytes"
             elif len(g.tickets) >= self.window:
                 reason = "flush_window"
+                # super-launch fusion (ISSUE 18): the window tripped but
+                # the in-flight ring is full — launching now would only
+                # queue a dispatch behind the backlog.  Defer the trip
+                # (the group stays windowed, accumulating whole windows)
+                # until the ring drains, the fuse bound or byte budget
+                # trips, or a barrier/reap flushes: the deferred windows
+                # then ride ONE fused dispatch, amortizing its overhead
+                # exactly when demand is proven.  Per-ticket settle
+                # slices, QoS arbitration, and the host-oracle fallback
+                # are untouched — a fused group is just a bigger group.
+                if (
+                    self.fuse_max_windows > 1
+                    and self.pipeline_depth > 0
+                    and len(self._live) >= self.pipeline_depth
+                    and len(g.tickets) < self.window * self.fuse_max_windows
+                    and g.nbytes < self.max_bytes
+                ):
+                    g.fused_windows = len(g.tickets) // self.window
+                    reason = None
             if reason is not None:
                 self._groups.pop(key, None)  # detach under the lock...
         if reason is not None:
@@ -934,6 +1089,10 @@ class LaunchAggregator:
                 self._launch(g, "flush_explicit")
             except Exception:
                 continue  # sticky on the group; other groups still launch
+        if detached:
+            # a fused group deferred past a full ring (ISSUE 18) launches
+            # here — re-bound the in-flight set at the depth budget
+            self._drain_pipeline()
 
     # -- launch + reap -------------------------------------------------------
 
@@ -945,6 +1104,39 @@ class LaunchAggregator:
         if stripes <= 64:
             return _next_pow2(stripes)
         return -(-stripes // 64) * 64
+
+    def _pad_target_for(self, key, stripes: int) -> int:
+        """Bucketed pad specialization (ISSUE 18): the static bucket,
+        improved by the per-key learner when this key's workload keeps
+        producing a batch size the static rounding wastes stripes on.
+        Updates the key's waste EWMA and the process-wide pad_waste
+        slice inputs; evicted bucket targets drop their pooled output
+        buffers so bucket churn cannot pin HBM."""
+        static = self._pad_target(stripes)
+        if self.pad_buckets <= 0:
+            return static
+        with self._lock:
+            state = self._pad_state.get(key)
+            if state is None:
+                state = self._pad_state[key] = _PadBuckets()
+            target, evicted = state.target(stripes, static, self.pad_buckets)
+            if evicted is not None:
+                self._donate_pool.drop_batch(evicted)
+        return target
+
+    def padding_waste(self) -> dict[str, float]:
+        """Per-key padding-waste EWMA snapshot (introspection/tests),
+        keyed by the group label `_group_label` would give the key."""
+        import zlib
+
+        with self._lock:
+            out = {}
+            for key, state in self._pad_state.items():
+                chunk = key[-1] if key and isinstance(key[-1], int) else 0
+                digest = zlib.crc32(repr(key).encode())
+                label = f"{self.PERF_NAME}/{digest:08x}/L{chunk}"
+                out[label] = state.waste_ewma
+            return out
 
     def _launch(self, g: _AggGroup, reason: str) -> None:
         """Concatenate a (detached) group's submissions into one padded
@@ -961,7 +1153,7 @@ class LaunchAggregator:
             # direct path never did
             pad = 0
             if self.pad_pow2 and self.window > 1:
-                pad = self._pad_target(g.stripes) - g.stripes
+                pad = self._pad_target_for(g.key, g.stripes) - g.stripes
             if pad:
                 data = np.concatenate(
                     [data, np.zeros((pad, *data.shape[1:]), dtype=np.uint8)]
@@ -998,6 +1190,16 @@ class LaunchAggregator:
                 reason=reason,
                 sched_class=self.SCHED_CLASS,
             )
+            rec["pad_stripes"] = pad
+            # fused verdict (ISSUE 18): the deferral armed AND the group
+            # actually accumulated more than one window before launching
+            # (a reap right after the deferral is a plain launch)
+            fused_windows = 0
+            if g.fused_windows and self.window > 1:
+                fused_windows = len(g.tickets) // self.window
+            if fused_windows > 1:
+                rec["flags"]["fused"] = True
+                rec["fused_windows"] = fused_windows
             if g.stalled:
                 rec["flags"]["throttle_stall"] = True
             # QoS arbitration (ISSUE 9): the ready launch enters the
@@ -1121,6 +1323,19 @@ class LaunchAggregator:
         self.perf.hinc("stripes_per_launch", g.stripes)
         self.perf.hinc("tickets_per_launch", len(g.tickets))
         self.perf.hinc("launch_bytes", data.nbytes)
+        if fused_windows > 1:
+            self.perf.inc("fused_launches")
+            self.perf.inc("fused_windows", fused_windows)
+            from ceph_tpu.ops.dispatch import record_fused
+
+            record_fused(fused_windows)
+        if pad or (self.pad_pow2 and self.window > 1):
+            # padding-waste slice (ISSUE 18): every padded-mode launch
+            # reports its batch and pad so perf_dump's pad_waste.<label>
+            # and padding_waste_ratio show where padding bytes go
+            from ceph_tpu.ops.dispatch import record_padding
+
+            record_padding(self._group_label(g), g.stripes + pad, pad)
 
     def _group_label(self, g: _AggGroup) -> str:
         """Stable human-readable lane name for a group's flight records
@@ -1765,6 +1980,48 @@ class MatrixCodecMixin:
         from ceph_tpu.ops.packed_gf import packed_code_host
 
         return packed_code_host(mat[self.k :], arr)
+
+    def encode_delta_device(
+        self, old_bufs, new_bufs, parity_bufs, chunk: int
+    ) -> jnp.ndarray:
+        """RMW parity delta, fully on device (ISSUE 18): k + k + m FLAT
+        per-shard device buffers (the chunk cache's native layout) ->
+        (stripes, m, chunk) NEW parity in ONE fused launch.  The code is
+        GF(2)-linear, so parity_new = parity_old ^ Encode(old ^ new)
+        with Encode the SAME reduced plane program `encode_array`'s
+        packed path compiles — the delta path cannot drift byte-wise
+        from a full re-encode.  Counts exactly one dispatch on the
+        launch gauges (`devices_per_launch` stays consistent)."""
+        from ceph_tpu.ops.packed_gf import _packed_delta_flat, best_program
+
+        mat = self.distribution_matrix()
+        prog = best_program(mat[self.k :])
+        stripes = int(old_bufs[0].size) // int(chunk)
+        nbytes = sum(
+            int(b.size)
+            for bufs in (old_bufs, new_bufs, parity_bufs)
+            for b in bufs
+        )
+        record_launch(stripes, nbytes)
+        return _packed_delta_flat(
+            tuple(old_bufs), tuple(new_bufs), tuple(parity_bufs),
+            sched=prog, k=self.k, m=self.m, chunk=int(chunk),
+        )
+
+    def encode_delta_host(
+        self, old_data, new_data, old_parity
+    ) -> np.ndarray:
+        """Byte-identical HOST oracle of encode_delta_device (pure
+        numpy): same chosen program via packed_delta_host, same xor
+        composition — the anchor the delta-path byte-identity tests pin
+        the device bytes against.  (S, k, L) old/new data + (S, m, L)
+        old parity -> (S, m, L) new parity."""
+        from ceph_tpu.ops.packed_gf import packed_delta_host
+
+        mat = self.distribution_matrix()
+        return packed_delta_host(
+            mat[self.k :], old_data, new_data, old_parity
+        )
 
     def decode_array_host(self, erasures: list[int], survivors) -> np.ndarray:
         """Byte-identical HOST oracle of decode_array (pure numpy): the

@@ -288,6 +288,59 @@ OPTIONS: dict[str, Option] = _opts(
         runtime=True,
     ),
     Option(
+        "ec_tpu_fuse_max_windows",
+        int,
+        4,
+        A,
+        "super-launch fusion bound (ISSUE 18): when the in-flight launch "
+        "ring (ec_tpu_pipeline_depth) is full as an aggregation window "
+        "trips, the group keeps accumulating up to this many whole "
+        "windows and launches them as ONE fused multi-window dispatch — "
+        "amortizing the fixed dispatch overhead exactly when the backlog "
+        "proves demand.  Per-ticket settle slices, QoS arbitration and "
+        "the host-oracle fallback are unchanged; fused launches count on "
+        "fused_launches/fused_windows and flag `fused` on their flight "
+        "records.  <= 1 disables fusion (every window trip launches "
+        "immediately)",
+        see_also=("ec_tpu_pipeline_depth", "ec_tpu_aggregate_window"),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_pad_buckets",
+        int,
+        4,
+        A,
+        "learned pad-bucket slots per aggregation group key (ISSUE 18): "
+        "a batch size the key's workload produces repeatedly is promoted "
+        "to an exact-fit launch target instead of rounding up to the "
+        "static pow2/64-multiple bucket, cutting zero-pad stripes on "
+        "recurring sizes while the bounded, LRU-evicted slot set keeps "
+        "the jit-cache geometry count capped (evicted targets drop "
+        "their pooled output buffers so bucket churn cannot pin HBM).  "
+        "Waste is exported as padding_waste_ratio / pad_waste.<label>.  "
+        "<= 0 keeps the static buckets only",
+        see_also=("ec_tpu_aggregate_window",),
+        runtime=True,
+    ),
+    Option(
+        "ec_tpu_rmw_delta",
+        bool,
+        True,
+        A,
+        "on-device RMW delta-encode path (ISSUE 18): when every operand "
+        "of a read-modify-write — the k pre-write data chunks AND the m "
+        "parity chunks — is resident in the device chunk cache at the "
+        "op's pre-write generation, parity is updated IN HBM via the "
+        "GF(2)-linear delta program (parity_new = parity_old xor "
+        "Encode(data_old xor data_new), the same chosen XOR schedule as "
+        "a full encode) — one launch, zero H2D and zero D2H on the "
+        "flight record, byte-identical to the host-oracle RMW.  Any "
+        "cache miss or a DEGRADED backend falls back to the existing "
+        "materialize path",
+        see_also=("ec_tpu_device_cache_bytes",),
+        runtime=True,
+    ),
+    Option(
         "ec_tpu_device_cache_bytes",
         int,
         32 << 20,

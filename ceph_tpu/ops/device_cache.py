@@ -85,6 +85,7 @@ class DeviceChunkCache:
         self.invalidations = 0
         self.served_bytes = 0
         self.put_failures = 0
+        self.delta_updates = 0
 
     # -- configuration -------------------------------------------------------
 
@@ -124,7 +125,10 @@ class DeviceChunkCache:
 
         if device_guard().degraded:
             return False
-        arr = np.asarray(data, dtype=np.uint8).reshape(-1)
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            arr = np.frombuffer(data, dtype=np.uint8)
+        else:
+            arr = np.asarray(data, dtype=np.uint8).reshape(-1)
         nbytes = arr.nbytes
         if nbytes == 0 or nbytes > self.max_bytes:
             return False
@@ -197,7 +201,72 @@ class DeviceChunkCache:
                 freed += self._evict_lru_one_locked()
         return freed
 
+    def replace(self, obj, shard: int, generation, buf, off: int = 0) -> bool:
+        """Commit an ALREADY-DEVICE-RESIDENT buffer under a new
+        generation — the RMW delta path's parity/data commit (ISSUE 18):
+        the delta kernel's output never leaves HBM, so there is no host
+        array to ``put``; the generation bumps in place and only the
+        ledger re-accounts.  Counts on ``delta_updates``."""
+        if not self.enabled or generation is None:
+            return False
+        from .guard import device_guard
+
+        if device_guard().degraded:
+            return False
+        nbytes = int(buf.nbytes)
+        if nbytes == 0 or nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            key = (obj, int(shard), int(off))
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                self._by_obj[obj].discard(key)
+                if old.mem is not None:
+                    old.mem.free()
+            self._evict_to_fit_locked(nbytes)
+            self._entries[key] = _Entry(
+                buf, nbytes, generation, off,
+                mem=_hbm_ledger().alloc("device_cache", nbytes, buf=buf),
+            )
+            self._by_obj.setdefault(obj, set()).add(key)
+            self._bytes += nbytes
+            self.insertions += 1
+            self.delta_updates += 1
+        return True
+
     # -- consumer side -------------------------------------------------------
+
+    def get_resident_many(
+        self, obj, shards, generation, off: int = 0,
+        length: int | None = None,
+    ) -> dict | None:
+        """All-or-nothing consult returning the DEVICE buffers — no D2H,
+        no flight record: the RMW delta read leg (ISSUE 18).  The caller
+        composes these into ONE delta launch whose flight record shows
+        h2d_s == d2h_s == 0; a partial hit returns None (the materialize
+        path re-encodes anyway, so serving half would be pure waste).
+        The returned buffers stay valid even if a subsequent put/replace
+        supersedes their keys (the arrays are refcounted)."""
+        shards = list(shards)
+        if not shards or not self.enabled:
+            return None
+        with self._lock:
+            out = {}
+            for s in shards:
+                entry = self._entries.get((obj, int(s), int(off)))
+                if (
+                    entry is None
+                    or entry.generation != generation
+                    or (length is not None and entry.nbytes < length)
+                ):
+                    self.misses += len(shards)
+                    return None
+                out[int(s)] = entry.buf
+            for s in shards:
+                self._entries.move_to_end((obj, int(s), int(off)))
+            self.hits += len(shards)
+        return out
 
     def get(self, obj, shard: int, generation, off: int = 0,
             length: int | None = None):
@@ -339,6 +408,7 @@ class DeviceChunkCache:
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
                 "put_failures": self.put_failures,
+                "delta_updates": self.delta_updates,
                 "served_bytes": self.served_bytes,
                 "resident_bytes": self._bytes,
                 "entries": len(self._entries),

@@ -183,6 +183,108 @@ class PipelineGauges:
 
 PIPELINE = PipelineGauges()
 
+
+class PaddingWaste:
+    """Pad-stripe accounting for aggregated launches (ISSUE 18): every
+    padded launch records its padded batch and how many of those stripes
+    were zero padding, globally and per group label, so `perf dump` (and
+    the bench) can show WHERE padding bytes go instead of only that the
+    `pad_stripes` counter moved.  The per-label map is capped — group
+    labels are bounded in practice (one per (matrix, chunk-size) key),
+    but a pathological key churn must not grow the perf dump unboundedly."""
+
+    LABEL_CAP = 32
+
+    __slots__ = ("_lock", "padded_stripes", "pad_stripes", "_labels")
+
+    def __init__(self) -> None:
+        self._lock = make_lock("padding_waste")
+        self.padded_stripes = 0  # stripes dispatched, padding included
+        self.pad_stripes = 0  # of those, zero-pad stripes
+        self._labels: dict[str, list[int]] = {}  # label -> [padded, pad]
+
+    def record(self, label: str, padded: int, pad: int) -> None:
+        with self._lock:
+            self.padded_stripes += int(padded)
+            self.pad_stripes += int(pad)
+            slot = self._labels.get(label)
+            if slot is None:
+                if len(self._labels) >= self.LABEL_CAP:
+                    return  # global totals still track the overflow
+                slot = self._labels[label] = [0, 0]
+            slot[0] += int(padded)
+            slot[1] += int(pad)
+
+    def ratio(self) -> float:
+        with self._lock:
+            if not self.padded_stripes:
+                return 0.0
+            return self.pad_stripes / self.padded_stripes
+
+    def per_label(self) -> dict[str, float]:
+        with self._lock:
+            return {
+                label: (pad / padded if padded else 0.0)
+                for label, (padded, pad) in self._labels.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.padded_stripes = 0
+            self.pad_stripes = 0
+            self._labels.clear()
+
+
+PAD_WASTE = PaddingWaste()
+
+
+def record_padding(label: str, padded: int, pad: int) -> None:
+    """Record one padded aggregated launch: `padded` stripes dispatched
+    (padding included) of which `pad` were zero padding, attributed to
+    the group `label` (codec/matrix_codec._group_label)."""
+    PAD_WASTE.record(label, padded, pad)
+
+
+class FusedGauges:
+    """Super-launch fusion totals (ISSUE 18): launches that carried more
+    than one aggregation window's worth of tickets because the in-flight
+    ring was full when their window tripped, and the windows they fused.
+    Mirrors of the per-aggregator `fused_launches`/`fused_windows` perf
+    counters, totalled process-wide for the dispatch perf dump."""
+
+    __slots__ = ("_lock", "fused_launches", "fused_windows")
+
+    def __init__(self) -> None:
+        self._lock = make_lock("fused_gauges")
+        self.fused_launches = 0
+        self.fused_windows = 0
+
+    def record(self, windows: int) -> None:
+        with self._lock:
+            self.fused_launches += 1
+            self.fused_windows += int(windows)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "fused_launches": self.fused_launches,
+                "fused_windows": self.fused_windows,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.fused_launches = 0
+            self.fused_windows = 0
+
+
+FUSED = FusedGauges()
+
+
+def record_fused(windows: int) -> None:
+    """Record one fused multi-window launch spanning `windows` windows."""
+    FUSED.record(windows)
+
+
 # Launches that completed on the HOST ORACLE instead of the device
 # (ops/guard.py DeviceGuard fallback: launch deadline exceeded, device
 # error, or degraded-mode bypass).  NOT counted in LAUNCHES — these never
@@ -300,6 +402,19 @@ def perf_dump() -> dict[str, object]:
     # drains, and the recycled-live invariant counter (must stay 0)
     for name, val in PIPELINE.snapshot().items():
         out[f"pipeline.{name}"] = val
+    # super-launch fusion totals (ISSUE 18): launches carrying more than
+    # one window's worth of tickets because the ring was full, and the
+    # windows they fused — launches < submits/window proves amortization
+    for name, val in FUSED.snapshot().items():
+        out[name] = val
+    # padding-waste accounting (ISSUE 18): the process-wide pad-stripe
+    # fraction of everything dispatched padded, plus a per-group-label
+    # slice (`pad_waste.<label>`) so asok/Perfetto show WHERE padding
+    # bytes go — the bench proves the bucketed targets push the global
+    # ratio below the pow2 baseline
+    out["padding_waste_ratio"] = round(PAD_WASTE.ratio(), 6)
+    for label, ratio in sorted(PAD_WASTE.per_label().items()):
+        out[f"pad_waste.{label}"] = round(ratio, 6)
     # device-resident chunk cache (ISSUE 11): hit/miss/evict counters
     # plus the resident-bytes/entries gauges, as `cache.<counter>`
     # scalars -> ceph_tpu_ec_dispatch_cache_* prometheus families

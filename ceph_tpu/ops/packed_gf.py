@@ -397,6 +397,68 @@ def _packed_verify(codeword: jax.Array, *, sched, k: int, m: int) -> jax.Array:
     return _packed_verify_impl(codeword, sched, k, m)
 
 
+@functools.partial(jax.jit, static_argnames=("sched", "k", "m"))
+def _packed_delta(
+    old_data: jax.Array,
+    new_data: jax.Array,
+    old_parity: jax.Array,
+    *,
+    sched,
+    k: int,
+    m: int,
+) -> jax.Array:
+    """RMW parity delta (ISSUE 18), fully on device: the GF(2^8) code is
+    linear over GF(2), so
+
+        parity_new = parity_old ^ Encode(data_old ^ data_new)
+
+    with Encode the SAME plane program a full encode would run — the
+    delta path can never drift from the materialize path byte-wise.
+    (..., k, L) old/new data + (..., m, L) old parity -> (..., m, L) new
+    parity, one fused launch, no host round-trip."""
+    return old_parity ^ _packed_code_impl(old_data ^ new_data, sched, k, m)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sched", "k", "m", "chunk")
+)
+def _packed_delta_flat(
+    old_data: tuple,
+    new_data: tuple,
+    old_parity: tuple,
+    *,
+    sched,
+    k: int,
+    m: int,
+    chunk: int,
+) -> jax.Array:
+    """`_packed_delta` over the cache's native layout: k + k + m FLAT
+    per-shard device buffers (each a shard's contiguous (stripes*chunk,)
+    bytes, exactly what DeviceChunkCache holds) fused into one launch —
+    the reshape/stack/xor/encode/xor chain compiles as a single program,
+    so a cache-hit RMW pays ONE dispatch and zero host transfers."""
+    od = jnp.stack([b.reshape(-1, chunk) for b in old_data], axis=1)
+    nd = jnp.stack([b.reshape(-1, chunk) for b in new_data], axis=1)
+    op_ = jnp.stack([b.reshape(-1, chunk) for b in old_parity], axis=1)
+    return op_ ^ _packed_code_impl(od ^ nd, sched, k, m)
+
+
+def packed_delta_host(
+    gf_matrix: np.ndarray,
+    old_data: np.ndarray,
+    new_data: np.ndarray,
+    old_parity: np.ndarray,
+) -> np.ndarray:
+    """Host oracle of `_packed_delta`: same chosen program via
+    run_program_host, same xor composition — the byte-identity anchor
+    the delta-path tests pin the device bytes against."""
+    delta = run_program_host(
+        best_program(gf_matrix),
+        np.asarray(old_data, np.uint8) ^ np.asarray(new_data, np.uint8),
+    )
+    return np.asarray(old_parity, np.uint8) ^ delta
+
+
 class PackedVerifyPlan:
     """Compare-only packed-plane plan (ISSUE 9): one fused jit per
     parity matrix that recomputes parity for a (batch, k+m, L) codeword

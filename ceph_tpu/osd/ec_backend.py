@@ -72,8 +72,32 @@ from .ec_transaction import (
     finish_transactions,
     get_write_plan,
     launch_encode,
+    launch_encode_delta,
 )
 from .pg_log import Eversion, LogEntry, LOG_DELETE, LOG_MODIFY
+
+
+# on-device RMW delta path arm bit (ISSUE 18, `ec_tpu_rmw_delta`):
+# process-wide like the device cache it composes with; daemons with a
+# live Config re-bind it through their runtime observers (osd.py).
+# None = not configured yet — read the option default lazily.
+_RMW_DELTA: bool | None = None
+
+
+def configure_rmw_delta(enabled: bool) -> None:
+    """Arm/disarm the on-device RMW delta-encode path (the
+    `ec_tpu_rmw_delta` observer hook)."""
+    global _RMW_DELTA
+    _RMW_DELTA = bool(enabled)
+
+
+def rmw_delta_enabled() -> bool:
+    global _RMW_DELTA
+    if _RMW_DELTA is None:
+        from ..common.options import OPTIONS
+
+        _RMW_DELTA = bool(OPTIONS["ec_tpu_rmw_delta"].default)
+    return _RMW_DELTA
 
 
 @dataclass
@@ -99,6 +123,11 @@ class Op:
     # generation.  None when an earlier in-flight write makes the
     # on-disk bytes ambiguous.
     cache_read_gen: object = None
+    # this op's encode took the on-device delta path (ISSUE 18): its
+    # launch already committed data + parity into the device cache at
+    # the write's generation, so the reap must not re-seed (or
+    # invalidate) the cache
+    delta: bool = False
     # LAUNCHED device encode awaiting dispatch (EncodeStage); the encode
     # pipeline reaps these FIFO so sub-writes fan out in tid order
     encode_stage: object | None = None
@@ -614,28 +643,65 @@ class ECBackend(PGBackend):
         out when the pipeline reaps the op (FIFO), so the next op's RMW
         reads overlap this op's device encode — the overlap the reference
         gets from queued AIO in front of ec_encode_data."""
-        # overwrite invalidation (ISSUE 11): from here on the object's
-        # bytes are changing — this op's RMW read leg (which could still
-        # serve the committed pre-write bytes) is complete, so drop the
-        # now-stale device-resident chunks (the generation bump would
-        # make them miss anyway; this frees HBM eagerly)
         cache = self._chunk_cache()
-        if cache is not None:
-            cache.invalidate_object(self._cache_obj(op.pgt.oid))
         op.encode_t0 = time.monotonic()
-        # scope the launch under ec:write so codec h2d/kernel_launch
-        # sub-spans (codec/tracing.py) and the PendingEncode's reap span
-        # attach to this op's trace
-        with tracer_mod.span_scope(op.trace):
-            op.encode_stage = launch_encode(
-                op.pgt,
-                op.plan,
-                self.sinfo,
-                self.ec,
-                op.obj_size,
-                op.read_results,
-                aggregator=self.encode_aggregator,
-            )
+        stage = None
+        # on-device RMW delta (ISSUE 18): when the cache holds EVERY
+        # shard of the written regions at the op's pre-write generation,
+        # parity updates IN HBM (one launch, zero H2D/D2H on its flight
+        # record) and the cache generation bumps in place — no
+        # invalidation, no materialize launch.  Preconditions: armed,
+        # overwrites pool, an actual RMW (to_read non-empty), an
+        # unambiguous pre-write generation, and no truncate (a size
+        # change re-shapes regions; not worth delta bookkeeping).
+        if (
+            cache is not None
+            and rmw_delta_enabled()
+            and self.allows_overwrites
+            and op.plan.to_read
+            and op.cache_read_gen is not None
+            and op.pgt.truncate is None
+        ):
+            with tracer_mod.span_scope(op.trace):
+                stage = launch_encode_delta(
+                    op.pgt,
+                    op.plan,
+                    self.sinfo,
+                    self.ec,
+                    op.obj_size,
+                    op.read_results,
+                    cache,
+                    self._cache_obj(op.pgt.oid),
+                    op.cache_read_gen,
+                    op.version.version,
+                )
+            if stage is not None:
+                op.delta = True
+                op.trace.event("delta encode launched (cache hit)")
+        if stage is None:
+            # overwrite invalidation (ISSUE 11): from here on the
+            # object's bytes are changing — this op's RMW read leg
+            # (which could still serve the committed pre-write bytes) is
+            # complete, so drop the now-stale device-resident chunks
+            # (the generation bump would make them miss anyway; this
+            # frees HBM eagerly).  Also drops any half-committed
+            # new-generation entries from an aborted delta attempt.
+            if cache is not None:
+                cache.invalidate_object(self._cache_obj(op.pgt.oid))
+            # scope the launch under ec:write so codec h2d/kernel_launch
+            # sub-spans (codec/tracing.py) and the PendingEncode's reap
+            # span attach to this op's trace
+            with tracer_mod.span_scope(op.trace):
+                stage = launch_encode(
+                    op.pgt,
+                    op.plan,
+                    self.sinfo,
+                    self.ec,
+                    op.obj_size,
+                    op.read_results,
+                    aggregator=self.encode_aggregator,
+                )
+        op.encode_stage = stage
         op.encoded = True
         op.trace.event("encode launched")
         # Pin exactly the bytes that were encoded (host-side, available at
@@ -729,6 +795,19 @@ class ECBackend(PGBackend):
             hinfo = proj["hinfo"]
         else:
             hinfo = self.get_hash_info(op.pgt.oid)
+        # cache seeding (ISSUE 18): a materialize-path write on an
+        # overwrites pool seeds every region's k+m shard chunks into the
+        # device cache at its generation — the residency the NEXT RMW's
+        # delta path hits.  A delta-path op skips it (its launch already
+        # committed data + parity in place, with no host round-trip).
+        cache = self._chunk_cache()
+        seed = (
+            cache is not None
+            and rmw_delta_enabled()
+            and self.allows_overwrites
+            and not op.delta
+            and not op.pgt.delete
+        )
         # the reap may run from a bare event-loop callback (_drain_encode_pipe):
         # re-enter the op's span scope so materialization sub-spans attach
         with tracer_mod.span_scope(op.trace):
@@ -743,6 +822,13 @@ class ECBackend(PGBackend):
                     op.obj_size,
                     hinfo,
                     op.version.version,
+                    chunk_cache=cache if seed else None,
+                    cache_obj=(
+                        self._cache_obj(op.pgt.oid) if seed else None
+                    ),
+                    cache_generation=(
+                        op.version.version if seed else None
+                    ),
                 )
             except EcError as e:
                 # a failed (aggregated) encode launch surfaces here, at
@@ -817,6 +903,13 @@ class ECBackend(PGBackend):
         read-failure convention."""
         oid = op.pgt.oid
         errno = -abs(err.errno or EIO)
+        # a delta-path op already committed data + parity into the device
+        # cache at its (now never-to-commit) generation: drop them —
+        # stale generations would miss anyway, but the bytes are dead
+        if op.delta:
+            cache = self._chunk_cache()
+            if cache is not None:
+                cache.invalidate_object(self._cache_obj(oid))
         doomed = [op] + [
             o
             for o in list(self.in_flight.values()) + self.waiting_reads

@@ -188,24 +188,21 @@ class EncodeStage:
         return all(p.launched() for p in self.pending.values())
 
 
-def launch_encode(
+def merge_writes(
     pgt: PGTransaction,
     plan: WritePlan,
-    sinfo: StripeInfo,
-    ec: ErasureCodeInterface,
     obj_size: int,
     read_data: dict[int, bytes],
-    aggregator=None,
-) -> EncodeStage:
-    """Merge RMW inputs with the new bytes and LAUNCH the device encodes
-    (one batched launch per contiguous region) without materializing
-    parity — phase one of generate_transactions.  An `aggregator` routes
-    the launches through the cross-write aggregation window (ECBackend
-    passes its shared EncodeAggregator; the sync composition below does
-    not)."""
+) -> dict[int, bytearray]:
+    """The RMW merge: per contiguous will_write region, the committed
+    pre-write bytes (read_data) overlaid with the mutation's writes,
+    zero-filled past an in-region truncate.  Shared by the materialize
+    path (launch_encode) and the on-device delta path
+    (launch_encode_delta) so both encode exactly the same logical
+    bytes."""
     merged: dict[int, bytearray] = {}
     if pgt.delete:
-        return EncodeStage(merged=merged, pending={})
+        return merged
     for off, ln in plan.will_write:
         buf = bytearray(ln)
         # old bytes (RMW) first
@@ -225,12 +222,67 @@ def launch_encode(
         for off, buf in merged.items():
             if off <= t < off + len(buf):
                 buf[t - off :] = b"\x00" * (off + len(buf) - t)
+    return merged
+
+
+def launch_encode(
+    pgt: PGTransaction,
+    plan: WritePlan,
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    obj_size: int,
+    read_data: dict[int, bytes],
+    aggregator=None,
+) -> EncodeStage:
+    """Merge RMW inputs with the new bytes and LAUNCH the device encodes
+    (one batched launch per contiguous region) without materializing
+    parity — phase one of generate_transactions.  An `aggregator` routes
+    the launches through the cross-write aggregation window (ECBackend
+    passes its shared EncodeAggregator; the sync composition below does
+    not)."""
+    merged = merge_writes(pgt, plan, obj_size, read_data)
+    if pgt.delete:
+        return EncodeStage(merged=merged, pending={})
     pending = {
         off: stripe_mod.encode_launch(
             sinfo, ec, bytes(merged[off]), aggregator=aggregator
         )
         for off in sorted(merged)
     }
+    return EncodeStage(merged=merged, pending=pending)
+
+
+def launch_encode_delta(
+    pgt: PGTransaction,
+    plan: WritePlan,
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    obj_size: int,
+    read_data: dict[int, bytes],
+    cache,
+    cache_obj,
+    old_gen,
+    new_gen,
+) -> EncodeStage | None:
+    """Phase one via the fully on-device RMW delta path (ISSUE 18), or
+    None when it does not apply to EVERY region — mixed materialize/
+    delta stages are not worth the bookkeeping, and the all-or-nothing
+    verdict keeps the fallback trivially correct (the caller invalidates
+    the object and re-launches through `launch_encode`, dropping any
+    half-committed new-generation cache entries)."""
+    merged = merge_writes(pgt, plan, obj_size, read_data)
+    if pgt.delete or not merged:
+        return None
+    pending: dict[int, "stripe_mod.PendingEncode"] = {}
+    for off in sorted(merged):
+        pend = stripe_mod.encode_delta_launch(
+            sinfo, ec, bytes(merged[off]), cache, cache_obj,
+            old_gen, new_gen,
+            sinfo.aligned_logical_offset_to_chunk_offset(off),
+        )
+        if pend is None:
+            return None
+        pending[off] = pend
     return EncodeStage(merged=merged, pending=pending)
 
 
@@ -244,11 +296,21 @@ def finish_transactions(
     obj_size: int,
     hinfo: HashInfo | None,
     version: int,
+    chunk_cache=None,
+    cache_obj=None,
+    cache_generation=None,
 ) -> tuple[dict[int, Transaction], HashInfo | None, dict[int, bytes]]:
     """Phase two: materialize the launched encodes (blocking only until
     THIS op's launches finish) and build the per-shard Transactions +
     hinfo chain.  Must run in submit (tid) order per object — the hinfo
-    chain consumes the materialized parity bytes."""
+    chain consumes the materialized parity bytes.
+
+    With ``chunk_cache``/``cache_obj``/``cache_generation`` set (the
+    ECBackend passes them when the RMW delta path is armed and this op
+    took the MATERIALIZE path), every region's k+m shard chunks seed the
+    device cache at the write's generation — the residency the NEXT
+    cache-hit RMW deltas against (a delta-path op skips this: its launch
+    already committed data and parity in place)."""
     n = ec.get_chunk_count()
     txns = {s: Transaction() for s in range(n)}
 
@@ -281,6 +343,10 @@ def finish_transactions(
             chunk = np.ascontiguousarray(shards[s]).tobytes()
             txns[s].write(shard_colls[s], pgt.oid, chunk_off, chunk)
             region_appends[off][s] = chunk
+            if chunk_cache is not None:
+                chunk_cache.put(
+                    cache_obj, s, cache_generation, chunk, off=chunk_off
+                )
 
     # Cumulative hinfo: appends chain onto the existing digests; a full
     # rewrite from 0 restarts the chain (stale digests would flag every

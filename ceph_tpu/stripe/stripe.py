@@ -187,6 +187,143 @@ def encode(
     return encode_launch(sinfo, ec, data, want).result()
 
 
+def encode_delta_launch(
+    sinfo: StripeInfo,
+    ec: ErasureCodeInterface,
+    data: bytes | np.ndarray,
+    cache,
+    cache_obj,
+    old_gen,
+    new_gen,
+    cache_off: int,
+    want: set[int] | None = None,
+) -> PendingEncode | None:
+    """RMW encode via the fully on-device delta path (ISSUE 18), or None
+    when the path does not apply — the caller falls back to
+    ``encode_launch`` (the materialize path), which is byte-identical by
+    construction (same chosen plane program on both paths).
+
+    Applies when the DEVICE chunk cache holds EVERY shard of the region
+    — the k pre-write data chunks AND the m parity chunks — at the op's
+    pre-write generation ``old_gen``.  Then:
+
+    - the NEW data chunks commit to the cache at ``new_gen`` (the only
+      host bytes that move; counted as cache insertions, and the next
+      RMW's read leg wants them resident anyway),
+    - ONE fused launch computes parity_new = parity_old ^
+      Encode(data_old ^ data_new) entirely in HBM
+      (MatrixCodecMixin.encode_delta_device),
+    - the new parity replaces the cached parity in place at ``new_gen``
+      (DeviceChunkCache.replace — no device_put),
+    - and the committed flight record (group ``#delta``, flags ``delta``
+      + ``cache_hit``) shows h2d_s == 0 and d2h_s == 0: the launch
+      itself staged nothing through the host.
+
+    Any miss, put failure, fault or DEGRADED backend returns None; the
+    materialize path then re-encodes from the merged bytes under its own
+    guard/fallback machinery."""
+    if cache is None or old_gen is None or new_gen is None:
+        return None
+    raw = (
+        np.frombuffer(data, dtype=np.uint8)
+        if isinstance(data, (bytes, bytearray))
+        else np.asarray(data, dtype=np.uint8).ravel()
+    )
+    if raw.size % sinfo.stripe_width:
+        return None
+    k = ec.get_data_chunk_count()
+    n = ec.get_chunk_count()
+    m = n - k
+    if not (_matrix_fast_path(ec) and m > 0) or k != sinfo.k:
+        return None
+    from ceph_tpu.ops.guard import DeviceTimeout, device_guard
+
+    if device_guard().degraded:
+        return None
+    stripes = raw.size // sinfo.stripe_width
+    shard_len = stripes * sinfo.chunk_size
+    shaped = raw.reshape(stripes, k, sinfo.chunk_size)
+    if want is None:
+        want = set(range(n))
+    resident = cache.get_resident_many(
+        cache_obj, range(n), old_gen, off=cache_off, length=shard_len
+    )
+    if resident is None:
+        return None
+    import time
+
+    from ceph_tpu.common.fault_injector import faultpoint
+    from ceph_tpu.ops.flight_recorder import flight_recorder, new_record
+
+    def _fit(buf):
+        return buf[:shard_len] if int(buf.size) > shard_len else buf
+
+    fr = flight_recorder()
+    rec = new_record(
+        "encode", group="#delta", tickets=1, stripes=stripes,
+        batch=stripes, nbytes=raw.size,
+    )
+    rec["flags"]["delta"] = True
+    rec["flags"]["cache_hit"] = True
+    try:
+        with fr.active_scope(rec):
+            # commit the new data chunks first: their device buffers are
+            # operands of the launch.  A failed put (pressure, DEGRADED
+            # flip) aborts the whole path pre-dispatch.
+            new_bufs = []
+            for i in range(k):
+                if not cache.put(
+                    cache_obj, i, new_gen, shaped[:, i, :], off=cache_off
+                ):
+                    return None
+                buf = cache.get(cache_obj, i, new_gen, off=cache_off)
+                if buf is None:
+                    return None
+                new_bufs.append(_fit(buf))
+            t0 = time.monotonic()
+            rec["dispatch_ts"] = t0
+            faultpoint("codec.launch")
+            parity = device_guard().call(
+                lambda: ec.encode_delta_device(
+                    [_fit(resident[i]) for i in range(k)],
+                    new_bufs,
+                    [_fit(resident[k + i]) for i in range(m)],
+                    sinfo.chunk_size,
+                ),
+                what="delta dispatch",
+            )
+            # generation bump IN PLACE: the delta output never leaves
+            # HBM — each parity row re-enters the cache at new_gen with
+            # no device_put (the next cache-hit RMW deltas again)
+            for i in range(m):
+                cache.replace(
+                    cache_obj, k + i, new_gen,
+                    parity[:, i, :].reshape(-1), off=cache_off,
+                )
+            # the dispatch is async: kernel_s is the synchronous enqueue
+            # slice; h2d_s and d2h_s stay 0 — this launch staged nothing
+            rec["kernel_s"] = time.monotonic() - t0
+            rec["complete_ts"] = time.monotonic()
+            fr.commit(rec)
+            return PendingEncode(shaped, parity, k, m, want)
+    except DeviceTimeout as e:
+        # the dispatch wedged: degrade now (clears this cache) so the
+        # materialize fallback goes straight to the host oracle instead
+        # of paying a second deadline wait on the same wedged runtime
+        device_guard().mark_degraded(f"delta dispatch: {e}")
+        return None
+    except BaseException as e:
+        # faultpoint or runtime error: the materialize path takes over
+        # (its own guard re-runs the host oracle), and its invalidate
+        # drops the half-committed new-generation puts.  Visible, not
+        # silent: the fallback is logged and the materialize launch that
+        # follows commits its own flight record.
+        from ceph_tpu.common.log import dout
+
+        dout("osd", 1, f"delta encode fell back to materialize: {e!r}")
+        return None
+
+
 class PendingDecode:
     """A LAUNCHED (or aggregator-windowed) batched stripe decode whose
     device work may still be running — the decode twin of PendingEncode.

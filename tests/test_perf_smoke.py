@@ -192,3 +192,118 @@ class TestShardedCounters:
         assert sum(occ.values()) == dump["launches"]
         assert sum(v for n, v in occ.items() if n > 1) == dump["sharded_launches"]
         assert sum(n * v for n, v in occ.items()) == dump["device_launches"]
+
+
+class TestFusionBacklog:
+    """Super-launch fusion perf contract (ISSUE 18 satellite): under a
+    4-submitter backlog the aggregator must fuse ring-full window trips
+    instead of queueing per-window launches — fused_launches >= 1 and
+    strictly fewer device launches than windows dispatched."""
+
+    def test_fusion_fires_under_four_submitter_backlog(self):
+        import threading
+
+        from ceph_tpu.codec.matrix_codec import EncodeAggregator
+
+        ec = make_rs()
+        rng = np.random.default_rng(17)
+        agg = EncodeAggregator(
+            window=4,
+            max_bytes=1 << 30,
+            inflight_max_bytes=1 << 30,
+            pipeline_depth=1,
+            fuse_max_windows=4,
+        )
+        threads, per_thread = 4, 8
+        l0 = agg.perf.get("launches")
+        f0 = agg.perf.get("fused_launches")
+        results, errs = [[] for _ in range(threads)], []
+
+        def worker(t):
+            try:
+                for i in range(per_thread):
+                    h = rng.integers(0, 256, (1, 4, 2048), dtype=np.uint8)
+                    results[t].append((h, agg.submit(ec, h)))
+            except Exception as e:  # surfaced below; a thread must not die silently
+                errs.append(e)
+
+        ths = [
+            threading.Thread(target=worker, args=(t,)) for t in range(threads)
+        ]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        agg.flush()
+        assert not errs, errs
+        for bucket in results:
+            for h, ticket in bucket:
+                assert np.array_equal(
+                    np.asarray(ticket), ec.encode_array_host(h)
+                )
+        launches = agg.perf.get("launches") - l0
+        windows_dispatched = threads * per_thread // 4
+        assert agg.perf.get("fused_launches") - f0 >= 1, (
+            "a 4-submitter backlog never produced a fused launch"
+        )
+        assert launches < windows_dispatched, (
+            f"{launches} launches for {windows_dispatched} windows: "
+            "fusion is not reducing dispatch count under backlog"
+        )
+
+
+class TestRmwDeltaSmoke:
+    """RMW delta-path perf contract (ISSUE 18 satellite): a cache-hit
+    partial overwrite commits a delta flight record that moved zero
+    bytes over PCIe — h2d_s == 0 and d2h_s == 0."""
+
+    def test_cache_hit_rmw_commits_zero_pcie_flight_record(self):
+        from test_ec_backend import (
+            FLAG_EC_OVERWRITES,
+            Cluster,
+            ec_pool,
+            payload,
+        )
+
+        from ceph_tpu.common.options import OPTIONS
+        from ceph_tpu.ops.device_cache import device_chunk_cache
+        from ceph_tpu.ops.flight_recorder import flight_recorder
+
+        cc = device_chunk_cache()
+        cc.configure(max_bytes=1 << 24)
+        cc.clear()
+        try:
+            pool, profiles = ec_pool(4, 2, flags=FLAG_EC_OVERWRITES)
+            c = Cluster(pool, profiles)
+            sw = pool.stripe_width
+            base = payload(2 * sw, seed=19)
+            c.write("obj", 0, base)  # seeds every chunk resident
+            d0 = cc.perf_dump()["delta_updates"]
+            patch = payload(600, seed=20)
+            c.write("obj", 100, patch)
+            assert cc.perf_dump()["delta_updates"] > d0, (
+                "the cache-hit overwrite did not take the delta path"
+            )
+            deltas = [
+                r for r in flight_recorder().records()
+                if r["flags"].get("delta")
+            ]
+            assert deltas, "no delta flight record committed"
+            rec = deltas[-1]
+            assert rec["flags"].get("cache_hit")
+            assert rec["h2d_s"] == 0.0, (
+                f"delta path uploaded bytes (h2d_s={rec['h2d_s']}); "
+                "the zero-PCIe contract regressed"
+            )
+            assert rec["d2h_s"] == 0.0, (
+                f"delta path downloaded bytes (d2h_s={rec['d2h_s']}); "
+                "the zero-PCIe contract regressed"
+            )
+            expect = bytearray(base)
+            expect[100:700] = patch
+            assert c.read("obj", 0, len(expect)) == bytes(expect)
+        finally:
+            cc.clear()
+            cc.configure(
+                max_bytes=int(OPTIONS["ec_tpu_device_cache_bytes"].default)
+            )

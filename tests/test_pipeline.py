@@ -393,6 +393,13 @@ class TestRmwCacheConsult:
         cc = device_chunk_cache()
         cc.configure(max_bytes=1 << 22)
         cc.clear()
+        # disarm the on-device RMW delta path (ISSUE 18): with it on, a
+        # warm cache makes the RMW bump generations IN PLACE instead of
+        # invalidating — this test pins the MATERIALIZE path's
+        # generation-capture + encode-time-invalidation contract
+        from ceph_tpu.osd import ec_backend as ec_backend_mod
+
+        ec_backend_mod.configure_rmw_delta(False)
         try:
             pool, profiles = ec_pool(4, 2, flags=FLAG_EC_OVERWRITES)
             c = Cluster(pool, profiles)
@@ -420,6 +427,9 @@ class TestRmwCacheConsult:
         finally:
             from ceph_tpu.common.options import OPTIONS
 
+            ec_backend_mod.configure_rmw_delta(
+                bool(OPTIONS["ec_tpu_rmw_delta"].default)
+            )
             cc.clear()
             cc.configure(
                 max_bytes=int(OPTIONS["ec_tpu_device_cache_bytes"].default)
