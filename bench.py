@@ -885,6 +885,131 @@ def run_child(platform: str, mc_only: bool = False) -> None:
         waste_err = repr(e)
         clog(f"pad_waste stage failed: {waste_err}")
 
+    # Checksum stage (ISSUE 20): BlueStore per-block crc32c as packed
+    # bit-matrix matmuls through the offload runtime's device kernel.
+    # Bytes first: the probe digests are checked against utils/crc32c
+    # itself (the host oracle the fallback path IS) before anything is
+    # timed.  Each measured round mutates the block batch with the round
+    # index, so a fresh H2D + launch is paid every iteration — runtime
+    # caching of repeated identical launches cannot inflate the number.
+    csum_result = None
+    csum_err = ""
+    CS_BLOCK = 4096
+    cs_batch = 4096 if on_tpu else 512  # blocks per launch
+    try:
+        watchdog.stage("csum_probe", PROBE_TIMEOUT_S)
+        from ceph_tpu.ops.checksum_offload import (
+            crc32c_device,
+            crc32c_host_rows,
+        )
+
+        clog("csum probe: device digests vs utils/crc32c host oracle")
+        cs_probe = rng.integers(0, 256, (64, CS_BLOCK), dtype=np.uint8)
+        if not np.array_equal(
+            np.asarray(crc32c_device(cs_probe)), crc32c_host_rows(cs_probe)
+        ):
+            clog("CSUM PROBE MISMATCH vs utils/crc32c host oracle")
+            sys.exit(4)
+        # ragged tail length too: compressed stored forms are not
+        # BLOCK-sized, and the matrix cache must be right for every L
+        cs_tail = rng.integers(0, 256, (16, 1000), dtype=np.uint8)
+        if not np.array_equal(
+            np.asarray(crc32c_device(cs_tail)), crc32c_host_rows(cs_tail)
+        ):
+            clog("CSUM PROBE MISMATCH at ragged tail length")
+            sys.exit(4)
+        clog("csum probe vs host oracle OK")
+
+        watchdog.stage("csum_warmup", PROBE_TIMEOUT_S)
+        cs_blocks = rng.integers(
+            0, 256, (cs_batch, CS_BLOCK), dtype=np.uint8
+        )
+        crcs = crc32c_device(cs_blocks)
+        jax.block_until_ready(crcs)
+        watchdog.disarm()
+        clog(f"csum measuring: blocks={cs_batch} iters={iters}")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            cs_blocks[0, :4] ^= np.uint8(i + 1)  # fresh bytes each round
+            crcs = crc32c_device(cs_blocks)
+        jax.block_until_ready(crcs)
+        _ = np.asarray(crcs[:8])
+        cs_elapsed = time.perf_counter() - t0
+        cs_gbps = cs_batch * CS_BLOCK * iters / cs_elapsed / 1e9
+        clog(f"csum done: {cs_gbps:.3f} GB/s at blocks={cs_batch}")
+        csum_result = {
+            "gbps": cs_gbps,
+            "blocks": cs_batch,
+            "block_bytes": CS_BLOCK,
+            "digest_ok": True,
+        }
+    except SystemExit:
+        raise
+    except Exception as e:  # headline survives a failed csum stage
+        watchdog.disarm()
+        csum_err = repr(e)
+        clog(f"csum stage failed: {csum_err}")
+
+    # Write-path offload stage (ISSUE 20): the full offloaded BlueStore
+    # large-write device work — the compressor's byte-plane transpose +
+    # zero-run-elision transform AND the per-block crc32c — over the
+    # same block batch per round.  Probe checks the device transform
+    # byte-identical to the host transform (the fallback IS the host
+    # transform) before timing; throughput counts raw input bytes once.
+    offload_result = None
+    offload_err = ""
+    try:
+        watchdog.stage("compress_probe", PROBE_TIMEOUT_S)
+        from ceph_tpu.compressor.device import (
+            transform_rows,
+            transform_rows_device,
+        )
+
+        clog("compress probe: device transform vs host oracle")
+        off_probe = rng.integers(0, 256, (32, CS_BLOCK), dtype=np.uint8)
+        off_probe[:, ::2] = 0  # zero-heavy planes: elision has work to do
+        if not np.array_equal(
+            np.asarray(transform_rows_device(off_probe)),
+            transform_rows(off_probe),
+        ):
+            clog("COMPRESS PROBE MISMATCH vs host transform oracle")
+            sys.exit(4)
+        clog("compress probe vs host oracle OK")
+
+        watchdog.stage("offload_warmup", PROBE_TIMEOUT_S)
+        off_blocks = rng.integers(
+            0, 256, (cs_batch, CS_BLOCK), dtype=np.uint8
+        )
+        off_blocks[:, 1::2] = 0
+        t = transform_rows_device(off_blocks)
+        c = crc32c_device(off_blocks)
+        jax.block_until_ready((t, c))
+        watchdog.disarm()
+        clog(f"offload measuring: blocks={cs_batch} iters={iters}")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            off_blocks[0, :4] ^= np.uint8(i + 1)  # fresh bytes each round
+            t = transform_rows_device(off_blocks)
+            c = crc32c_device(off_blocks)
+        jax.block_until_ready((t, c))
+        _ = np.asarray(c[:8])
+        off_elapsed = time.perf_counter() - t0
+        off_gbps = cs_batch * CS_BLOCK * iters / off_elapsed / 1e9
+        del t, c
+        clog(f"offload done: {off_gbps:.3f} GB/s at blocks={cs_batch}")
+        offload_result = {
+            "gbps": off_gbps,
+            "blocks": cs_batch,
+            "block_bytes": CS_BLOCK,
+            "transform_ok": True,
+        }
+    except SystemExit:
+        raise
+    except Exception as e:  # headline survives a failed offload stage
+        watchdog.disarm()
+        offload_err = repr(e)
+        clog(f"offload stage failed: {offload_err}")
+
     result = {
         "platform": got,
         "gbps": gbps,
@@ -922,6 +1047,14 @@ def run_child(platform: str, mc_only: bool = False) -> None:
         result["pad_waste"] = waste_result
     elif waste_err:
         result["pad_waste_error"] = waste_err
+    if csum_result is not None:
+        result["csum"] = csum_result
+    elif csum_err:
+        result["csum_error"] = csum_err
+    if offload_result is not None:
+        result["offload"] = offload_result
+    elif offload_err:
+        result["offload_error"] = offload_err
     if stages is not None:
         result["stages"] = stages
     if os.environ.get("BENCH_TRACE"):
@@ -1325,6 +1458,32 @@ def main() -> None:
         }
     elif "pad_waste_error" in result:
         out["pad_waste_error"] = result["pad_waste_error"]
+    # write-path offload metrics (ISSUE 20, same {metric, value} sub-
+    # object shape): device crc32c GB/s and the fused compress+csum
+    # write-path GB/s, both probe-checked byte-identical to their host
+    # oracles before timing
+    if "csum" in result:
+        c = result["csum"]
+        out["csum"] = {
+            "metric": "bluestore_csum_GBps_per_chip",
+            "value": round(c["gbps"], 3),
+            "unit": "GB/s",
+            "blocks": c["blocks"],
+            "block_bytes": c["block_bytes"],
+        }
+    elif "csum_error" in result:
+        out["csum_error"] = result["csum_error"]
+    if "offload" in result:
+        off = result["offload"]
+        out["offload"] = {
+            "metric": "write_path_offload_GBps",
+            "value": round(off["gbps"], 3),
+            "unit": "GB/s",
+            "blocks": off["blocks"],
+            "block_bytes": off["block_bytes"],
+        }
+    elif "offload_error" in result:
+        out["offload_error"] = result["offload_error"]
     # multichip stage (ISSUE 6): aggregate GB/s of the mesh-sharded
     # launch path, alongside (never replacing) the per-chip metrics
     if "multichip" in result:

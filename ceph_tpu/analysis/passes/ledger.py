@@ -4,8 +4,9 @@ ledger is invisible residency.
 ISSUE 13 built ``common/mempool.py`` so every byte resident on the
 device is attributable to a named pool.  That property only holds if
 new code keeps the discipline: a ``jax.device_put`` in the data-path
-packages (``ops/``, ``codec/``, ``parallel/``) commits host bytes to
-HBM, and unless the result is threaded through a mempool-tracked
+packages (``ops/``, ``codec/``, ``parallel/``, ``compressor/``)
+commits host bytes to HBM, and unless the result is threaded through a
+mempool-tracked
 helper — ``track_buffer(...)`` wrapping the call, or an explicit
 ``ledger().alloc(...)`` handle in the same function — the bytes exist
 but no ledger pool knows, ``dump_mempools`` under-reports, and the
@@ -26,9 +27,11 @@ import ast
 from .. import Finding, SourceTree
 
 # packages whose device_put calls must be ledger-tracked: the EC data
-# path's HBM holders.  Matched as path components so the fixture trees
-# in tests (pkg/ops/x.py) scope the same way the live tree does.
-_SCOPED_DIRS = {"ops", "codec", "parallel"}
+# path's HBM holders, plus the compressor package now that the device
+# plugin (ISSUE 20) places block batches through the offload runtime.
+# Matched as path components so the fixture trees in tests
+# (pkg/ops/x.py) scope the same way the live tree does.
+_SCOPED_DIRS = {"ops", "codec", "parallel", "compressor"}
 
 _TRACKED_WRAPPERS = {"track_buffer", "tracked_device_put", "_hbm_track"}
 
@@ -56,8 +59,8 @@ class LedgerDisciplinePass:
     PASS_ID = "ledger-discipline"
     DESCRIBE = (
         "jax.device_put / device-buffer retention in ops//codec//"
-        "parallel/ outside a mempool-tracked helper (track_buffer or an "
-        "explicit ledger alloc handle)"
+        "parallel//compressor/ outside a mempool-tracked helper "
+        "(track_buffer or an explicit ledger alloc handle)"
     )
 
     def __call__(self, tree: SourceTree) -> list[Finding]:

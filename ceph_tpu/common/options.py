@@ -734,10 +734,53 @@ OPTIONS: dict[str, Option] = _opts(
     Option("osd_data", str, "", A,
            "data directory for persistent stores (empty = in-memory)"),
     Option("bluestore_compression_algorithm", str, "none", A,
-           "blob compression: none | zlib | zstd "
-           "(src/compressor plugin family; bluestore_compression_algorithm)"),
+           "blob compression: none | zlib | zstd | device "
+           "(src/compressor plugin family; bluestore_compression_algorithm; "
+           "`device` is the batched byte-plane transpose + zero-run "
+           "elision plugin riding the offload runtime, compressor/device.py)"),
     Option("bluestore_compression_required_ratio", float, 0.875, A,
            "store compressed only when compressed/raw <= this ratio"),
+    Option(
+        "bluestore_csum_offload",
+        bool,
+        False,
+        A,
+        "compute BlueStore per-block crc32c on the device through the "
+        "offload runtime (ops/checksum_offload.py ChecksumAggregator, "
+        "background lane): large-write stored-form checksums and batched "
+        "read-verify ride coalesced bit-matrix launches, with the "
+        "byte-identical utils/crc32c host oracle under faults/DEGRADED.  "
+        "Off = every checksum on the host table loop",
+        see_also=("bluestore_csum_offload_window",
+                  "bluestore_csum_offload_max_bytes"),
+        runtime=True,
+    ),
+    Option(
+        "bluestore_csum_offload_window",
+        int,
+        64,
+        A,
+        "checksum/compressor offload aggregation window: same-length "
+        "block batches held before a coalesced device launch "
+        "(ChecksumAggregator / CompressAggregator).  <= 1 launches every "
+        "submission immediately.  Store reaps drain the window, so the "
+        "value trades no durability, only launch count",
+        see_also=("bluestore_csum_offload",
+                  "bluestore_csum_offload_max_bytes"),
+        runtime=True,
+    ),
+    Option(
+        "bluestore_csum_offload_max_bytes",
+        int,
+        64 << 20,
+        A,
+        "input-byte budget per checksum/compressor aggregation group: a "
+        "group launches as soon as its queued block bytes reach this, "
+        "whatever the window (bounds device memory held by deferred "
+        "csum/compress launches)",
+        see_also=("bluestore_csum_offload_window",),
+        runtime=True,
+    ),
     Option("memstore_device_bytes", int, 1 << 30, A, ""),
     # --- logging (src/log) --------------------------------------------------
     Option("log_file", str, "", B, "empty = stderr"),

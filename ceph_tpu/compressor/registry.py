@@ -67,6 +67,12 @@ class CompressorRegistry:
         if inst is not None:
             return inst
         cls = self._PLUGINS.get(name)
+        if cls is None and name == "device":
+            # the device plugin self-registers on import; loaded lazily
+            # so the registry stays importable without jax on the path
+            from . import device  # noqa: F401
+
+            cls = self._PLUGINS.get(name)
         if cls is None:
             raise ValueError(
                 f"unknown compressor {name!r} (have {sorted(self._PLUGINS)})"

@@ -48,6 +48,10 @@ def _perf_type(counter: str) -> str:
         # per-label `pad_waste.<label>` slice are fractions that rise
         # AND fall as the bucketed pad targets learn
         or "waste" in counter
+        # offload-runtime registry levels (ISSUE 20): a service's pending
+        # submission count drains to zero, and the registered-service
+        # count is a level, not a monotone total
+        or name in ("pending", "services")
     ):
         return "gauge"
     return "counter"

@@ -53,7 +53,7 @@ from dataclasses import dataclass, field
 from ..utils.crc32c import crc32c
 from .kv import FileKV, KeyValueDB, MemKV
 from .objectstore import ObjectStore, StoreError
-from .transaction import Transaction
+from .transaction import OP_WRITE, Transaction
 
 BLOCK = 4096
 # Overwrites up to this many bytes take the deferred-WAL path
@@ -176,6 +176,7 @@ def make_store(conf) -> ObjectStore:
             compression_required_ratio=conf.get(
                 "bluestore_compression_required_ratio"
             ),
+            csum_offload=bool(conf.get("bluestore_csum_offload")),
         )
     if kind == "filestore":
         if not data:
@@ -192,6 +193,7 @@ class BlueStore(ObjectStore):
         path: str | None = None,
         compression: str = "none",
         compression_required_ratio: float = 0.875,
+        csum_offload: bool = False,
     ):
         from ..compressor import get_compressor
 
@@ -201,6 +203,16 @@ class BlueStore(ObjectStore):
         # required ratio; csums always cover the stored form
         self._compressor = get_compressor(compression or "none")
         self._required_ratio = compression_required_ratio
+        # device checksum offload (bluestore_csum_offload): large writes
+        # and read-verify sweeps batch their per-block crc32c through the
+        # shared offload runtime instead of the host table loop
+        self._csum_offload = bool(csum_offload)
+        # identical-content overwrites whose stored form was provably
+        # unchanged (store-form + csum + block write all elided)
+        self.csum_compute_skips = 0
+        # blocks whose stored csum came from an EC-transaction-fused
+        # digest (computed in the encode's launch window, not here)
+        self.csum_fused_blocks = 0
         self.db: KeyValueDB = MemKV() if path is None else None  # set at mount
         self._block_f = None
         self.alloc = BitmapAllocator(INITIAL_BLOCKS)
@@ -235,6 +247,41 @@ class BlueStore(ObjectStore):
         if len(comp) <= int(BLOCK * self._required_ratio):
             return comp, len(comp)
         return image, 0
+
+    def set_csum_offload(self, enabled: bool) -> None:
+        """Runtime observer target for `bluestore_csum_offload`."""
+        self._csum_offload = bool(enabled)
+
+    def _store_forms(self, images: list[bytes]) -> list[tuple[bytes, int]]:
+        """Batched `_store_form`: compressors exposing `compress_batch`
+        (the device plugin) get ONE call for the whole block range so
+        their transforms coalesce into shared offload launches; the
+        required-ratio gate is applied per block exactly as in the
+        scalar path."""
+        if not images:
+            return []
+        if self._compressor.name == "none":
+            return [(img, 0) for img in images]
+        batch = getattr(self._compressor, "compress_batch", None)
+        if batch is not None:
+            comps = batch(images)
+        else:
+            comps = [self._compressor.compress(img) for img in images]
+        limit = int(BLOCK * self._required_ratio)
+        return [
+            (comp, len(comp)) if len(comp) <= limit else (img, 0)
+            for img, comp in zip(images, comps)
+        ]
+
+    def _csum_batch(self, stored: list[bytes]) -> list[int]:
+        """crc32c over a batch of stored forms — one offload-runtime
+        submission per stored-length group when the knob is armed, else
+        the host table loop (byte-identical either way)."""
+        if self._csum_offload:
+            from ..ops.checksum_offload import checksum_blocks
+
+            return checksum_blocks(stored, offload=True)
+        return [crc32c(s) for s in stored]
 
     # -- mount / umount --------------------------------------------------------
 
@@ -451,16 +498,27 @@ class BlueStore(ObjectStore):
             return data[:end] + b"\x00" * (BLOCK - end)
         return data
 
-    def _write(self, coll: str, oid: str, off: int, data: bytes) -> None:
+    def _write(
+        self, coll: str, oid: str, off: int, data: bytes, csums=None
+    ) -> None:
+        """`csums` (EC-transaction fusion): per-BLOCK crc32c of `data`,
+        precomputed in the encode's offload launch window — an AggTicket
+        or uint32 array, trusted only for block-aligned writes whose
+        stored form stays raw (stored bytes == image bytes)."""
         if not data:
             self._get_onode(coll, oid, create=True)
             return
         o = self._get_onode(coll, oid, create=True)
         b0, b1 = off // BLOCK, (off + len(data) - 1) // BLOCK
-        # Assemble full-block images for the affected range.
+        # Assemble full-block images for the affected range, keeping the
+        # pre-overlay content of live blocks for the unchanged-skip check.
         images: dict[int, bytearray] = {}
+        orig: dict[int, bytes] = {}
         for b in range(b0, b1 + 1):
-            images[b] = bytearray(self._valid_block(o, b))
+            prev = self._valid_block(o, b)
+            if b in o.blocks:
+                orig[b] = prev
+            images[b] = bytearray(prev)
         cur = off
         dpos = 0
         while dpos < len(data):
@@ -470,27 +528,70 @@ class BlueStore(ObjectStore):
             images[b][boff : boff + n] = data[dpos : dpos + n]
             cur += n
             dpos += n
+        # Identical-content overwrite: a live block entirely below the
+        # current size whose image is unchanged keeps its stored form,
+        # csum, and physical slot — nothing to recompute or rewrite.
+        # (Blocks straddling o.size are never skipped: their stored tail
+        # bytes may be stale, and a size extension would expose them.)
+        skip = {
+            b
+            for b in images
+            if b in orig
+            and (b + 1) * BLOCK <= o.size
+            and bytes(images[b]) == orig[b]
+        }
+        self.csum_compute_skips += len(skip)
+        todo = [b for b in sorted(images) if b not in skip]
         all_mapped = all(b in o.blocks for b in images)
+        # One batched store-form + one batched csum pass for the whole
+        # range (the device compressor / csum service coalesce these
+        # into shared offload launches when armed).
+        forms = self._store_forms([bytes(images[b]) for b in todo])
+        crcs = [0] * len(todo)
+        pre = None
+        if csums is not None and off % BLOCK == 0 and len(data) % BLOCK == 0:
+            pre = csums.result() if hasattr(csums, "result") else csums
+        need = []
+        for i, b in enumerate(todo):
+            if pre is not None and forms[i][1] == 0:
+                # raw-stored fully-overwritten block: the fused digest
+                # covers exactly the stored bytes
+                crcs[i] = int(pre[b - b0])
+                self.csum_fused_blocks += 1
+            else:
+                need.append(i)
+        if need:
+            digs = self._csum_batch([forms[i][0] for i in need])
+            for i, dig in zip(need, digs):
+                crcs[i] = dig
         if all_mapped and len(data) <= DEFERRED_MAX:
             # deferred WAL overwrite in place
-            for b, image in images.items():
+            for i, b in enumerate(todo):
                 poff = o.blocks[b][0]
-                stored, clen = self._store_form(bytes(image))
-                o.blocks[b] = (poff, crc32c(stored), clen)
+                stored, clen = forms[i]
+                o.blocks[b] = (poff, crcs[i], clen)
                 self._deferred.append((poff, stored))
                 self._staged[poff] = stored
         else:
-            # COW: fresh blocks for the whole affected range
-            newblocks = self._ensure_capacity(len(images))
-            for (b, image), nb in zip(sorted(images.items()), newblocks):
+            # COW: fresh blocks for the (non-skipped) affected range
+            newblocks = self._ensure_capacity(len(todo))
+            for i, (b, nb) in enumerate(zip(todo, newblocks)):
                 old = o.blocks.get(b)
                 if old is not None:
                     self._to_release.append(old[0] // BLOCK)
-                stored, clen = self._store_form(bytes(image))
-                o.blocks[b] = (nb * BLOCK, crc32c(stored), clen)
+                stored, clen = forms[i]
+                o.blocks[b] = (nb * BLOCK, crcs[i], clen)
                 self._direct.append((nb * BLOCK, stored))
                 self._staged[nb * BLOCK] = stored
         o.size = max(o.size, off + len(data))
+
+    def _apply_op(self, op) -> None:
+        # thread the fused-csum hint through to _write; every other op
+        # takes the shared application loop
+        if op.code == OP_WRITE and getattr(op, "csums", None) is not None:
+            self._write(op.coll, op.oid, op.off, op.data, csums=op.csums)
+            return
+        super()._apply_op(op)
 
     def _truncate(self, coll: str, oid: str, size: int) -> None:
         o = self._get_onode(coll, oid, create=True)
@@ -586,17 +687,50 @@ class BlueStore(ObjectStore):
         end = o.size if length == 0 else min(off + length, o.size)
         if off >= end:
             return b""
+        b_first, b_last = off // BLOCK, (end - 1) // BLOCK
+        blocks = self._logical_blocks(o, b_first, b_last)
         parts = []
-        b = off // BLOCK
         cur = off
-        while cur < end:
-            block = self._logical_block(o, b)
+        for b in range(b_first, b_last + 1):
             lo = cur - b * BLOCK
             hi = min(BLOCK, end - b * BLOCK)
-            parts.append(block[lo:hi])
+            parts.append(blocks[b - b_first][lo:hi])
             cur = (b + 1) * BLOCK
-            b += 1
         return b"".join(parts)
+
+    def _logical_blocks(
+        self, o: Onode, b_first: int, b_last: int
+    ) -> list[bytes]:
+        """`_logical_block` over a contiguous range with ONE batched
+        verification-csum pass: when csum offload is armed the whole
+        range's stored forms ride the offload runtime (grouped by stored
+        length) instead of one host crc per block.  Holes read zeros;
+        a digest mismatch raises the same EIO as the scalar path."""
+        out: list[bytes | None] = [None] * (b_last - b_first + 1)
+        mapped: list[tuple[int, int, int, int, int, bytes]] = []
+        for b in range(b_first, b_last + 1):
+            ent = o.blocks.get(b)
+            if ent is None:
+                out[b - b_first] = b"\x00" * BLOCK
+                continue
+            poff, crc, clen = ent
+            stored = self._staged.get(poff)
+            if stored is None:
+                stored = self._block_read(poff, clen or BLOCK)
+                if not clen and len(stored) < BLOCK:
+                    stored = stored + b"\x00" * (BLOCK - len(stored))
+            mapped.append((b - b_first, b, poff, crc, clen, stored))
+        if mapped:
+            digs = self._csum_batch([m[5] for m in mapped])
+            for (idx, bidx, poff, crc, clen, stored), dig in zip(mapped, digs):
+                if dig != crc:
+                    raise StoreError(
+                        5, f"csum mismatch at block {bidx} (poff {poff})"
+                    )
+                out[idx] = (
+                    self._compressor.decompress(stored) if clen else stored
+                )
+        return out
 
     def _peek_onode(self, coll: str, oid: str) -> Onode:
         """Read-side onode lookup: no create, no dirty-marking."""

@@ -48,6 +48,14 @@ class Op:
     name: str = ""  # attr name / clone target
     keys: dict[str, bytes] = field(default_factory=dict)
     hints: int = 0
+    # EC-transaction fusion (ISSUE 20): per-BLOCK crc32c of `data`
+    # precomputed in the same offload launch window the chunk was
+    # encoded in — an AggTicket (or array) resolving to uint32 digests,
+    # consumed by BlueStore for block-aligned raw-stored writes.  A
+    # process-local optimization hint only: NOT encoded (a decoded
+    # transaction recomputes), never trusted for non-aligned or
+    # compressed stores.
+    csums: object = None
 
 
 class Transaction(Encodable):
@@ -69,10 +77,25 @@ class Transaction(Encodable):
         return self
 
     def write(
-        self, coll: str, oid: str, off: int, data: bytes, hints: int = 0
+        self,
+        coll: str,
+        oid: str,
+        off: int,
+        data: bytes,
+        hints: int = 0,
+        csums: object = None,
     ) -> "Transaction":
         self.ops.append(
-            Op(OP_WRITE, coll, oid, off=off, length=len(data), data=bytes(data), hints=hints)
+            Op(
+                OP_WRITE,
+                coll,
+                oid,
+                off=off,
+                length=len(data),
+                data=bytes(data),
+                hints=hints,
+                csums=csums,
+            )
         )
         return self
 

@@ -783,6 +783,24 @@ class ECBackend(PGBackend):
         while self._decode_pipe:
             self._finish_recovery_decode(self._decode_pipe[0])
 
+    def _csum_submit(self, chunk: bytes, chunk_off: int):
+        """EC-transaction fusion (ISSUE 20): a freshly materialized shard
+        chunk's per-BLOCK crc32c is submitted into the shared checksum
+        offload window right at encode-reap time, so the digests ride the
+        same launch cadence as the encodes that produced the bytes; the
+        returned ticket lands on the shard Transaction's write as its
+        ``csums`` hint (BlueStore skips its stored-form csum pass for raw
+        aligned blocks).  Misaligned chunks return None — the store
+        computes its own csums as usual."""
+        from ..os.bluestore import BLOCK
+
+        if not chunk or chunk_off % BLOCK or len(chunk) % BLOCK:
+            return None
+        from ..ops.checksum_offload import default_csum_aggregator
+
+        blocks = np.frombuffer(chunk, dtype=np.uint8).reshape(-1, BLOCK)
+        return default_csum_aggregator().submit_blocks(blocks)
+
     def _dispatch_encoded(self, op: Op) -> None:
         """Reap one launched encode and fan out its sub-writes
         (the completion half of try_reads_to_commit)."""
@@ -828,6 +846,11 @@ class ECBackend(PGBackend):
                     ),
                     cache_generation=(
                         op.version.version if seed else None
+                    ),
+                    csum_submit=(
+                        self._csum_submit
+                        if getattr(self.store, "_csum_offload", False)
+                        else None
                     ),
                 )
             except EcError as e:

@@ -489,6 +489,41 @@ class OSD(Dispatcher):
             ["ec_tpu_probe_interval_ms"],
             lambda _n, v: device_guard().configure(probe_interval_ms=int(v)),
         )
+        # device-offload runtime riders (ISSUE 20): the csum/compress
+        # service aggregators share the bluestore_csum_offload_window /
+        # _max_bytes knobs, and the BlueStore arm bit is runtime-mutable
+        # through the store's setter — all three options runtime=True
+        from ..compressor.device import default_compress_aggregator
+        from ..ops.checksum_offload import default_csum_aggregator
+
+        self.csum_aggregator = default_csum_aggregator()
+        self.compress_aggregator = default_compress_aggregator()
+
+        def _apply_offload_window(v: int) -> None:
+            self.csum_aggregator.configure(window=int(v))
+            self.compress_aggregator.configure(window=int(v))
+
+        def _apply_offload_max_bytes(v: int) -> None:
+            self.csum_aggregator.configure(max_bytes=int(v))
+            self.compress_aggregator.configure(max_bytes=int(v))
+
+        _apply_offload_window(self.conf.get("bluestore_csum_offload_window"))
+        _apply_offload_max_bytes(
+            self.conf.get("bluestore_csum_offload_max_bytes")
+        )
+        self.conf.add_observer(
+            ["bluestore_csum_offload_window"],
+            lambda _n, v: _apply_offload_window(v),
+        )
+        self.conf.add_observer(
+            ["bluestore_csum_offload_max_bytes"],
+            lambda _n, v: _apply_offload_max_bytes(v),
+        )
+        if hasattr(self.store, "set_csum_offload"):
+            self.conf.add_observer(
+                ["bluestore_csum_offload"],
+                lambda _n, v: self.store.set_csum_offload(bool(v)),
+            )
         # sharded-dispatch policy (ISSUE 6): the process-wide mesh fan-out
         # knobs ride the same config/observer plumbing as the aggregators
         from ..parallel import dispatch as shard_dispatch
@@ -603,6 +638,9 @@ class OSD(Dispatcher):
         dec_perf = self.decode_aggregator.perf
         ver_perf = self.verify_aggregator.perf
         from ..ops import dispatch as ec_dispatch
+        from ..ops.offload_runtime import (
+            offload_perf_dump as _offload_perf_dump,
+        )
 
         sock.register(
             "perf dump",
@@ -615,6 +653,8 @@ class OSD(Dispatcher):
                 # devices-per-launch dimension and the launch-scheduler
                 # per-class QoS counters (ops/dispatch.py)
                 "ec_dispatch": ec_dispatch.perf_dump(),
+                # offload-runtime service registry slice (ISSUE 20)
+                "offload": _offload_perf_dump(),
             },
             "dump perf counters",
         )
@@ -979,6 +1019,13 @@ class OSD(Dispatcher):
 
         for name, val in ec_dispatch.perf_dump().items():
             perf[f"ec_dispatch.{name}"] = val
+        # device-offload runtime services (ISSUE 20): one flat
+        # <service>.<counter> slice per registered rider (csum, compress,
+        # plus the EC trio), exported as ceph_tpu_offload_* families
+        from ..ops.offload_runtime import offload_perf_dump
+
+        for name, val in offload_perf_dump().items():
+            perf[f"offload.{name}"] = val
         # launch-scheduler QoS counters under their canonical prometheus
         # prefix (ISSUE 9): aliases of the sched.* slice the dispatch
         # loop above just exported, re-namespaced so the scrape renders

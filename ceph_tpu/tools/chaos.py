@@ -16,6 +16,12 @@ failure modes the wired FaultInjector seams expose (ISSUE 7):
   lifts, and a healthy control window proves hedging is quiescent,
 - device coding-launch failures (`codec.launch`) driving the
   DEGRADED-backend host fallback + re-probe self-heal,
+- an offload-fallback phase (ISSUE 20): launch faults armed while the
+  device crc32c and batched-compressor services have launches in
+  flight under mixed load with `bluestore_csum_offload` switched on
+  live — stored csums stay byte-identical to utils/crc32c, compressed
+  blobs round-trip, the offload_inflight mempool drains to zero, and
+  client p99 stays bounded,
 - a deep-scrub-under-load phase (ISSUE 9): silent shard corruption is
   planted on disk, every primary deep-scrubs (TPU-offloaded parity
   verify through the VerifyAggregator's background QoS lane) WHILE
@@ -70,6 +76,10 @@ def _osd_conf(i: int):
     return Config(
         {
             "name": f"osd.{i}",
+            # a real (in-memory) BlueStore, not MemStore: the offload
+            # phase (ISSUE 20) switches bluestore_csum_offload on live
+            # and verifies the csums the store actually persisted
+            "osd_objectstore": "bluestore",
             "osd_heartbeat_interval": 0.1,
             "osd_heartbeat_grace": 0.6,
             # tight deadline so an (injected) wedged launch falls back
@@ -777,6 +787,157 @@ async def _run(cfg: dict) -> dict:
         report["donation_recycled_live"] = recycled
         report["events"].append("pipelined wedge recovered byte-identical")
 
+        # ---- phase 3.8: offload fallback — csum + compressor (ISSUE 20) -
+        # Launch faults armed while the NON-EC offload services' launches
+        # are in flight: the device crc32c service (BlueStore per-block
+        # checksums, switched on live through the bluestore_csum_offload
+        # observer — the knob path itself is under test) and the batched
+        # device compressor.  Every affected launch must host-fallback
+        # byte-identically — the csum oracle IS utils/crc32c and the
+        # compressor's host transform is the device transform's twin —
+        # so the phase proves it three ways at once: directly-submitted
+        # tickets match the host oracle, the csums BlueStore actually
+        # STORED under fire equal crc32c of the stored form, and
+        # compressed blobs round-trip.  The offload_inflight mempool
+        # must drain to zero (EC-fusion tickets whose transactions were
+        # wire-encoded are never consumed — the drain is what settles
+        # them), and client p99 stays bounded while the faults land.
+        from ceph_tpu.compressor import get_compressor
+        from ceph_tpu.ops.checksum_offload import (
+            crc32c_host_rows,
+            default_csum_aggregator,
+        )
+        from ceph_tpu.compressor.device import default_compress_aggregator
+        from ceph_tpu.ops.offload_runtime import offload_perf_dump
+        from ceph_tpu.os.bluestore import BLOCK as BS_BLOCK
+        from ceph_tpu.utils.crc32c import crc32c as host_crc32c
+
+        off0 = offload_perf_dump()
+        for o in osds:
+            if o._running:
+                o.conf.set("bluestore_csum_offload", True)
+        csum_agg = default_csum_aggregator()
+        crng = np.random.default_rng(cfg["seed"] ^ 0x20)
+        csum_batches = [
+            crng.integers(0, 256, (8, BS_BLOCK), dtype=np.uint8)
+            for _ in range(4)
+        ]
+        dev_comp = get_compressor("device")
+        comp_blocks = []
+        for i in range(12):  # zero-heavy: the elision path really elides
+            buf = bytearray(BS_BLOCK)
+            buf[64 * (i % 8): 64 * (i % 8) + 16] = bytes(range(16))
+            buf[0] = i + 1
+            comp_blocks.append(bytes(buf))
+        await arm("codec.launch", 5, 2 + cfg["launch_faults"])
+        csum_tickets = [csum_agg.submit_blocks(b) for b in csum_batches]
+        comp_blobs = dev_comp.compress_batch(comp_blocks)
+        assert all(
+            dev_comp.decompress(blob) == blk
+            for blob, blk in zip(comp_blobs, comp_blocks)
+        ), "chaos: wedged compressor blobs did not round-trip"
+        assert all(
+            blob == dev_comp.compress(blk)
+            for blob, blk in zip(comp_blobs, comp_blocks)
+        ), "chaos: wedged compressor blobs diverged from the host form"
+        assert all(
+            np.array_equal(
+                np.asarray(t.result()), crc32c_host_rows(b)
+            )
+            for t, b in zip(csum_tickets, csum_batches)
+        ), "chaos: wedged csum tickets diverged from utils/crc32c"
+        # mixed client load while the remaining armed hits land on the
+        # write path's OWN csum launches (and the read-backs' verify
+        # recomputes), per-op latency sampled for the p99 bound
+        off_lat_s: list[float] = []
+        for i in range(cfg["objects"]):
+            t0 = time.monotonic()
+            await put(f"offload{i}", 8 * BS_BLOCK)
+            off_lat_s.append(time.monotonic() - t0)
+            back = await io.read(f"offload{i}")
+            assert back == expected[f"offload{i}"], (
+                f"chaos: offload{i} corrupt under csum-offload faults"
+            )
+        inj.clear("codec.launch")
+        # the csums BlueStore STORED under fire are the host oracle's:
+        # walk every live store's offload-phase blocks and recompute
+        checked_blocks = 0
+        for o in osds:
+            if not o._running:
+                continue
+            st = o.store
+            for coll in sorted(st._colls):
+                for oid in sorted(st.list_objects(coll)):
+                    if not str(oid).startswith("offload"):
+                        continue
+                    on = st._get_onode(coll, oid)
+                    for bidx in sorted(on.blocks):
+                        poff, crc, clen = on.blocks[bidx]
+                        stored = st._staged.get(poff)
+                        if stored is None:
+                            stored = st._block_read(
+                                poff, clen if clen else BS_BLOCK
+                            )
+                        if not clen:
+                            stored = stored.ljust(BS_BLOCK, b"\x00")
+                        assert host_crc32c(stored) == crc, (
+                            f"chaos: stored csum for {coll}/{oid} block "
+                            f"{bidx} is not utils/crc32c of the stored "
+                            "form — the fallback was not byte-identical"
+                        )
+                        checked_blocks += 1
+        assert checked_blocks >= 8, (
+            f"chaos: offload phase verified only {checked_blocks} stored "
+            "blocks — the load never reached the csum-offload write path"
+        )
+        # settle the never-consumed EC-fusion tickets, then the
+        # offload_inflight pool must hold ZERO bytes
+        csum_agg.drain()
+        default_compress_aggregator().drain()
+        offload_leaked = hbm.current_bytes("offload_inflight")
+        off1 = offload_perf_dump()
+        for o in osds:
+            if o._running:
+                o.conf.set("bluestore_csum_offload", False)
+        off_lat_s.sort()
+        off_p99_s = (
+            off_lat_s[int(0.99 * (len(off_lat_s) - 1))]
+            if off_lat_s else 0.0
+        )
+        report["offload_csum_launches"] = (
+            off1.get("csum.launches", 0) - off0.get("csum.launches", 0)
+        )
+        report["offload_csum_fallbacks"] = (
+            off1.get("csum.host_fallbacks", 0)
+            - off0.get("csum.host_fallbacks", 0)
+        )
+        report["offload_compress_fallbacks"] = (
+            off1.get("compress.host_fallbacks", 0)
+            - off0.get("compress.host_fallbacks", 0)
+        )
+        report["offload_stored_blocks"] = checked_blocks
+        report["offload_leaked_bytes"] = offload_leaked
+        report["offload_p99_ms"] = round(off_p99_s * 1e3, 3)
+        assert report["offload_csum_launches"] >= 1, (
+            "chaos: the csum service never launched under the offload load"
+        )
+        assert report["offload_csum_fallbacks"] >= 1, (
+            "chaos: armed launch faults never drove a csum host fallback"
+        )
+        assert report["offload_compress_fallbacks"] >= 1, (
+            "chaos: armed launch faults never drove a compress host "
+            "fallback"
+        )
+        assert offload_leaked == 0, (
+            f"chaos: {offload_leaked} offload_inflight bytes leaked "
+            f"after drain (reconcile: {hbm.reconcile()})"
+        )
+        assert off_p99_s * 1e3 <= cfg["offload_p99_bound_ms"], (
+            f"chaos: client p99 {off_p99_s * 1e3:.1f} ms exceeded the "
+            f"{cfg['offload_p99_bound_ms']} ms bound under offload faults"
+        )
+        report["events"].append("offload faults host-fallback byte-identical")
+
         # ---- phase 4: OSD flap + recovery -------------------------------
         victim_id = rng.randrange(cfg["osds"])
         victim = osds[victim_id]
@@ -1135,6 +1296,7 @@ async def _run(cfg: dict) -> dict:
         hbm_leaked = (
             hbm.current_bytes("ec_pipeline_inflight")
             + hbm.current_bytes("verify")
+            + hbm.current_bytes("offload_inflight")
         )
         report["hbm_leaked_bytes"] = hbm_leaked
         assert hbm_leaked == 0, (
@@ -1372,6 +1534,10 @@ def run_chaos(
         # and the assertion trips — it cannot pass vacuously.
         "gray_delay_ms": 3000.0,
         "gray_p99_bound_ms": 2000.0 if smoke else 1000.0,
+        # ISSUE 20 offload-fallback gate: client write p99 bound while
+        # launch faults land on the csum/compressor services (same
+        # generosity rationale — catches seconds-scale stalls, not noise)
+        "offload_p99_bound_ms": 2000.0 if smoke else 1000.0,
     }
     return asyncio.run(_run(cfg))
 

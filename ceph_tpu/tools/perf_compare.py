@@ -69,6 +69,11 @@ METRICS: dict[str, tuple[tuple[str, ...], str, bool]] = {
     # paid for it — both lower-is-better, folded from the chaos JSON
     "chaos_gray_p99_ms": (("chaos", "gray_p99_ms"), "lower", False),
     "chaos_hedge_rate": (("chaos", "hedge_rate"), "lower", False),
+    # write-path offload trajectory (ISSUE 20): device crc32c GB/s and
+    # the fused compressor-transform + csum write path — both per-chip
+    # throughputs, judged same-platform like the EC kernels
+    "bluestore_csum_GBps_per_chip": (("csum",), "higher", True),
+    "write_path_offload_GBps": (("offload",), "higher", True),
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -60,6 +60,7 @@ def _hbm_leak_gate():
         return (
             led.current_bytes("ec_pipeline_inflight")
             + led.current_bytes("verify")
+            + led.current_bytes("offload_inflight")
         )
 
     leaked = _held()

@@ -26,7 +26,7 @@ class TestChaosSmoke:
         assert report["converged"], report
         assert report["lost_writes"] == 0, report
         # every chaos phase actually ran
-        assert len(report["events"]) == 12, report["events"]
+        assert len(report["events"]) == 13, report["events"]
         # ISSUE 17: the gray-OSD phase — one OSD's shard reads delayed
         # ~50x while its heartbeats stayed on time.  Hedged/re-planned
         # reads kept client p99 under the injected delay, the victim
@@ -70,6 +70,20 @@ class TestChaosSmoke:
         # 4 launches through a depth-2 ring MUST overflow it: a zero here
         # means _drain_pipeline silently stopped bounding the ring
         assert report["pipeline_drains"] >= 1, report
+        # ISSUE 20: the offload-fallback phase armed launch faults while
+        # the csum and compressor services had launches in flight under
+        # mixed load — directly-submitted tickets matched the host
+        # oracle and compressed blobs round-tripped (asserted inside the
+        # phase), the csums BlueStore actually STORED under fire equal
+        # utils/crc32c of the stored form, both services really fell
+        # back at least once, the offload_inflight mempool drained to
+        # zero, and client p99 stayed inside the bound
+        assert report["offload_csum_launches"] >= 1, report
+        assert report["offload_csum_fallbacks"] >= 1, report
+        assert report["offload_compress_fallbacks"] >= 1, report
+        assert report["offload_stored_blocks"] >= 8, report
+        assert report["offload_leaked_bytes"] == 0, report
+        assert 0.0 <= report["offload_p99_ms"] <= 2000.0, report
         # ISSUE 9: the deep-scrub-under-load phase detected the planted
         # corruption through aggregated device verify launches (fewer
         # launches than objects = one launch covered many), and client

@@ -231,6 +231,28 @@ class TestClusterScrapeLint:
                 np.zeros((1, 2, 512), dtype=np.uint8)
             ))
 
+            # ...and one eager csum submit + device-compressor batch so
+            # the ISSUE 20 offload services carry their full counter
+            # sets (the launch-path keys materialize lazily on first
+            # use) before the round-trip snapshot below
+            from ceph_tpu.compressor import get_compressor
+            from ceph_tpu.ops.checksum_offload import (
+                default_csum_aggregator,
+            )
+            from ceph_tpu.ops.offload_runtime import offload_perf_dump
+
+            np.asarray(default_csum_aggregator().submit_blocks(
+                np.zeros((2, 512), dtype=np.uint8)
+            ).result())
+            get_compressor("device").compress_batch(
+                [bytes(65536), bytes(65536)]
+            )
+            offload_keys = set(offload_perf_dump())
+            assert {"services", "csum.pending", "csum.launches",
+                    "compress.pending", "compress.launches",
+                    "csum.host_fallbacks",
+                    "compress.host_fallbacks"} <= offload_keys
+
             # snapshot the perf-dump key set BEFORE waiting on the
             # scrape: the OSD reports the same process-wide counters, so
             # every key here must round-trip through MMgrReport
@@ -262,6 +284,12 @@ class TestClusterScrapeLint:
                 if "op_latency" not in text or not all(
                     f"ceph_tpu_ec_dispatch_{_sanitize(k)}" in text
                     for k in dispatch_keys
+                ):
+                    return False
+                # ...and the ISSUE 20 offload-service slice arrived
+                if not all(
+                    f"ceph_tpu_offload_{_sanitize(k)}" in text
+                    for k in offload_keys
                 ):
                     return False
                 # ...and the iostat module consumed a pool_io report:
@@ -565,6 +593,47 @@ class TestClusterScrapeLint:
                 "ceph_tpu_recovery_storm_preempted_backfills",
             ):
                 assert families[fam]["type"] == "counter", fam
+
+            # ISSUE 20 cross-lint: the device-offload service registry —
+            # every offload_perf_dump() key round-trips onto the scrape
+            # as ceph_tpu_offload_<service>_<counter> AND is documented,
+            # and vice versa.  pending/services are levels (gauges);
+            # launch and fallback totals stay counters; the per-service
+            # launch-shape distributions render as real histogram
+            # families the linter's bucket checks already validated.
+            for key in offload_keys:
+                fam = f"ceph_tpu_offload_{_sanitize(key)}"
+                assert fam in families, f"{fam} missing from scrape"
+                assert documented(fam), f"{fam} not documented"
+            live_offload = {_sanitize(k) for k in offload_perf_dump()}
+            for fam in families:
+                if fam.startswith("ceph_tpu_offload_"):
+                    key = fam.removeprefix("ceph_tpu_offload_")
+                    assert key in live_offload, (
+                        f"scraped {fam} has no offload_perf_dump() "
+                        "source — update the exporter or the docs"
+                    )
+            assert families["ceph_tpu_offload_services"]["type"] == "gauge"
+            for svc in ("encode", "decode", "verify", "compress", "csum"):
+                assert (
+                    families[f"ceph_tpu_offload_{svc}_pending"]["type"]
+                    == "gauge"
+                ), svc
+            for fam in (
+                "ceph_tpu_offload_csum_launches",
+                "ceph_tpu_offload_csum_host_fallbacks",
+                "ceph_tpu_offload_compress_launches",
+                "ceph_tpu_offload_compress_host_fallbacks",
+            ):
+                assert families[fam]["type"] == "counter", fam
+            assert (
+                families["ceph_tpu_offload_csum_stripes_per_launch"][
+                    "type"] == "histogram"
+            )
+            assert (
+                families["ceph_tpu_offload_compress_launch_bytes"][
+                    "type"] == "histogram"
+            )
 
             # ISSUE 16 cross-lint: the clog module subscribes to the
             # committed log stream and polls the health-event history —

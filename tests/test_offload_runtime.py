@@ -1,0 +1,189 @@
+"""Device-offload runtime (ISSUE 20): the service registry that names
+every LaunchAggregator-backed offload service, the refactor guard
+pinning `codec/matrix_codec` to its `ops/offload_runtime` re-exports
+(the promotion must be a pure move — same objects, same behavior), and
+the device crc32c service's byte-identity against `utils/crc32c` across
+block sizes, ragged tails, fault injection and the DEGRADED bypass."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.fault_injector import global_injector
+from ceph_tpu.ops.checksum_offload import (
+    CSUM_OFFLOAD_MIN_BYTES,
+    ChecksumAggregator,
+    checksum_blocks,
+    crc32c_device,
+    crc32c_host_rows,
+    default_csum_aggregator,
+)
+from ceph_tpu.ops.guard import device_guard
+from ceph_tpu.utils.crc32c import crc32c
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    global_injector().clear()
+    device_guard().mark_healthy()
+    device_guard().configure(timeout_ms=20000, probe_interval_ms=2000)
+
+
+class TestServiceRegistry:
+    def test_builtin_services_present_in_registration_order(self):
+        from ceph_tpu.ops.offload_runtime import offload_services
+
+        names = offload_services()
+        # the EC trio registered first (the refactor's zero-behavior
+        # seam), the ISSUE 20 services after them
+        for want in ("encode", "decode", "verify", "compress", "csum"):
+            assert want in names, names
+
+    def test_service_resolves_the_default_aggregators(self):
+        from ceph_tpu.codec.matrix_codec import default_encode_aggregator
+        from ceph_tpu.compressor.device import default_compress_aggregator
+        from ceph_tpu.ops.offload_runtime import service, service_aggregator
+
+        assert service("csum").aggregator() is default_csum_aggregator()
+        assert service("encode").aggregator() is default_encode_aggregator()
+        assert service_aggregator("compress") is default_compress_aggregator()
+
+    def test_register_is_idempotent(self):
+        from ceph_tpu.ops.offload_runtime import (
+            offload_services,
+            register_service,
+        )
+
+        before = offload_services()
+        register_service(
+            "csum", default_csum_aggregator, lane="background",
+            oracle="utils/crc32c.crc32c", doc="re-registration no-op",
+        )
+        assert offload_services() == before
+
+    def test_perf_dump_is_flat_and_names_every_service(self):
+        from ceph_tpu.ops.offload_runtime import (
+            offload_perf_dump,
+            offload_services,
+        )
+
+        dump = offload_perf_dump()
+        names = offload_services()
+        assert dump["services"] == len(names)
+        for name in names:
+            assert f"{name}.pending" in dump, sorted(dump)
+        # flat values only — scalars plus the histogram payload shape
+        # the prometheus exporter already renders; nothing nested deeper
+        assert all(
+            isinstance(v, (int, float))
+            or (isinstance(v, dict) and "histogram" in v)
+            for v in dump.values()
+        )
+
+    def test_service_lanes_match_their_qos_class(self):
+        from ceph_tpu.ops.offload_runtime import service
+
+        # checksums and compression must never head-of-line-block
+        # client encodes: both ride the background lane
+        assert service("csum").lane == "background"
+        assert service("compress").lane == "background"
+        assert service("csum").aggregator().SCHED_CLASS == "background"
+
+
+class TestRefactorGuard:
+    def test_matrix_codec_reexports_are_the_runtime_objects(self):
+        """The promotion to ops/offload_runtime was a pure move: every
+        name matrix_codec still exports must BE the runtime's object,
+        not a copy — two class objects would mean two donation pools,
+        two aggregator registries, two drain scopes."""
+        from ceph_tpu.codec import matrix_codec as mc
+        from ceph_tpu.ops import offload_runtime as rt
+
+        assert mc.LaunchAggregator is rt.LaunchAggregator
+        assert mc.AggTicket is rt.AggTicket
+        assert mc.DonationPool is rt.DonationPool
+        assert mc._AggGroup is rt._AggGroup
+        assert mc.drain_all_aggregators is rt.drain_all_aggregators
+        assert mc.drop_donation_retention is rt.drop_donation_retention
+
+    def test_every_service_aggregator_subclasses_the_runtime_base(self):
+        from ceph_tpu.codec.matrix_codec import (
+            DecodeAggregator,
+            EncodeAggregator,
+            VerifyAggregator,
+        )
+        from ceph_tpu.compressor.device import CompressAggregator
+        from ceph_tpu.ops.offload_runtime import LaunchAggregator
+
+        for cls in (EncodeAggregator, DecodeAggregator, VerifyAggregator,
+                    ChecksumAggregator, CompressAggregator):
+            assert issubclass(cls, LaunchAggregator), cls
+
+    def test_drain_all_reaches_the_new_services(self):
+        from ceph_tpu.ops.offload_runtime import drain_all_aggregators
+
+        agg = default_csum_aggregator()
+        blocks = np.arange(2 * 512, dtype=np.uint8).reshape(2, 512) % 251
+        ticket = agg.submit_blocks(blocks)
+        drain_all_aggregators()
+        assert agg.pending() == 0
+        assert np.array_equal(
+            np.asarray(ticket.result()), crc32c_host_rows(blocks)
+        )
+
+
+class TestDeviceCrc32c:
+    @pytest.mark.parametrize("L", [1, 4, 63, 64, 512, 1000, 4096])
+    def test_device_digests_byte_identical_across_lengths(self, L):
+        rng = np.random.default_rng(L)
+        blocks = rng.integers(0, 256, (5, L), dtype=np.uint8)
+        got = np.asarray(crc32c_device(blocks))
+        assert np.array_equal(got, crc32c_host_rows(blocks)), L
+
+    def test_host_rows_is_the_utils_oracle(self):
+        rng = np.random.default_rng(7)
+        blocks = rng.integers(0, 256, (3, 200), dtype=np.uint8)
+        want = [crc32c(row.tobytes()) for row in blocks]
+        assert list(crc32c_host_rows(blocks)) == want
+
+    def test_checksum_blocks_matches_host_below_and_above_threshold(self):
+        rng = np.random.default_rng(11)
+        small = [rng.bytes(100) for _ in range(3)]  # host loop
+        assert checksum_blocks(small) == [crc32c(c) for c in small]
+        # ragged population: three length groups, one above threshold
+        big = [rng.bytes(4096) for _ in range(6)]
+        mixed = big + [rng.bytes(1000), b"", rng.bytes(1000)]
+        assert sum(len(c) for c in mixed) >= CSUM_OFFLOAD_MIN_BYTES
+        assert checksum_blocks(mixed) == [crc32c(c) for c in mixed]
+
+    def test_fault_injected_launch_falls_back_byte_identical(self):
+        agg = ChecksumAggregator(window=4)
+        rng = np.random.default_rng(13)
+        blocks = rng.integers(0, 256, (4, 4096), dtype=np.uint8)
+        global_injector().inject("codec.launch", 5, hits=1)
+        fb0 = agg.perf.get("host_fallbacks")
+        ticket = agg.submit_blocks(blocks)
+        assert np.array_equal(
+            np.asarray(ticket.result()), crc32c_host_rows(blocks)
+        )
+        assert agg.perf.get("host_fallbacks") == fb0 + 1
+        assert device_guard().degraded  # the failed launch marked it
+
+    def test_degraded_bypass_stays_byte_identical(self):
+        device_guard().configure(probe_interval_ms=10 * 60 * 1000)
+        device_guard().mark_degraded("test: forced")
+        try:
+            rng = np.random.default_rng(17)
+            chunks = [rng.bytes(4096) for _ in range(8)]
+            assert checksum_blocks(chunks) == [crc32c(c) for c in chunks]
+        finally:
+            device_guard().mark_healthy()
+
+    def test_matrix_cache_is_bounded(self):
+        from ceph_tpu.ops import checksum_offload as co
+
+        for L in range(1, 2 * co._MATRIX_CACHE_CAP):
+            co._contribution_matrix(L)
+            co._zero_const(L)
+        assert len(co._HOST_MATRICES) <= co._MATRIX_CACHE_CAP
+        assert len(co._CONSTS) <= co._MATRIX_CACHE_CAP

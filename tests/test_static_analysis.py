@@ -109,6 +109,42 @@ class TestDonationPass:
         findings = DonationLifetimePass()(tree)
         assert any("placed" in k for k in _keys(findings)), findings
 
+    def test_offload_runtime_module_in_scope(self, tmp_path):
+        """ISSUE 20: the hoisted offload runtime is covered exactly like
+        the codec module its machinery came from."""
+        tree = _tree(tmp_path, {"ops/offload_runtime.py": """
+            import functools, jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def launch(buf):
+                return buf + 1
+
+            def reap(buf):
+                out = launch(buf)
+                return buf.nbytes  # use-after-donation
+        """})
+        findings = DonationLifetimePass()(tree)
+        assert any("::reap::buf" in k for k in _keys(findings)), findings
+
+    def test_compressor_service_module_in_scope(self, tmp_path):
+        """ISSUE 20: a compressor-package service module donating into
+        its batched transform is covered too."""
+        tree = _tree(tmp_path, {"compressor/device.py": """
+            import functools, jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def transform(rows):
+                return rows + 1
+
+            def compress_batch(rows):
+                out = transform(rows)
+                return rows.sum()  # use-after-donation
+        """})
+        findings = DonationLifetimePass()(tree)
+        assert any(
+            "::compress_batch::rows" in k for k in _keys(findings)
+        ), findings
+
 
 class TestPurityPass:
     @pytest.mark.parametrize("body,what", [
@@ -326,6 +362,35 @@ class TestLedgerPass:
         assert any("::stage::device_put" in k for k in _keys(findings)), (
             findings
         )
+
+    def test_offload_runtime_untracked_device_put_trips(self, tmp_path):
+        """ISSUE 20: the offload runtime and its service modules are in
+        scope — a bare device_put in ops/offload_runtime.py trips."""
+        tree = _tree(tmp_path, {"ops/offload_runtime.py": """
+            import jax
+
+            def dispatch(batch):
+                return jax.device_put(batch)
+        """})
+        findings = LedgerDisciplinePass()(tree)
+        assert any(
+            "::dispatch::device_put" in k for k in _keys(findings)
+        ), findings
+
+    def test_compressor_untracked_device_put_trips(self, tmp_path):
+        """ISSUE 20: compressor/ joined the scoped data-path packages —
+        the device plugin's placements must be ledger-tracked."""
+        tree = _tree(tmp_path, {"compressor/device.py": """
+            import jax
+
+            def transform_rows_device(rows):
+                return jax.device_put(rows)
+        """})
+        findings = LedgerDisciplinePass()(tree)
+        assert any(
+            "::transform_rows_device::device_put" in k
+            for k in _keys(findings)
+        ), findings
 
     def test_track_buffer_wrapper_passes(self, tmp_path):
         tree = _tree(tmp_path, {"parallel/place.py": """
